@@ -1,0 +1,105 @@
+"""Binary identifiers for cluster entities.
+
+Capability parity with the reference's ID scheme (``src/ray/common/id.h``):
+every cluster entity (node, job, task, actor, object, placement group) is
+identified by a fixed-width random binary ID with a stable hex rendering.
+Unlike the reference we do not embed lineage structure in the ID bytes; the
+owner/lineage tables in :mod:`ray_tpu._private.refcount` carry that
+relationship instead, which keeps IDs opaque and cheap to generate.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_counter_lock = threading.Lock()
+_counter = 0
+
+
+def _unique_bytes(nbytes: int) -> bytes:
+    return os.urandom(nbytes)
+
+
+class BaseID:
+    """Immutable fixed-width binary identifier."""
+
+    SIZE = 16
+    __slots__ = ("_binary", "_hash")
+
+    def __init__(self, binary: bytes):
+        if not isinstance(binary, bytes) or len(binary) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {binary!r}"
+            )
+        self._binary = binary
+        self._hash = hash((type(self).__name__, binary))
+
+    @classmethod
+    def from_random(cls) -> "BaseID":
+        return cls(_unique_bytes(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str) -> "BaseID":
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls) -> "BaseID":
+        return cls(b"\x00" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._binary == b"\x00" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._binary
+
+    def hex(self) -> str:
+        return self._binary.hex()
+
+    def __hash__(self) -> int:
+        return self._hash
+
+    def __eq__(self, other) -> bool:
+        return type(other) is type(self) and other._binary == self._binary
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.hex()[:16]})"
+
+    def __reduce__(self):
+        return (type(self), (self._binary,))
+
+
+class NodeID(BaseID):
+    SIZE = 16
+
+
+class JobID(BaseID):
+    SIZE = 8
+
+
+class TaskID(BaseID):
+    SIZE = 16
+
+
+class ActorID(BaseID):
+    SIZE = 16
+
+
+class ObjectID(BaseID):
+    SIZE = 20
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 16
+
+
+class WorkerID(BaseID):
+    SIZE = 16
+
+
+def next_seqno() -> int:
+    """Monotonic process-wide sequence number (actor task ordering)."""
+    global _counter
+    with _counter_lock:
+        _counter += 1
+        return _counter

@@ -1,0 +1,310 @@
+"""Per-node runtime: resource accounting, task dispatch, actor hosting.
+
+Parity contract (reference ``src/ray/raylet/``): each node owns a resource
+ledger (``LocalResourceManager``), a queue of leased tasks gated on resource
+availability (``LocalTaskManager``), a worker pool that executes them, and the
+actor executors living on the node. Worker leases are implicit: the scheduler
+(:mod:`ray_tpu._private.scheduler`) assigns a task to a node, the node's
+dispatch loop admits it when resources free up, and a pooled worker thread
+runs it.
+
+TPU-first note: heavy compute on this framework happens inside XLA executables
+which release the GIL, so a thread-based worker pool gives real parallelism
+for accelerator work; CPU-bound Python tasks still interleave. The dispatch /
+resource model is process-agnostic so a subprocess worker pool can slot in.
+"""
+
+from __future__ import annotations
+
+import heapq
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu._private.gcs import NodeInfo
+from ray_tpu._private.ids import ActorID, NodeID
+from ray_tpu._private.object_store import LocalObjectStore
+from ray_tpu._private.task_spec import TaskKind, TaskSpec
+
+_DISPATCH_POLL_S = 5.0
+
+
+class ResourceLedger:
+    """Tracks total/available resources with blocking acquire."""
+
+    def __init__(self, total: Dict[str, float]):
+        self.total = dict(total)
+        self._available = dict(total)
+        self._cond = threading.Condition()
+
+    def can_fit_total(self, demand: Dict[str, float]) -> bool:
+        return all(self.total.get(k, 0.0) >= v for k, v in demand.items())
+
+    def try_acquire(self, demand: Dict[str, float]) -> bool:
+        with self._cond:
+            if all(self._available.get(k, 0.0) >= v - 1e-9
+                   for k, v in demand.items()):
+                for k, v in demand.items():
+                    self._available[k] = self._available.get(k, 0.0) - v
+                return True
+            return False
+
+    def release(self, demand: Dict[str, float]) -> None:
+        with self._cond:
+            for k, v in demand.items():
+                self._available[k] = min(
+                    self._available.get(k, 0.0) + v, self.total.get(k, 0.0))
+            self._cond.notify_all()
+
+    def wait_for_change(self, timeout: float) -> None:
+        with self._cond:
+            self._cond.wait(timeout)
+
+    def notify(self) -> None:
+        with self._cond:
+            self._cond.notify_all()
+
+    def available(self) -> Dict[str, float]:
+        with self._cond:
+            return dict(self._available)
+
+    def add_total(self, extra: Dict[str, float]) -> None:
+        """Grow capacity in place (placement-group bundle resources)."""
+        with self._cond:
+            for k, v in extra.items():
+                self.total[k] = self.total.get(k, 0.0) + v
+                self._available[k] = self._available.get(k, 0.0) + v
+            self._cond.notify_all()
+
+    def remove_total(self, extra: Dict[str, float]) -> None:
+        with self._cond:
+            for k, v in extra.items():
+                self.total[k] = max(self.total.get(k, 0.0) - v, 0.0)
+                self._available[k] = max(self._available.get(k, 0.0) - v, 0.0)
+            self._cond.notify_all()
+
+
+class ActorExecutor:
+    """Executes one actor's tasks: FIFO by seqno, optional concurrency/async.
+
+    Reference: ``core_worker/transport/actor_scheduling_queue.h`` (ordered),
+    ``out_of_order_actor_scheduling_queue.h`` (threaded/async actors), and
+    the fiber-based async path (``core_worker/fiber.h``).
+    """
+
+    def __init__(self, actor_id: ActorID, max_concurrency: int,
+                 run_task: Callable[[TaskSpec, Any], None],
+                 run_task_async: Optional[Callable] = None):
+        self.actor_id = actor_id
+        self.max_concurrency = max(1, max_concurrency)
+        self._run_task = run_task
+        self._run_task_async = run_task_async
+        self.instance: Any = None
+        self.is_async = False
+        self._heap: List = []  # (seqno, spec)
+        self._cond = threading.Condition()
+        self._dead = False
+        self.death_cause: Optional[str] = None
+        self._threads: List[threading.Thread] = []
+        self._loop = None  # asyncio loop for async actors
+        self.num_pending = 0
+
+    def start(self, instance: Any, is_async: bool) -> None:
+        self.instance = instance
+        self.is_async = is_async
+        if is_async:
+            t = threading.Thread(target=self._async_main, daemon=True,
+                                 name=f"actor-{self.actor_id.hex()[:8]}-loop")
+            t.start()
+            self._threads.append(t)
+        else:
+            for i in range(self.max_concurrency):
+                t = threading.Thread(target=self._sync_main, daemon=True,
+                                     name=f"actor-{self.actor_id.hex()[:8]}-{i}")
+                t.start()
+                self._threads.append(t)
+
+    def submit(self, spec: TaskSpec) -> bool:
+        with self._cond:
+            if self._dead:
+                return False
+            heapq.heappush(self._heap, (spec.seqno, spec))
+            self.num_pending += 1
+            self._cond.notify()
+        return True
+
+    def kill(self, cause: str) -> List[TaskSpec]:
+        """Mark dead; return tasks that were still pending."""
+        with self._cond:
+            if self._dead:
+                return []
+            self._dead = True
+            self.death_cause = cause
+            pending = [spec for _, spec in self._heap]
+            self._heap.clear()
+            self.num_pending = 0
+            self._cond.notify_all()
+        if self._loop is not None:
+            try:
+                self._loop.call_soon_threadsafe(self._loop.stop)
+            except RuntimeError:
+                pass
+        return pending
+
+    def _next(self) -> Optional[TaskSpec]:
+        with self._cond:
+            while not self._heap and not self._dead:
+                self._cond.wait()
+            if self._dead:
+                return None
+            _, spec = heapq.heappop(self._heap)
+            self.num_pending -= 1
+            return spec
+
+    def _sync_main(self) -> None:
+        while True:
+            spec = self._next()
+            if spec is None:
+                return
+            self._run_task(spec, self.instance)
+
+    def _async_main(self) -> None:
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+        sem = asyncio.Semaphore(self.max_concurrency)
+
+        async def handle(spec):
+            async with sem:
+                await self._run_task_async(spec, self.instance)
+
+        async def pump():
+            while True:
+                spec = await loop.run_in_executor(None, self._next)
+                if spec is None:
+                    loop.stop()
+                    return
+                loop.create_task(handle(spec))
+
+        loop.create_task(pump())
+        try:
+            loop.run_forever()
+        finally:
+            loop.close()
+
+
+class Node:
+    """One (virtual) node: resources + store + dispatch loop + actors."""
+
+    def __init__(self, node_id: NodeID, resources: Dict[str, float],
+                 labels: Dict[str, str], store: LocalObjectStore,
+                 execute_task: Callable[[TaskSpec, "Node"], None],
+                 max_worker_threads: int = 256):
+        self.node_id = node_id
+        self.ledger = ResourceLedger(resources)
+        self.labels = dict(labels)
+        self.store = store
+        self._execute_task = execute_task
+        self.alive = True
+        self.actors: Dict[ActorID, ActorExecutor] = {}
+        self._actors_lock = threading.Lock()
+        self._queue: "queue.Queue[Optional[TaskSpec]]" = queue.Queue()
+        self._backlog: List[TaskSpec] = []
+        self._running: set = set()
+        self._running_lock = threading.Lock()
+        self._sema = threading.Semaphore(max_worker_threads)
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name=f"dispatch-{node_id.hex()[:8]}")
+        self._dispatcher.start()
+
+    def info(self) -> NodeInfo:
+        return NodeInfo(node_id=self.node_id, alive=self.alive,
+                        resources=dict(self.ledger.total),
+                        labels=dict(self.labels))
+
+    # -- normal task path --------------------------------------------------
+    def enqueue(self, spec: TaskSpec) -> None:
+        self._queue.put(spec)
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            # Move newly queued tasks into the backlog.
+            try:
+                timeout = 0.0 if self._backlog else _DISPATCH_POLL_S
+                while True:
+                    spec = self._queue.get(timeout=timeout)
+                    if spec is None:
+                        return
+                    self._backlog.append(spec)
+                    timeout = 0.0
+            except queue.Empty:
+                pass
+            if not self.alive:
+                self._fail_backlog()
+                continue
+            progressed = False
+            remaining: List[TaskSpec] = []
+            for spec in self._backlog:
+                if self.ledger.try_acquire(spec.resources):
+                    self._launch(spec)
+                    progressed = True
+                else:
+                    remaining.append(spec)
+            self._backlog = remaining
+            if self._backlog and not progressed:
+                self.ledger.wait_for_change(0.05)
+
+    def _launch(self, spec: TaskSpec) -> None:
+        self._sema.acquire()
+        with self._running_lock:
+            self._running.add(spec.task_id)
+
+        def run():
+            try:
+                self._execute_task(spec, self)
+            finally:
+                with self._running_lock:
+                    self._running.discard(spec.task_id)
+                if spec.kind != TaskKind.ACTOR_CREATION:
+                    # Actors hold their resources for their whole lifetime;
+                    # the runtime releases them on actor death.
+                    self.ledger.release(spec.resources)
+                self._sema.release()
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"worker-{spec.task_id.hex()[:8]}").start()
+
+    def _fail_backlog(self) -> None:
+        from ray_tpu._private import worker
+        rt = worker.global_runtime()
+        backlog, self._backlog = self._backlog, []
+        if rt is not None:
+            for spec in backlog:
+                rt.on_node_task_lost(spec, self)
+
+    # -- actor hosting -----------------------------------------------------
+    def host_actor(self, executor: ActorExecutor) -> None:
+        with self._actors_lock:
+            self.actors[executor.actor_id] = executor
+
+    def evict_actor(self, actor_id: ActorID) -> None:
+        with self._actors_lock:
+            self.actors.pop(actor_id, None)
+
+    # -- lifecycle ---------------------------------------------------------
+    def shutdown(self, fail_tasks: bool = True) -> Dict[ActorID, List[TaskSpec]]:
+        """Stop the node; returns per-actor pending tasks for FT handling."""
+        self.alive = False
+        self._queue.put(None)
+        pending_by_actor: Dict[ActorID, List[TaskSpec]] = {}
+        with self._actors_lock:
+            actors = dict(self.actors)
+            self.actors.clear()
+        for aid, ex in actors.items():
+            pending_by_actor[aid] = ex.kill("node died")
+        if fail_tasks:
+            self._fail_backlog()
+        return pending_by_actor

@@ -1,0 +1,163 @@
+"""Distributed reference counting and lineage tracking.
+
+Parity contract (reference ``src/ray/core_worker/reference_count.h`` and
+``task_manager.h``): an object stays alive while any of these hold:
+local Python handles, pending tasks that take it as an argument, or nested
+containment inside another live object. When the count reaches zero the value
+is freed from every store and its lineage entry released. Lineage (the task
+that produced each object) is retained while the object or any downstream
+dependent is alive, enabling reconstruction after node loss
+(``object_recovery_manager.h``).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set
+
+from ray_tpu._private.ids import ObjectID, TaskID
+
+
+@dataclass
+class Reference:
+    local_refs: int = 0
+    submitted_task_refs: int = 0
+    # objects whose serialized payload contains this one (containment pins)
+    contained_in: Set[ObjectID] = field(default_factory=set)
+    contains: Set[ObjectID] = field(default_factory=set)
+    # never collect (e.g. detached-actor state, named objects)
+    pinned: bool = False
+
+    def total(self) -> int:
+        return (self.local_refs + self.submitted_task_refs
+                + len(self.contained_in) + (1 if self.pinned else 0))
+
+
+class ReferenceCounter:
+    def __init__(self, on_zero: Optional[Callable[[ObjectID], None]] = None):
+        self._lock = threading.RLock()
+        self._refs: Dict[ObjectID, Reference] = {}
+        self._on_zero = on_zero
+
+    def set_on_zero(self, cb: Callable[[ObjectID], None]) -> None:
+        self._on_zero = cb
+
+    def _get(self, oid: ObjectID) -> Reference:
+        ref = self._refs.get(oid)
+        if ref is None:
+            ref = self._refs[oid] = Reference()
+        return ref
+
+    # -- local handles -----------------------------------------------------
+    def add_local_ref(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._get(oid).local_refs += 1
+
+    def remove_local_ref(self, oid: ObjectID) -> None:
+        self._dec(oid, "local_refs")
+
+    # -- task argument pins ------------------------------------------------
+    def add_submitted_task_refs(self, oids: List[ObjectID]) -> None:
+        with self._lock:
+            for oid in oids:
+                self._get(oid).submitted_task_refs += 1
+
+    def remove_submitted_task_refs(self, oids: List[ObjectID]) -> None:
+        for oid in oids:
+            self._dec(oid, "submitted_task_refs")
+
+    # -- containment (nested refs inside stored values) --------------------
+    def add_nested_refs(self, outer: ObjectID, inner: List[ObjectID]) -> None:
+        with self._lock:
+            for oid in inner:
+                self._get(oid).contained_in.add(outer)
+                self._get(outer).contains.add(oid)
+
+    # -- pinning -----------------------------------------------------------
+    def pin(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._get(oid).pinned = True
+
+    def unpin(self, oid: ObjectID) -> None:
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref is None or not ref.pinned:
+                return
+            ref.pinned = False
+        self._maybe_free(oid)
+
+    # -- internals ---------------------------------------------------------
+    def _dec(self, oid: ObjectID, attr: str) -> None:
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref is None:
+                return
+            cur = getattr(ref, attr)
+            if cur > 0:
+                setattr(ref, attr, cur - 1)
+        self._maybe_free(oid)
+
+    def _maybe_free(self, oid: ObjectID) -> None:
+        to_free: List[ObjectID] = []
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref is None or ref.total() > 0:
+                return
+            del self._refs[oid]
+            to_free.append(oid)
+            # release containment pins held by this object
+            stack = list(ref.contains)
+            while stack:
+                inner_id = stack.pop()
+                inner = self._refs.get(inner_id)
+                if inner is None:
+                    continue
+                inner.contained_in.discard(oid)
+                if inner.total() == 0:
+                    del self._refs[inner_id]
+                    to_free.append(inner_id)
+                    stack.extend(inner.contains)
+        if self._on_zero is not None:
+            for freed in to_free:
+                try:
+                    self._on_zero(freed)
+                except Exception:
+                    pass
+
+    def ref_count(self, oid: ObjectID) -> int:
+        with self._lock:
+            ref = self._refs.get(oid)
+            return 0 if ref is None else ref.total()
+
+    def num_tracked(self) -> int:
+        with self._lock:
+            return len(self._refs)
+
+
+class LineageTable:
+    """object → producing-task map used for reconstruction after loss."""
+
+    def __init__(self, max_entries: int = 1_000_000):
+        self._lock = threading.Lock()
+        self._producers: Dict[ObjectID, Any] = {}  # oid -> TaskSpec
+        self._max_entries = max_entries
+
+    def record(self, return_ids: List[ObjectID], spec: Any) -> None:
+        with self._lock:
+            if len(self._producers) >= self._max_entries:
+                return  # lineage cap (reference: max_lineage_bytes)
+            for oid in return_ids:
+                self._producers[oid] = spec
+
+    def producer_of(self, oid: ObjectID) -> Optional[Any]:
+        with self._lock:
+            return self._producers.get(oid)
+
+    def release(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._producers.pop(oid, None)
+
+    def num_entries(self) -> int:
+        with self._lock:
+            return len(self._producers)

@@ -1,0 +1,172 @@
+"""Cluster-level scheduling policies.
+
+Parity contract (reference ``src/ray/raylet/scheduling/policy/``): hybrid
+top-k (pack up to a utilization threshold, then spread), SPREAD, node
+affinity (hard/soft), node-label selection, and placement-group bundle
+placement. The two-level split of the reference (cluster pick + local
+dispatch) is preserved: this module only picks a node; admission happens in
+the node's dispatch loop (:mod:`ray_tpu._private.node`).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional
+
+from ray_tpu._private.node import Node
+from ray_tpu._private.task_spec import (
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    TaskSpec,
+)
+
+# Hybrid policy knobs (reference: hybrid_scheduling_policy.h:29-50 —
+# scheduler_spread_threshold, top-k fraction).
+SPREAD_THRESHOLD = 0.5
+TOP_K_FRACTION = 0.2
+
+
+class SchedulingError(Exception):
+    """Task is infeasible: no alive node can ever satisfy it."""
+
+
+class ClusterScheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spread_rr = 0  # round-robin cursor for SPREAD
+
+    def pick_node(self, spec: TaskSpec, nodes: List[Node],
+                  preferred: Optional[Node] = None) -> Optional[Node]:
+        """Choose a node for the task, or None if feasible-but-busy.
+
+        Raises SchedulingError if no node can ever fit the demand.
+        """
+        alive = [n for n in nodes if n.alive]
+        if not alive:
+            raise SchedulingError("no alive nodes in cluster")
+
+        strategy = spec.scheduling_strategy
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            return self._pick_pg(spec, strategy, alive)
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            return self._pick_affinity(spec, strategy, alive)
+        if isinstance(strategy, NodeLabelSchedulingStrategy):
+            alive = self._filter_labels(strategy, alive)
+            if not alive:
+                raise SchedulingError("no node matches label selector")
+            strategy = "DEFAULT"
+
+        feasible = [n for n in alive if n.ledger.can_fit_total(spec.resources)]
+        if not feasible:
+            raise SchedulingError(
+                f"resource demand {spec.resources} is infeasible on every "
+                f"alive node")
+
+        if strategy == "SPREAD":
+            return self._pick_spread(spec, feasible)
+        return self._pick_hybrid(spec, feasible, preferred)
+
+    # -- policies ----------------------------------------------------------
+    def _pick_hybrid(self, spec: TaskSpec, feasible: List[Node],
+                     preferred: Optional[Node]) -> Optional[Node]:
+        """Pack onto low-utilization nodes first; break ties toward preferred
+        (locality) node; randomize among top-k to avoid herding."""
+        scored = []
+        for n in feasible:
+            avail = n.ledger.available()
+            if not all(avail.get(k, 0.0) >= v - 1e-9
+                       for k, v in spec.resources.items()):
+                continue
+            util = self._utilization(n)
+            bias = -0.1 if (preferred is not None
+                            and n.node_id == preferred.node_id) else 0.0
+            scored.append((util + bias, n))
+        if not scored:
+            # All feasible nodes currently busy: queue on the least loaded
+            # (its dispatch loop admits when resources free up). This mirrors
+            # the reference's lease-queuing on the selected raylet.
+            return min(feasible, key=self._utilization)
+        scored.sort(key=lambda t: t[0])
+        if scored[0][0] <= SPREAD_THRESHOLD:
+            k = max(1, int(len(scored) * TOP_K_FRACTION))
+            return random.choice(scored[:k])[1]
+        return scored[0][1]
+
+    def _pick_spread(self, spec: TaskSpec, feasible: List[Node]) -> Node:
+        with self._lock:
+            self._spread_rr += 1
+            start = self._spread_rr
+        # Prefer a currently-available node in round-robin order.
+        order = [feasible[(start + i) % len(feasible)]
+                 for i in range(len(feasible))]
+        for n in order:
+            avail = n.ledger.available()
+            if all(avail.get(k, 0.0) >= v - 1e-9
+                   for k, v in spec.resources.items()):
+                return n
+        return order[0]
+
+    def _pick_affinity(self, spec: TaskSpec,
+                       strategy: NodeAffinitySchedulingStrategy,
+                       alive: List[Node]) -> Node:
+        target = None
+        for n in alive:
+            if n.node_id.hex() == strategy.node_id:
+                target = n
+                break
+        if target is not None and target.ledger.can_fit_total(spec.resources):
+            return target
+        if strategy.soft:
+            return self._pick_hybrid(spec, [
+                n for n in alive if n.ledger.can_fit_total(spec.resources)
+            ] or alive, None)
+        raise SchedulingError(
+            f"node {strategy.node_id[:8]} is dead or cannot fit "
+            f"{spec.resources} (hard affinity)")
+
+    def _filter_labels(self, strategy: NodeLabelSchedulingStrategy,
+                       alive: List[Node]) -> List[Node]:
+        def matches(node: Node, selector: Dict) -> bool:
+            for key, expected in (selector or {}).items():
+                actual = node.labels.get(key)
+                if isinstance(expected, (list, tuple, set)):
+                    if actual not in expected:
+                        return False
+                elif actual != expected:
+                    return False
+            return True
+
+        hard = [n for n in alive if matches(n, strategy.hard)]
+        if strategy.soft:
+            soft = [n for n in hard if matches(n, strategy.soft)]
+            if soft:
+                return soft
+        return hard
+
+    def _pick_pg(self, spec: TaskSpec,
+                 strategy: PlacementGroupSchedulingStrategy,
+                 alive: List[Node]) -> Node:
+        pg = strategy.placement_group
+        if not pg.is_ready():
+            raise SchedulingError(
+                "placement group is not ready (wait on pg.ready() first)")
+        idx = strategy.placement_group_bundle_index
+        candidates = (pg.bundle_nodes() if idx == -1
+                      else [pg.bundle_nodes()[idx]])
+        node_by_id = {n.node_id: n for n in alive}
+        for node_id in candidates:
+            n = node_by_id.get(node_id)
+            if n is not None and n.ledger.can_fit_total(spec.resources):
+                return n
+        raise SchedulingError(
+            "no bundle in the placement group can fit the task")
+
+    @staticmethod
+    def _utilization(node: Node) -> float:
+        total = node.ledger.total
+        avail = node.ledger.available()
+        utils = [1.0 - avail.get(k, 0.0) / v
+                 for k, v in total.items() if v > 0]
+        return max(utils) if utils else 0.0

@@ -1,0 +1,43 @@
+"""Test fixtures.
+
+JAX is forced onto a virtual 8-device CPU platform (the reference's
+"multi-node cluster in one machine" fixture idea, cluster_utils.py:135,
+applied to SPMD: XLA_FLAGS=--xla_force_host_platform_device_count=8).
+Must run before the first jax import in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Boot a 1-node runtime per test (reference: conftest.py:588)."""
+    import ray_tpu
+    rt = ray_tpu.init(num_nodes=1, resources={"CPU": 8})
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Boot a 4-node virtual cluster (reference: conftest.py:678)."""
+    import ray_tpu
+    rt = ray_tpu.init(num_nodes=4, resources={"CPU": 4})
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_runtime():
+    yield
+    import ray_tpu
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()

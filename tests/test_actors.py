@@ -1,0 +1,180 @@
+"""Actor tests (reference: python/ray/tests/test_actor*.py)."""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def inc(self, by=1):
+        self.n += by
+        return self.n
+
+    def read(self):
+        return self.n
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    assert ray_tpu.get(c.inc.remote(5)) == 6
+    assert ray_tpu.get(c.read.remote()) == 6
+
+
+def test_actor_init_args(ray_start_regular):
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.read.remote()) == 100
+
+
+def test_actor_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(50)]
+    # FIFO per-caller ordering: results are 1..50 in submission order.
+    assert ray_tpu.get(refs) == list(range(1, 51))
+
+
+def test_actor_method_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def boom(self):
+            raise ValueError("actor method error")
+
+        def ok(self):
+            return "fine"
+
+    b = Bad.remote()
+    with pytest.raises(ValueError, match="actor method error"):
+        ray_tpu.get(b.boom.remote())
+    # Actor survives method errors.
+    assert ray_tpu.get(b.ok.remote()) == "fine"
+
+
+def test_actor_init_failure(ray_start_regular):
+    @ray_tpu.remote
+    class Broken:
+        def __init__(self):
+            raise RuntimeError("init failed")
+
+        def m(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(b.m.remote(), timeout=10)
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="counter1").remote(7)
+    h = ray_tpu.get_actor("counter1")
+    assert ray_tpu.get(h.read.remote()) == 7
+
+
+def test_named_actor_get_if_exists(ray_start_regular):
+    h1 = Counter.options(name="c", get_if_exists=True).remote(1)
+    h2 = Counter.options(name="c", get_if_exists=True).remote(999)
+    ray_tpu.get(h1.inc.remote())
+    assert ray_tpu.get(h2.read.remote()) == 2  # same actor
+
+
+def test_named_actor_duplicate_raises(ray_start_regular):
+    Counter.options(name="dup").remote()
+    time.sleep(0.2)
+    with pytest.raises(ValueError):
+        Counter.options(name="dup").remote()
+
+
+def test_get_actor_missing(ray_start_regular):
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("nope")
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    ray_tpu.get(c.inc.remote())
+    ray_tpu.kill(c)
+    with pytest.raises((exc.ActorDiedError, exc.ActorError)):
+        ray_tpu.get(c.inc.remote())
+
+
+def test_actor_handle_serialization(ray_start_regular):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def use(handle):
+        return ray_tpu.get(handle.inc.remote(10))
+
+    assert ray_tpu.get(use.remote(c)) == 10
+    assert ray_tpu.get(c.read.remote()) == 10
+
+
+def test_actor_max_concurrency(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Sleeper:
+        def nap(self):
+            time.sleep(0.3)
+            return 1
+
+    s = Sleeper.remote()
+    t0 = time.monotonic()
+    ray_tpu.get([s.nap.remote() for _ in range(4)])
+    elapsed = time.monotonic() - t0
+    assert elapsed < 1.0  # ran concurrently, not 1.2s serial
+
+
+def test_async_actor(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=8)
+    class AsyncActor:
+        async def work(self, i):
+            await asyncio.sleep(0.2)
+            return i * 2
+
+    a = AsyncActor.remote()
+    t0 = time.monotonic()
+    out = ray_tpu.get([a.work.remote(i) for i in range(8)])
+    elapsed = time.monotonic() - t0
+    assert out == [i * 2 for i in range(8)]
+    assert elapsed < 1.0  # concurrent awaits
+
+
+def test_exit_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Quitter:
+        def quit(self):
+            ray_tpu.exit_actor()
+
+        def m(self):
+            return 1
+
+    q = Quitter.remote()
+    ray_tpu.get(q.quit.remote())
+    with pytest.raises((exc.ActorDiedError, exc.ActorError)):
+        ray_tpu.get(q.m.remote())
+
+
+def test_actor_resource_accounting(ray_start_regular):
+    @ray_tpu.remote(num_cpus=4)
+    class Big:
+        def ping(self):
+            return "pong"
+
+    b1 = Big.remote()
+    b2 = Big.remote()
+    assert ray_tpu.get(b1.ping.remote()) == "pong"
+    assert ray_tpu.get(b2.ping.remote()) == "pong"
+    avail = ray_tpu.available_resources()
+    assert avail.get("CPU", 0) == 0  # 2 actors x 4 CPUs on an 8-CPU node
+
+
+def test_detached_actor_namespace(ray_start_regular):
+    Counter.options(name="d1", lifetime="detached",
+                    namespace="other").remote(3)
+    h = ray_tpu.get_actor("d1", namespace="other")
+    assert ray_tpu.get(h.read.remote()) == 3

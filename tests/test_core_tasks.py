@@ -1,0 +1,265 @@
+"""Core task/object API tests (reference: python/ray/tests/test_basic*.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+    ref2 = ray_tpu.put({"a": [1, 2, 3]})
+    assert ray_tpu.get(ref2) == {"a": [1, 2, 3]}
+
+
+def test_put_numpy_zero_copy_readonly(ray_start_regular):
+    arr = np.arange(10)
+    ref = ray_tpu.put(arr)
+    got = ray_tpu.get(ref)
+    np.testing.assert_array_equal(got, arr)
+    with pytest.raises(ValueError):
+        got[0] = 99  # store values are immutable
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_args(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    x = ray_tpu.put(10)
+    y = add.remote(x, 5)
+    z = add.remote(y, y)
+    assert ray_tpu.get(z) == 30
+
+
+def test_task_chain_parallel(ray_start_regular):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(20)]
+    assert ray_tpu.get(refs) == [i * i for i in range(20)]
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagation(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("bad")
+
+    with pytest.raises(ValueError, match="bad"):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_propagates_through_dependency(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise KeyError("first")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(exc.TaskError):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return 1
+
+    with pytest.raises(exc.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.2)
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return 1
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+        return 2
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=5)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_wait_timeout_empty(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    ready, not_ready = ray_tpu.wait([slow.remote()], num_returns=1,
+                                    timeout=0.1)
+    assert ready == []
+    assert len(not_ready) == 1
+
+
+def test_options_override(ray_start_regular):
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return ray_tpu.get_runtime_context().get_assigned_resources()
+
+    res = ray_tpu.get(f.options(num_cpus=2).remote())
+    assert res.get("CPU") == 2
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(1)) == 12
+
+
+def test_nested_refs_in_containers(ray_start_regular):
+    @ray_tpu.remote
+    def make():
+        return 7
+
+    @ray_tpu.remote
+    def consume(refs):
+        return sum(ray_tpu.get(r) for r in refs)
+
+    refs = [make.remote() for _ in range(3)]
+    # Passing refs inside a list does NOT auto-resolve (parity with ray).
+    assert ray_tpu.get(consume.remote(refs)) == 21
+
+
+def test_retry_on_app_error(ray_start_regular):
+    state = {"n": 0}
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert ray_tpu.get(flaky.remote()) == "ok"
+    assert state["n"] == 3
+
+
+def test_retry_exceptions_allowlist(ray_start_regular):
+    state = {"n": 0}
+
+    @ray_tpu.remote(max_retries=5, retry_exceptions=[KeyError])
+    def flaky():
+        state["n"] += 1
+        raise ValueError("not retryable")
+
+    with pytest.raises(ValueError):
+        ray_tpu.get(flaky.remote())
+    assert state["n"] == 1
+
+
+def test_cancel_pending(ray_start_regular):
+    @ray_tpu.remote(num_cpus=8)
+    def hog():
+        time.sleep(3)
+        return 1
+
+    @ray_tpu.remote(num_cpus=8)
+    def queued():
+        return 2
+
+    h = hog.remote()
+    q = queued.remote()  # stuck behind hog
+    time.sleep(0.1)
+    ray_tpu.cancel(q)
+    with pytest.raises(exc.TaskCancelledError):
+        ray_tpu.get(q)
+    assert ray_tpu.get(h) == 1
+
+
+def test_runtime_context(ray_start_regular):
+    @ray_tpu.remote
+    def ctx():
+        c = ray_tpu.get_runtime_context()
+        return c.get_task_id(), c.get_node_id(), c.get_job_id()
+
+    task_id, node_id, job_id = ray_tpu.get(ctx.remote())
+    assert task_id and node_id and job_id
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 8
+
+
+def test_large_object_roundtrip(ray_start_regular):
+    arr = np.random.rand(1000, 1000)  # 8 MB -> node store
+
+    @ray_tpu.remote
+    def identity(x):
+        return x
+
+    got = ray_tpu.get(identity.remote(arr))
+    np.testing.assert_array_equal(got, arr)
+
+
+def test_streaming_generator(ray_start_regular):
+    @ray_tpu.remote
+    def gen(n):
+        for i in range(n):
+            yield i * 10
+
+    out = [ray_tpu.get(ref) for ref in gen.remote(5)]
+    assert out == [0, 10, 20, 30, 40]
+
+
+def test_streaming_generator_early_consumption(ray_start_regular):
+    @ray_tpu.remote
+    def gen():
+        yield "first"
+        time.sleep(3)
+        yield "second"
+
+    it = gen.remote()
+    t0 = time.monotonic()
+    first = ray_tpu.get(next(it))
+    assert first == "first"
+    assert time.monotonic() - t0 < 2.0  # did not wait for task completion
+
+
+def test_generator_error_propagates(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def gen():
+        yield 1
+        raise RuntimeError("mid-stream failure")
+
+    it = gen.remote()
+    assert ray_tpu.get(next(it)) == 1
+    with pytest.raises(Exception, match="mid-stream"):
+        next(it)
+        next(it)

@@ -1,0 +1,161 @@
+"""Fault tolerance: node death, lineage reconstruction, actor restart.
+
+Reference: python/ray/tests/test_reconstruction*.py, test_actor_failures.py,
+cluster fixture cluster_utils.py:135.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu._private import worker as worker_mod
+
+
+def _node_of(rt, ref):
+    """Find which node store holds an object."""
+    for node in rt.nodes():
+        if node.store.contains(ref.id):
+            return node
+    return None
+
+
+def test_object_lost_reconstruction(ray_start_cluster):
+    rt = ray_start_cluster
+
+    @ray_tpu.remote(max_retries=3)
+    def big():
+        import numpy as np
+        return np.ones((1000, 1000))  # 8MB -> node store, not inline
+
+    ref = big.remote()
+    ray_tpu.get(ref)
+    victim = _node_of(rt, ref)
+    assert victim is not None
+    rt.remove_node(victim)
+    # Value was lost with the node; get() must reconstruct via lineage.
+    val = ray_tpu.get(ref, timeout=30)
+    assert val.shape == (1000, 1000)
+    assert rt.stats["objects_reconstructed"] >= 1
+
+
+def test_put_object_lost_is_unrecoverable(ray_start_cluster):
+    rt = ray_start_cluster
+    import numpy as np
+    big_val = np.ones((1000, 1000))
+    ref = rt.put(big_val)  # driver put: no lineage
+    # Force it onto a node store (puts are inline only if small; this is 8MB
+    # but driver puts go to memory store by default — emulate a node-stored
+    # put via a task instead).
+    # put() values live in the owner memory store -> survive node death.
+    victim = rt.nodes()[0]
+    rt.remove_node(victim)
+    assert ray_tpu.get(ref).shape == (1000, 1000)
+
+
+def test_chained_reconstruction(ray_start_cluster):
+    rt = ray_start_cluster
+
+    @ray_tpu.remote(max_retries=5)
+    def step(x):
+        import numpy as np
+        return x + np.ones((600, 600))
+
+    @ray_tpu.remote(max_retries=5)
+    def base():
+        import numpy as np
+        return np.zeros((600, 600))
+
+    a = base.remote()
+    b = step.remote(a)
+    c = step.remote(b)
+    ray_tpu.get(c)
+    # Kill every node that holds any of the intermediate values.
+    for ref in (a, b, c):
+        n = _node_of(rt, ref)
+        if n is not None and n.alive:
+            rt.remove_node(n)
+    assert ray_tpu.get(c, timeout=60)[0][0] == 2.0
+
+
+def test_task_retry_on_node_death(ray_start_cluster):
+    rt = ray_start_cluster
+
+    @ray_tpu.remote(max_retries=3)
+    def slow():
+        time.sleep(1.0)
+        return "done"
+
+    ref = slow.remote()
+    time.sleep(0.2)
+    # Find the node running it and kill it mid-flight.
+    with rt._tasks_lock:
+        inflight = [t for t in rt._tasks.values()
+                    if t.spec.name.endswith("slow")]
+    assert inflight
+    node = rt.get_node(inflight[0].node_id)
+    rt.remove_node(node)
+    assert ray_tpu.get(ref, timeout=30) == "done"
+    assert rt.stats["tasks_retried"] >= 1
+
+
+def test_actor_restart_on_node_death(ray_start_cluster):
+    rt = ray_start_cluster
+
+    @ray_tpu.remote(max_restarts=2, max_task_retries=2)
+    class Stateful:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = Stateful.remote()
+    assert ray_tpu.get(a.inc.remote()) == 1
+    node_hex = ray_tpu.get(a.node.remote())
+    victim = rt.get_node(
+        next(n.node_id for n in rt.nodes() if n.node_id.hex() == node_hex))
+    rt.remove_node(victim)
+    # Actor restarts on another node; state resets (fresh __init__).
+    val = ray_tpu.get(a.inc.remote(), timeout=30)
+    assert val == 1
+    assert rt.stats["actor_restarts"] == 1
+
+
+def test_actor_no_restart_when_limit_zero(ray_start_cluster):
+    rt = ray_start_cluster
+
+    @ray_tpu.remote(max_restarts=0)
+    class Fragile:
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = Fragile.remote()
+    node_hex = ray_tpu.get(a.node.remote())
+    victim = next(n for n in rt.nodes() if n.node_id.hex() == node_hex)
+    rt.remove_node(victim)
+    with pytest.raises((exc.ActorDiedError, exc.ActorError)):
+        ray_tpu.get(a.node.remote(), timeout=10)
+
+
+def test_retries_exhausted_gives_error(ray_start_regular):
+    rt = ray_start_regular
+
+    @ray_tpu.remote(max_retries=0)
+    def big():
+        import numpy as np
+        return np.ones((500, 500))
+
+    ref = big.remote()
+    ray_tpu.get(ref)
+    node = _node_of(rt, ref)
+    if node is None:
+        pytest.skip("value was inlined")
+    rt.remove_node(node)
+    with pytest.raises(exc.ObjectLostError):
+        ray_tpu.get(ref, timeout=10)

@@ -1,0 +1,98 @@
+"""Object store + reference counting tests.
+
+Reference: python/ray/tests/test_object_*.py, test_reference_counting*.py.
+"""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import NodeID, ObjectID
+from ray_tpu._private.object_store import LocalObjectStore
+from ray_tpu.exceptions import OutOfMemoryError
+
+
+def test_refcount_frees_on_del(ray_start_regular):
+    rt = ray_start_regular
+    ref = ray_tpu.put(np.ones((500, 500)))
+    oid = ref.id
+    assert rt.refcounter.ref_count(oid) >= 1
+    del ref
+    gc.collect()
+    time.sleep(0.1)
+    assert rt.refcounter.ref_count(oid) == 0
+    assert not rt.memory_store.contains(oid)
+
+
+def test_refcount_pinned_by_pending_task(ray_start_regular):
+    rt = ray_start_regular
+
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(0.5)
+        return x
+
+    ref = ray_tpu.put(123)
+    oid = ref.id
+    out = slow.remote(ref)
+    del ref
+    gc.collect()
+    # Task argument pin keeps it alive while the task runs.
+    assert ray_tpu.get(out) == 123
+
+
+def test_nested_ref_containment(ray_start_regular):
+    rt = ray_start_regular
+    inner = ray_tpu.put("inner-value")
+    outer = ray_tpu.put([inner])
+    inner_id = inner.id
+    del inner
+    gc.collect()
+    time.sleep(0.05)
+    # Containment pin: inner survives because outer holds it.
+    assert rt.refcounter.ref_count(inner_id) >= 1
+    got = ray_tpu.get(outer)
+    assert ray_tpu.get(got[0]) == "inner-value"
+
+
+def test_store_eviction_spill_roundtrip(tmp_path):
+    store = LocalObjectStore(NodeID.from_random(), capacity_bytes=1_000_000,
+                             spill_dir=str(tmp_path))
+    oids = []
+    for i in range(10):
+        oid = ObjectID.from_random()
+        store.put(oid, np.full((200, 200), i))  # 320KB each
+        oids.append(oid)
+    assert store.stats["spills"] > 0
+    # Every object still readable (restored from disk transparently).
+    for i, oid in enumerate(oids):
+        assert store.get(oid)[0][0] == i
+
+
+def test_store_capacity_error():
+    store = LocalObjectStore(NodeID.from_random(), capacity_bytes=1000)
+    with pytest.raises(OutOfMemoryError):
+        store.put(ObjectID.from_random(), np.ones(10_000))
+
+
+def test_object_immutability(ray_start_regular):
+    arr = np.zeros(5)
+    ref = ray_tpu.put(arr)
+    got = ray_tpu.get(ref)
+    with pytest.raises(ValueError):
+        got[0] = 1
+
+
+def test_shared_value_across_consumers(ray_start_regular):
+    big = np.random.rand(400, 400)
+    ref = ray_tpu.put(big)
+
+    @ray_tpu.remote
+    def reader(x):
+        return float(x.sum())
+
+    outs = ray_tpu.get([reader.remote(ref) for _ in range(4)])
+    assert all(abs(o - big.sum()) < 1e-6 for o in outs)

@@ -1,0 +1,118 @@
+"""Regression tests for code-review findings on the core runtime."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+def test_wait_caps_ready_at_num_returns(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    refs = [f.remote() for _ in range(5)]
+    ray_tpu.get(refs)  # all done
+    ready, not_ready = ray_tpu.wait(refs, num_returns=1)
+    assert len(ready) == 1
+    assert len(not_ready) == 4
+
+
+def test_method_decorator_num_returns(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return "x", "y"
+
+    a = A.remote()
+    r1, r2 = a.pair.remote()
+    assert ray_tpu.get([r1, r2]) == ["x", "y"]
+
+
+def test_max_retries_minus_one_unlimited(ray_start_regular):
+    state = {"n": 0}
+
+    @ray_tpu.remote(max_retries=-1, retry_exceptions=True)
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 6:
+            raise RuntimeError("transient")
+        return "ok"
+
+    assert ray_tpu.get(flaky.remote()) == "ok"
+    assert state["n"] == 6
+
+
+def test_kill_during_init_not_resurrected(ray_start_regular):
+    @ray_tpu.remote
+    class SlowInit:
+        def __init__(self):
+            time.sleep(1.0)
+
+        def ping(self):
+            return "pong"
+
+    a = SlowInit.remote()
+    time.sleep(0.1)
+    ray_tpu.kill(a)
+    with pytest.raises((exc.ActorDiedError, exc.ActorError)):
+        ray_tpu.get(a.ping.remote(), timeout=15)
+    # Resources freed: an 8-CPU task can still run.
+    rt = ray_tpu._private.worker.global_runtime()
+    time.sleep(1.2)  # let __init__ finish and the kill path release
+    avail = ray_tpu.available_resources()
+    assert avail.get("CPU") == 8.0
+
+
+def test_actor_task_replay_on_node_death(ray_start_cluster):
+    rt = ray_start_cluster
+
+    @ray_tpu.remote(max_restarts=1, max_task_retries=2)
+    class Slow:
+        def work(self):
+            time.sleep(1.0)
+            return "done"
+
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = Slow.remote()
+    node_hex = ray_tpu.get(a.node.remote())
+    ref = a.work.remote()  # will be in flight when the node dies
+    time.sleep(0.2)
+    victim = next(n for n in rt.nodes() if n.node_id.hex() == node_hex)
+    rt.remove_node(victim)
+    # Replayed on the restarted incarnation, not crashed on func=None.
+    assert ray_tpu.get(ref, timeout=30) == "done"
+
+
+def test_failed_tasks_do_not_leak(ray_start_regular):
+    rt = ray_tpu._private.worker.global_runtime()
+
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("x")
+
+    refs = [boom.remote() for _ in range(10)]
+    for r in refs:
+        with pytest.raises(ValueError):
+            ray_tpu.get(r)
+    time.sleep(0.1)
+    with rt._tasks_lock:
+        assert len(rt._tasks) == 0
+
+
+def test_generator_retry_keeps_stream_binding(ray_start_cluster):
+    rt = ray_start_cluster
+
+    @ray_tpu.remote(max_retries=2)
+    def gen():
+        for i in range(3):
+            yield i
+
+    it = gen.remote()
+    out = [ray_tpu.get(r) for r in it]
+    assert out == [0, 1, 2]
