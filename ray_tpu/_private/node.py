@@ -192,6 +192,12 @@ class ActorExecutor:
         try:
             loop.run_forever()
         finally:
+            for task in asyncio.all_tasks(loop):
+                task.cancel()
+            # Let cancellations unwind before closing the loop.
+            loop.run_until_complete(
+                asyncio.gather(*asyncio.all_tasks(loop),
+                               return_exceptions=True))
             loop.close()
 
 
@@ -212,6 +218,11 @@ class Node:
         self._actors_lock = threading.Lock()
         self._queue: "queue.Queue[Optional[TaskSpec]]" = queue.Queue()
         self._backlog: List[TaskSpec] = []
+        # Demand of enqueued-but-not-yet-admitted tasks; lets the cluster
+        # scheduler see load before the dispatch loop acquires resources
+        # (reference: ReportWorkerBacklog, node_manager.proto:421).
+        self._pending_demand: Dict[str, float] = {}
+        self._pending_lock = threading.Lock()
         self._running: set = set()
         self._running_lock = threading.Lock()
         self._sema = threading.Semaphore(max_worker_threads)
@@ -227,7 +238,29 @@ class Node:
 
     # -- normal task path --------------------------------------------------
     def enqueue(self, spec: TaskSpec) -> None:
+        with self._pending_lock:
+            for k, v in spec.resources.items():
+                self._pending_demand[k] = self._pending_demand.get(k, 0.0) + v
         self._queue.put(spec)
+
+    def _drop_pending(self, spec: TaskSpec) -> None:
+        with self._pending_lock:
+            for k, v in spec.resources.items():
+                left = max(self._pending_demand.get(k, 0.0) - v, 0.0)
+                if left <= 1e-12:
+                    # Drop zeroed keys: PG-scoped names are unique per group
+                    # and would otherwise accumulate forever.
+                    self._pending_demand.pop(k, None)
+                else:
+                    self._pending_demand[k] = left
+
+    def effective_available(self) -> Dict[str, float]:
+        """Available capacity minus demand already queued here."""
+        avail = self.ledger.available()
+        with self._pending_lock:
+            for k, v in self._pending_demand.items():
+                avail[k] = avail.get(k, 0.0) - v
+        return avail
 
     def _dispatch_loop(self) -> None:
         while True:
@@ -258,6 +291,7 @@ class Node:
                 self.ledger.wait_for_change(0.05)
 
     def _launch(self, spec: TaskSpec) -> None:
+        self._drop_pending(spec)
         self._sema.acquire()
         with self._running_lock:
             self._running.add(spec.task_id)
@@ -281,6 +315,8 @@ class Node:
         from ray_tpu._private import worker
         rt = worker.global_runtime()
         backlog, self._backlog = self._backlog, []
+        for spec in backlog:
+            self._drop_pending(spec)
         if rt is not None:
             for spec in backlog:
                 rt.on_node_task_lost(spec, self)

@@ -75,7 +75,7 @@ class ClusterScheduler:
         (locality) node; randomize among top-k to avoid herding."""
         scored = []
         for n in feasible:
-            avail = n.ledger.available()
+            avail = n.effective_available()
             if not all(avail.get(k, 0.0) >= v - 1e-9
                        for k, v in spec.resources.items()):
                 continue
@@ -102,7 +102,7 @@ class ClusterScheduler:
         order = [feasible[(start + i) % len(feasible)]
                  for i in range(len(feasible))]
         for n in order:
-            avail = n.ledger.available()
+            avail = n.effective_available()
             if all(avail.get(k, 0.0) >= v - 1e-9
                    for k, v in spec.resources.items()):
                 return n
@@ -166,7 +166,7 @@ class ClusterScheduler:
     @staticmethod
     def _utilization(node: Node) -> float:
         total = node.ledger.total
-        avail = node.ledger.available()
+        avail = node.effective_available()
         utils = [1.0 - avail.get(k, 0.0) / v
                  for k, v in total.items() if v > 0]
         return max(utils) if utils else 0.0

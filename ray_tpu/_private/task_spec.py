@@ -155,6 +155,10 @@ class TaskSpec:
     # placement group capture
     placement_group_id: Optional[PlacementGroupID] = None
     bundle_index: int = -1
+    # original (un-scoped) demand, kept so retries can re-match bundles
+    # after resources were rewritten onto bundle-scoped names
+    pg_demand: Optional[Dict[str, float]] = None
+    pg_capture: bool = False  # propagate the PG to child tasks
     # lineage/retry accounting
     attempt_number: int = 0
     # generator backpressure

@@ -172,7 +172,8 @@ class Runtime:
 
         self._generators: Dict[TaskID, GeneratorState] = {}
 
-        self.placement_groups: Dict = {}
+        from ray_tpu.util.placement_group import PlacementGroupManager
+        self.pg_manager = PlacementGroupManager(self)
         self._shutdown = False
         self.stats = {"tasks_submitted": 0, "tasks_finished": 0,
                       "tasks_retried": 0, "objects_reconstructed": 0,
@@ -232,6 +233,7 @@ class Runtime:
                         self.futures.reset(oid)
                         self._lost.add(oid)
         node.store.clear()
+        self.pg_manager.on_node_death(node.node_id)
         # Actors on this node die (and may restart).
         for actor_id, pending in pending_by_actor.items():
             self._handle_actor_death(actor_id, "node died",
@@ -462,12 +464,79 @@ class Runtime:
             if inflight.cancelled:
                 return
             inflight.state = TaskState.QUEUED
+        from ray_tpu._private.task_spec import PlacementGroupSchedulingStrategy
+        if isinstance(spec.scheduling_strategy,
+                      PlacementGroupSchedulingStrategy):
+            self._schedule_into_pg(spec, inflight)
+            return
         try:
             node = self.scheduler.pick_node(spec, self.nodes(),
                                             preferred=self._locality_node(spec))
         except SchedulingError as e:
             self._fail_task(spec, exc.TaskError(e, spec.name))
             return
+        inflight.node_id = node.node_id
+        node.enqueue(spec)
+
+    def _schedule_into_pg(self, spec: TaskSpec,
+                          inflight: _InFlightTask) -> None:
+        """Rewrite the demand onto bundle-scoped resources and enqueue."""
+        strat = spec.scheduling_strategy
+        pg = strat.placement_group
+        if not pg.is_ready():
+            # Queue behind placement; the PG manager sets the event when
+            # placed (or removed/unschedulable).
+            def wait_then_schedule():
+                pg._ready_event.wait()
+                self._schedule_into_pg(spec, inflight)
+            threading.Thread(target=wait_then_schedule, daemon=True).start()
+            return
+        if pg.state != "CREATED":
+            self._fail_task(spec, exc.TaskError(
+                exc.PlacementGroupUnschedulableError(
+                    f"placement group is {pg.state}"), spec.name))
+            return
+        idx = strat.placement_group_bundle_index
+        if idx != -1 and not (0 <= idx < len(pg.bundles)):
+            self._fail_task(spec, exc.TaskError(
+                ValueError(
+                    f"placement_group_bundle_index={idx} out of range for "
+                    f"{len(pg.bundles)} bundles"), spec.name))
+            return
+        # On a retry the spec's resources are already bundle-scoped; match
+        # bundles against the original demand snapshot.
+        if spec.pg_demand is None:
+            spec.pg_demand = dict(spec.resources)
+        demand = spec.pg_demand
+        candidates = (pg.bundles if idx == -1 else [pg.bundles[idx]])
+        chosen = None
+        for bundle in candidates:
+            if all(bundle.resources.get(k, 0.0) >= v - 1e-9
+                   for k, v in demand.items()):
+                node = self.get_node(bundle.node_id)
+                if node is not None and node.alive:
+                    avail = node.ledger.available()
+                    scoped = {f"_pg_{pg.id.hex()[:16]}_{bundle.index}_{k}": v
+                              for k, v in demand.items()}
+                    if chosen is None or all(
+                            avail.get(k, 0.0) >= v - 1e-9
+                            for k, v in scoped.items()):
+                        chosen = (bundle, node, scoped)
+                        if all(avail.get(k, 0.0) >= v - 1e-9
+                               for k, v in scoped.items()):
+                            break
+        if chosen is None:
+            self._fail_task(spec, exc.TaskError(
+                SchedulingError(
+                    f"demand {demand} does not fit any bundle of "
+                    f"the placement group"), spec.name))
+            return
+        bundle, node, scoped = chosen
+        spec.resources = scoped
+        spec.placement_group_id = pg.id
+        spec.bundle_index = bundle.index
+        spec.pg_capture = bool(
+            getattr(strat, "placement_group_capture_child_tasks", False))
         inflight.node_id = node.node_id
         node.enqueue(spec)
 
@@ -509,7 +578,9 @@ class Runtime:
             return
         token = runtime_context._set_context(
             job_id=self.job_id, task_id=spec.task_id, node_id=node.node_id,
-            actor_id=None, resources=spec.resources, task_name=spec.name)
+            actor_id=None, resources=spec.resources, task_name=spec.name,
+            placement_group_id=spec.placement_group_id,
+            pg_capture=spec.pg_capture)
         try:
             result = spec.func(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
@@ -698,7 +769,9 @@ class Runtime:
             return
         token = runtime_context._set_context(
             job_id=self.job_id, task_id=spec.task_id, node_id=node.node_id,
-            actor_id=actor_id, resources=spec.resources, task_name=spec.name)
+            actor_id=actor_id, resources=spec.resources, task_name=spec.name,
+            placement_group_id=spec.placement_group_id,
+            pg_capture=spec.pg_capture)
         try:
             instance = spec.func(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
@@ -832,7 +905,9 @@ class Runtime:
         token = runtime_context._set_context(
             job_id=self.job_id, task_id=spec.task_id, node_id=node.node_id,
             actor_id=spec.actor_id, resources=spec.resources,
-            task_name=spec.name)
+            task_name=spec.name,
+            placement_group_id=spec.placement_group_id,
+            pg_capture=spec.pg_capture)
         try:
             method = getattr(instance, spec.method_name)
             result = method(*args, **kwargs)
@@ -870,7 +945,9 @@ class Runtime:
         token = runtime_context._set_context(
             job_id=self.job_id, task_id=spec.task_id, node_id=node.node_id,
             actor_id=spec.actor_id, resources=spec.resources,
-            task_name=spec.name)
+            task_name=spec.name,
+            placement_group_id=spec.placement_group_id,
+            pg_capture=spec.pg_capture)
         try:
             method = getattr(instance, spec.method_name)
             result = method(*args, **kwargs)
@@ -1033,6 +1110,26 @@ def _find_nested_refs(value: Any, _depth: int = 0) -> List[ObjectRef]:
             out.extend(_find_nested_refs(k, _depth + 1))
             out.extend(_find_nested_refs(v, _depth + 1))
     return out
+
+
+def capture_parent_pg_strategy(strategy):
+    """Inherit the caller's PG when it asked to capture child tasks."""
+    if strategy != "DEFAULT":
+        return strategy
+    ctx = runtime_context._ctx.get()
+    if (ctx is None or not getattr(ctx, "pg_capture", False)
+            or ctx.placement_group_id is None):
+        return strategy
+    rt = global_runtime()
+    if rt is None:
+        return strategy
+    pg = rt.pg_manager.get(ctx.placement_group_id)
+    if pg is None:
+        return strategy
+    from ray_tpu._private.task_spec import PlacementGroupSchedulingStrategy
+    return PlacementGroupSchedulingStrategy(
+        pg, placement_group_bundle_index=-1,
+        placement_group_capture_child_tasks=True)
 
 
 def init_runtime(**kwargs) -> Runtime:

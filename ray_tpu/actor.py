@@ -168,7 +168,8 @@ class ActorClass:
             resources=resources_from_options(options),
             num_returns=1,
             return_ids=[ObjectID.from_random()],
-            scheduling_strategy=options.get("scheduling_strategy", "DEFAULT"),
+            scheduling_strategy=worker.capture_parent_pg_strategy(
+                options.get("scheduling_strategy", "DEFAULT")),
             job_id=rt.job_id,
             actor_id=actor_id,
             max_restarts=options.get("max_restarts", 0),

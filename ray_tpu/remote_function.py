@@ -96,7 +96,8 @@ class RemoteFunction:
             return_ids=[ObjectID.from_random() for _ in range(n_ids)],
             max_retries=options.get("max_retries", 3),
             retry_exceptions=options.get("retry_exceptions", False),
-            scheduling_strategy=options.get("scheduling_strategy", "DEFAULT"),
+            scheduling_strategy=worker.capture_parent_pg_strategy(
+                options.get("scheduling_strategy", "DEFAULT")),
             job_id=rt.job_id,
             backpressure_num_objects=options.get(
                 "_generator_backpressure_num_objects", -1),

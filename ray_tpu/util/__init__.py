@@ -1,0 +1,23 @@
+"""Distributed-computing utilities (reference: python/ray/util)."""
+
+from ray_tpu.util.placement_group import (
+    PlacementGroup,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+__all__ = [
+    "PlacementGroup", "placement_group", "remove_placement_group",
+    "placement_group_table", "ActorPool", "Queue",
+]
+
+
+def __getattr__(name):
+    if name == "ActorPool":
+        from ray_tpu.util.actor_pool import ActorPool
+        return ActorPool
+    if name == "Queue":
+        from ray_tpu.util.queue import Queue
+        return Queue
+    raise AttributeError(name)

@@ -1,0 +1,205 @@
+"""Placement group tests (reference: python/ray/tests/test_placement_group*)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (placement_group, placement_group_table,
+                          remove_placement_group)
+
+
+@ray_tpu.remote
+def where():
+    return ray_tpu.get_runtime_context().get_node_id()
+
+
+def test_pg_create_ready(ray_start_cluster):
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="PACK")
+    assert pg.wait(10)
+    assert pg.is_ready()
+    assert all(n is not None for n in pg.bundle_nodes())
+
+
+def test_pg_ready_ref(ray_start_cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    got = ray_tpu.get(pg.ready(), timeout=10)
+    assert got.is_ready()
+
+
+def test_strict_pack_same_node(ray_start_cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}, {"CPU": 1}],
+                         strategy="STRICT_PACK")
+    assert pg.wait(10)
+    nodes = {n.hex() for n in pg.bundle_nodes()}
+    assert len(nodes) == 1
+
+
+def test_strict_spread_distinct_nodes(ray_start_cluster):
+    pg = placement_group([{"CPU": 1}] * 4, strategy="STRICT_SPREAD")
+    assert pg.wait(10)
+    nodes = {n.hex() for n in pg.bundle_nodes()}
+    assert len(nodes) == 4
+
+
+def test_strict_spread_infeasible_pending(ray_start_cluster):
+    # 5 bundles, only 4 nodes -> cannot place.
+    pg = placement_group([{"CPU": 1}] * 5, strategy="STRICT_SPREAD")
+    assert not pg.wait(1.0)
+    assert pg.state in ("PENDING", "RESCHEDULING")
+
+
+def test_task_into_pg_bundle(ray_start_cluster):
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_SPREAD")
+    assert pg.wait(10)
+    strat = ray_tpu.PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=1)
+    got = ray_tpu.get(where.options(
+        scheduling_strategy=strat, num_cpus=1).remote())
+    assert got == pg.bundle_nodes()[1].hex()
+
+
+def test_pg_bundle_capacity_enforced(ray_start_cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(10)
+    strat = ray_tpu.PlacementGroupSchedulingStrategy(placement_group=pg)
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=strat)
+    def hold():
+        time.sleep(0.4)
+        return time.monotonic()
+
+    t0 = time.monotonic()
+    # Two 1-CPU tasks into a 1-CPU bundle -> must serialize.
+    times = ray_tpu.get([hold.remote(), hold.remote()])
+    assert max(times) - t0 >= 0.75
+
+
+def test_pg_task_demand_exceeding_bundle_fails(ray_start_cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(10)
+    strat = ray_tpu.PlacementGroupSchedulingStrategy(placement_group=pg)
+    with pytest.raises(Exception):
+        ray_tpu.get(where.options(scheduling_strategy=strat,
+                                  num_cpus=4).remote(), timeout=10)
+
+
+def test_actor_in_pg(ray_start_cluster):
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=2, scheduling_strategy=
+                    ray_tpu.PlacementGroupSchedulingStrategy(
+                        placement_group=pg,
+                        placement_group_bundle_index=0))
+    class Pinned:
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = Pinned.remote()
+    assert ray_tpu.get(a.node.remote()) == pg.bundle_nodes()[0].hex()
+
+
+def test_remove_pg_frees_resources(ray_start_cluster):
+    rt = ray_start_cluster
+    before = ray_tpu.available_resources()["CPU"]
+    pg = placement_group([{"CPU": 4}, {"CPU": 4}], strategy="SPREAD")
+    assert pg.wait(10)
+    during = ray_tpu.available_resources()["CPU"]
+    assert during == before - 8
+    remove_placement_group(pg)
+    time.sleep(0.2)
+    after = ray_tpu.available_resources()["CPU"]
+    assert after == before
+
+
+def test_pg_reschedules_after_node_death(ray_start_cluster):
+    rt = ray_start_cluster
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_SPREAD")
+    assert pg.wait(10)
+    victim_id = pg.bundle_nodes()[0]
+    victim = rt.get_node(victim_id)
+    rt.remove_node(victim)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if pg.is_ready() and all(
+                n is not None and n != victim_id
+                for n in pg.bundle_nodes()):
+            break
+        time.sleep(0.1)
+    assert pg.is_ready()
+    assert victim_id not in pg.bundle_nodes()
+
+
+def test_pg_table(ray_start_cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK", name="mypg")
+    pg.wait(10)
+    table = placement_group_table()
+    assert pg.id.hex() in table
+    assert table[pg.id.hex()]["name"] == "mypg"
+    assert table[pg.id.hex()]["state"] == "CREATED"
+
+
+def test_pending_pg_places_when_resources_free(ray_start_cluster):
+    # Fill the cluster, create a PG that can't fit, then free resources.
+    @ray_tpu.remote(num_cpus=4)
+    def hog():
+        time.sleep(1.0)
+
+    hogs = [hog.remote() for _ in range(4)]  # consumes all 16 CPUs
+    time.sleep(0.2)
+    pg = placement_group([{"CPU": 4}], strategy="PACK")
+    assert not pg.wait(0.3)  # can't place yet
+    ray_tpu.get(hogs)
+    assert pg.wait(10)
+
+
+def test_pg_task_retry_after_node_death(ray_start_cluster):
+    """Retry of a PG task re-matches bundles (scoped-resource regression)."""
+    rt = ray_start_cluster
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(10)
+    strat = ray_tpu.PlacementGroupSchedulingStrategy(placement_group=pg)
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=strat, max_retries=3)
+    def slow():
+        time.sleep(1.0)
+        return "ok"
+
+    ref = slow.remote()
+    time.sleep(0.3)
+    victim = rt.get_node(pg.bundle_nodes()[0])
+    rt.remove_node(victim)
+    assert ray_tpu.get(ref, timeout=30) == "ok"
+
+
+def test_capture_child_tasks(ray_start_cluster):
+    pg = placement_group([{"CPU": 3}], strategy="PACK")
+    assert pg.wait(10)
+    strat = ray_tpu.PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_capture_child_tasks=True)
+
+    @ray_tpu.remote(num_cpus=1)
+    def child():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=strat)
+    def parent():
+        from ray_tpu.util.placement_group import get_current_placement_group
+        me = ray_tpu.get_runtime_context().get_node_id()
+        kid = ray_tpu.get(child.remote())
+        return me, kid, get_current_placement_group() is not None
+
+    me, kid, has_pg = ray_tpu.get(parent.remote())
+    assert me == kid  # child captured into the same bundle's node
+    assert has_pg
+
+
+def test_bundle_index_out_of_range(ray_start_cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(10)
+    strat = ray_tpu.PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=5)
+    with pytest.raises(ValueError):
+        ray_tpu.get(where.options(scheduling_strategy=strat).remote(),
+                    timeout=10)

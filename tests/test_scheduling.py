@@ -123,6 +123,6 @@ def test_locality_preference(ray_start_cluster):
             holder = node
             break
     assert holder is not None
-    consumer_nodes = ray_tpu.get([consume.remote(data) for _ in range(8)])
-    # Locality bias: most consumers should land on the holder node.
-    assert Histogram(consumer_nodes)[holder.node_id.hex()] >= 4
+    # Sequential submissions (idle cluster each time): locality bias wins.
+    consumer_nodes = [ray_tpu.get(consume.remote(data)) for _ in range(6)]
+    assert Histogram(consumer_nodes)[holder.node_id.hex()] >= 5

@@ -1,0 +1,65 @@
+"""Utility tests: ActorPool, Queue (reference: ray.util)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Queue
+
+
+@ray_tpu.remote
+class Doubler:
+    def double(self, x):
+        return 2 * x
+
+
+def test_actor_pool_map(ray_start_regular):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(6)))
+    assert out == [0, 2, 4, 6, 8, 10]
+
+
+def test_actor_pool_unordered(ray_start_regular):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = sorted(pool.map_unordered(lambda a, v: a.double.remote(v),
+                                    range(6)))
+    assert out == [0, 2, 4, 6, 8, 10]
+
+
+def test_queue_basic(ray_start_regular):
+    q = Queue(maxsize=4)
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.get() == "a"
+    assert q.get() == "b"
+    assert q.empty()
+
+
+def test_queue_nowait_errors(ray_start_regular):
+    from ray_tpu.util.queue import Empty, Full
+    q = Queue(maxsize=1)
+    q.put_nowait(1)
+    with pytest.raises(Full):
+        q.put_nowait(2)
+    assert q.get_nowait() == 1
+    with pytest.raises(Empty):
+        q.get_nowait()
+
+
+def test_queue_cross_task(ray_start_regular):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(queue, n):
+        for i in range(n):
+            queue.put(i)
+        return "done"
+
+    @ray_tpu.remote
+    def consumer(queue, n):
+        return [queue.get() for _ in range(n)]
+
+    p = producer.remote(q, 5)
+    c = consumer.remote(q, 5)
+    assert ray_tpu.get(c) == [0, 1, 2, 3, 4]
+    assert ray_tpu.get(p) == "done"
