@@ -111,19 +111,44 @@ class GCS:
             return [n for n in self.nodes.values() if n.alive]
 
     # -- actors ------------------------------------------------------------
+    def register_actor_or_get_existing(self, info: ActorInfo):
+        """Atomic get_if_exists: returns existing live ActorID or registers.
+
+        Returns (actor_id, created).
+        """
+        with self._lock:
+            existing_id = self._live_named_actor_locked(info.namespace,
+                                                        info.name)
+            if existing_id is not None:
+                return existing_id, False
+            self._register_actor_locked(info)
+            return info.actor_id, True
+
+    def _live_named_actor_locked(self, namespace: str,
+                                 name: Optional[str]):
+        if not name:
+            return None
+        existing_id = self._named_actors.get((namespace, name))
+        if existing_id is None:
+            return None
+        existing = self.actors.get(existing_id)
+        if existing is not None and existing.state != ActorState.DEAD:
+            return existing_id
+        return None
+
     def register_actor(self, info: ActorInfo) -> None:
         with self._lock:
-            if info.name:
-                key = (info.namespace, info.name)
-                existing_id = self._named_actors.get(key)
-                if existing_id is not None:
-                    existing = self.actors.get(existing_id)
-                    if existing is not None and existing.state != ActorState.DEAD:
-                        raise ValueError(
-                            f"actor name {info.name!r} already taken in "
-                            f"namespace {info.namespace!r}")
-                self._named_actors[key] = info.actor_id
-            self.actors[info.actor_id] = info
+            self._register_actor_locked(info)
+
+    def _register_actor_locked(self, info: ActorInfo) -> None:
+        if info.name:
+            if self._live_named_actor_locked(info.namespace,
+                                             info.name) is not None:
+                raise ValueError(
+                    f"actor name {info.name!r} already taken in "
+                    f"namespace {info.namespace!r}")
+            self._named_actors[(info.namespace, info.name)] = info.actor_id
+        self.actors[info.actor_id] = info
 
     def update_actor_state(self, actor_id: ActorID, state: ActorState,
                            node_id: Optional[NodeID] = None,

@@ -59,6 +59,8 @@ def validate_options(options: Dict[str, Any], for_actor: bool) -> Dict[str, Any]
     if lifetime not in (None, "detached", "non_detached"):
         raise ValueError(f"lifetime must be 'detached'|'non_detached', "
                          f"got {lifetime!r}")
+    if options.get("get_if_exists") and not options.get("name"):
+        raise ValueError("get_if_exists requires a `name` option")
     nr = options.get("num_returns")
     if nr is not None and not (
             (isinstance(nr, int) and nr >= 0) or nr in ("dynamic", "streaming")):

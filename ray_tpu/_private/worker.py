@@ -743,7 +743,8 @@ class Runtime:
     # ------------------------------------------------------------------
     # actors
     # ------------------------------------------------------------------
-    def create_actor(self, spec: TaskSpec) -> ActorID:
+    def create_actor(self, spec: TaskSpec,
+                     get_if_exists: bool = False) -> ActorID:
         actor_id = spec.actor_id
         info = ActorInfo(
             actor_id=actor_id, name=spec.actor_name,
@@ -754,7 +755,12 @@ class Runtime:
             creation_spec=spec,
             class_name=getattr(spec.func, "__name__", "Actor"),
             method_options=dict(spec.method_options))
-        self.gcs.register_actor(info)
+        if get_if_exists and spec.actor_name:
+            actor_id, created = self.gcs.register_actor_or_get_existing(info)
+            if not created:
+                return actor_id
+        else:
+            self.gcs.register_actor(info)
         with self._actor_lock:
             self._actor_pending_tasks[actor_id] = []
         self.submit_task(spec, record_lineage=False)

@@ -150,13 +150,6 @@ class ActorClass:
         rt = worker.global_worker()
         name = options.get("name")
         namespace = options.get("namespace") or rt.namespace
-        if name and options.get("get_if_exists"):
-            existing = rt.gcs.get_named_actor(name, namespace)
-            if existing is not None:
-                info = rt.gcs.get_actor_info(existing)
-                if info is not None and info.state != ActorState.DEAD:
-                    return ActorHandle(existing, info.class_name,
-                                       dict(info.method_options))
         actor_id = ActorID.from_random()
         spec = TaskSpec(
             task_id=TaskID.from_random(),
@@ -182,7 +175,13 @@ class ActorClass:
             label_selector=options.get("label_selector"),
             method_options=dict(self._method_options),
         )
-        rt.create_actor(spec)
+        real_id = rt.create_actor(
+            spec, get_if_exists=bool(options.get("get_if_exists")))
+        if real_id != actor_id:  # got an existing named actor
+            info = rt.gcs.get_actor_info(real_id)
+            return ActorHandle(real_id,
+                               info.class_name if info else "Actor",
+                               dict(info.method_options) if info else None)
         return ActorHandle(actor_id, self._cls.__name__,
                            dict(self._method_options))
 

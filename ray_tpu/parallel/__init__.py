@@ -1,0 +1,33 @@
+"""TPU parallelism layer: topology, meshes, sharding rules, pipelining.
+
+This package is the TPU-native replacement for the reference's parallelism
+plumbing (SURVEY.md §2.3): mesh axes instead of process groups, XLA
+collectives over ICI instead of NCCL, SPMD pipeline scans instead of
+compiled actor DAGs.
+"""
+
+from ray_tpu.parallel.mesh import (
+    DEFAULT_AXIS_ORDER,
+    DEFAULT_RULES,
+    MeshSpec,
+    build_mesh,
+    logical_to_spec,
+    mesh_from_string,
+    named_sharding,
+    replicated,
+    shard_constraint,
+)
+from ray_tpu.parallel.pipeline import pipeline_apply, pipelined
+from ray_tpu.parallel.topology import (
+    SubSlice,
+    TpuTopology,
+    detect_local_topology,
+    parse_topology,
+)
+
+__all__ = [
+    "MeshSpec", "build_mesh", "mesh_from_string", "named_sharding",
+    "logical_to_spec", "shard_constraint", "replicated", "DEFAULT_RULES",
+    "DEFAULT_AXIS_ORDER", "TpuTopology", "SubSlice", "detect_local_topology",
+    "parse_topology", "pipeline_apply", "pipelined",
+]

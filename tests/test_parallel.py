@@ -1,0 +1,205 @@
+"""Mesh / topology / pipeline / collective tests (8 virtual CPU devices)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ray_tpu.parallel import (MeshSpec, TpuTopology, build_mesh,
+                              logical_to_spec, mesh_from_string,
+                              named_sharding, pipelined, shard_constraint)
+
+
+def test_devices_are_virtual_8():
+    assert len(jax.devices()) == 8
+
+
+def test_mesh_spec_auto():
+    spec = MeshSpec.auto(8, tp=2, sp=2)
+    assert spec.dp == 2 and spec.num_devices == 8
+    with pytest.raises(ValueError):
+        MeshSpec.auto(8, tp=3)
+
+
+def test_build_mesh_axes():
+    mesh = build_mesh(MeshSpec.auto(8, tp=2))
+    assert set(mesh.axis_names) == {"pp", "dp", "fsdp", "sp", "tp", "ep"}
+    assert mesh.shape["tp"] == 2
+    assert mesh.shape["dp"] == 4
+
+
+def test_mesh_from_string():
+    mesh = mesh_from_string("dp=2,tp=2,sp=2")
+    assert mesh.shape["sp"] == 2
+
+
+def test_logical_rules():
+    spec = logical_to_spec(("batch", "seq", "embed"))
+    assert spec == P(("dp", "fsdp"), "sp", None)
+    with pytest.raises(KeyError):
+        logical_to_spec(("nonexistent_axis",))
+
+
+def test_sharded_matmul_psum_equivalence():
+    """TP matmul over the mesh matches single-device result."""
+    mesh = build_mesh(MeshSpec.auto(8, tp=2))
+    x = jnp.arange(16 * 32, dtype=jnp.float32).reshape(16, 32) / 100
+    w = jnp.ones((32, 64), jnp.float32) * 0.01
+
+    @jax.jit
+    def f(x, w):
+        x = shard_constraint(x, mesh, "batch", None)
+        w = shard_constraint(w, mesh, None, "mlp")
+        y = x @ w
+        return shard_constraint(y, mesh, "batch", "mlp")
+
+    np.testing.assert_allclose(np.asarray(f(x, w)), np.asarray(x @ w),
+                               rtol=1e-6)
+
+
+def test_pipeline_matches_sequential():
+    """GPipe SPMD schedule == running stages sequentially."""
+    mesh = build_mesh(MeshSpec.auto(8, pp=4))
+    n_stages, num_micro = 4, 8
+    dim = 16
+    key = jax.random.PRNGKey(0)
+    ws = jax.random.normal(key, (n_stages, dim, dim)) * 0.3
+
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w)
+
+    batch = jax.random.normal(jax.random.PRNGKey(1), (32, dim))
+    run = pipelined(stage_fn, mesh, num_microbatches=num_micro)
+    out = jax.jit(run)(ws, batch)
+
+    expected = batch
+    for i in range(n_stages):
+        expected = stage_fn(ws[i], expected)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_grad_flows():
+    mesh = build_mesh(MeshSpec.auto(8, pp=2))
+    n_stages, num_micro, dim = 2, 4, 8
+    ws = jax.random.normal(jax.random.PRNGKey(0), (n_stages, dim, dim)) * 0.3
+
+    def stage_fn(w, x):
+        return jnp.tanh(x @ w)
+
+    batch = jax.random.normal(jax.random.PRNGKey(1), (32, dim))
+    run = pipelined(stage_fn, mesh, num_microbatches=num_micro)
+
+    def loss(ws):
+        return jnp.mean(run(ws, batch) ** 2)
+
+    g = jax.jit(jax.grad(loss))(ws)
+    assert g.shape == ws.shape
+    assert float(jnp.abs(g).sum()) > 0
+
+    def loss_seq(ws):
+        x = batch
+        for i in range(n_stages):
+            x = stage_fn(ws[i], x)
+        return jnp.mean(x ** 2)
+
+    g_seq = jax.grad(loss_seq)(ws)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_seq),
+                               rtol=1e-4, atol=1e-5)
+
+
+# -- topology ---------------------------------------------------------------
+
+def test_topology_basics():
+    topo = TpuTopology("v5p", "4x4x4")
+    assert topo.num_chips == 64
+    assert topo.num_hosts == 16
+    assert topo.chips_per_host == 4
+
+
+def test_topology_subslice_allocation():
+    topo = TpuTopology("v5p", "4x4x4")
+    sub = topo.allocate(8)
+    assert sub is not None and sub.num_chips == 8
+    # cube-like preference: 2x2x2
+    assert sorted(sub.shape) == [2, 2, 2]
+    a = topo.allocate(32)
+    b = topo.allocate(16)
+    assert a is not None and b is not None
+    assert topo.allocate(32) is None  # only 8 chips left
+    topo.free(a)
+    assert topo.allocate(32) is not None
+
+
+def test_topology_v5e_2d():
+    topo = TpuTopology("v5e", "4x4")
+    assert topo.num_chips == 16
+    assert topo.num_hosts == 4
+    sub = topo.allocate(4)
+    assert sub.num_chips == 4
+    with pytest.raises(ValueError):
+        TpuTopology("v5e", "4x4x4")  # wrong dimensionality
+
+
+def test_topology_host_mapping():
+    topo = TpuTopology("v5e", "4x4")
+    hosts = {topo.host_of(c.coords) for c in topo.chips()}
+    assert hosts == {0, 1, 2, 3}
+    sub = topo.allocate(4)  # one host block 2x2
+    assert len(topo.hosts_of_subslice(sub)) == 1
+
+
+# -- host-level collectives -------------------------------------------------
+
+def test_collective_allreduce(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import collective as col
+
+    @ray_tpu.remote
+    class Worker:
+        def __init__(self, rank, world):
+            col.init_collective_group(world, rank, group_name="g1")
+            self.rank = rank
+
+        def allreduce(self):
+            out = col.allreduce(np.full(4, self.rank + 1.0),
+                                group_name="g1")
+            return out
+
+        def gather_bcast(self):
+            gathered = col.allgather(np.array([self.rank]), group_name="g1")
+            bc = col.broadcast(np.array([self.rank * 10]), src_rank=1,
+                               group_name="g1")
+            return gathered, bc
+
+    workers = [Worker.remote(i, 3) for i in range(3)]
+    outs = ray_tpu.get([w.allreduce.remote() for w in workers])
+    for o in outs:
+        np.testing.assert_array_equal(o, np.full(4, 6.0))  # 1+2+3
+    results = ray_tpu.get([w.gather_bcast.remote() for w in workers])
+    gathered, bc = results[0]
+    assert [int(g[0]) for g in gathered] == [0, 1, 2]
+    assert int(bc[0]) == 10
+
+
+def test_collective_send_recv(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.util import collective as col
+
+    @ray_tpu.remote
+    class P2P:
+        def __init__(self, rank):
+            col.init_collective_group(2, rank, group_name="p2p")
+            self.rank = rank
+
+        def run(self):
+            if self.rank == 0:
+                col.send(np.array([42.0]), dst_rank=1, group_name="p2p")
+                return None
+            return col.recv(src_rank=0, group_name="p2p")
+
+    a, b = P2P.remote(0), P2P.remote(1)
+    r0, r1 = ray_tpu.get([a.run.remote(), b.run.remote()])
+    assert int(r1[0]) == 42
