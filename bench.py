@@ -1,0 +1,97 @@
+"""Flagship benchmark: Llama training-step throughput on the local chip(s).
+
+Prints ONE JSON line: tokens/sec/chip on a Llama-family model sized to the
+available memory, plus model FLOPs utilization (MFU) as ``vs_baseline``
+(the reference repo publishes no tok/s numbers — BASELINE.md — so the
+hardware roofline is the honest denominator).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _roofline_flops(device) -> float:
+    """Peak bf16 FLOP/s for known TPU generations (per chip)."""
+    kind = getattr(device, "device_kind", "").lower()
+    table = {
+        "v5e": 394e12, "v5 lite": 394e12, "v5litepod": 394e12,
+        "v5p": 459e12,
+        "v4": 275e12,
+        "v6e": 918e12, "trillium": 918e12,
+        "v3": 123e12,
+        "v2": 45e12,
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 275e12  # conservative default
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel
+    from ray_tpu.train.spmd import make_train_step
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        cfg = LlamaConfig.bench_400m()
+        batch, seq = 8, 2048
+        steps, warmup = 20, 3
+    else:  # CPU smoke path so bench.py always emits a line
+        cfg = LlamaConfig.debug(vocab_size=512, max_seq_len=256)
+        batch, seq = 2, 256
+        steps, warmup = 3, 1
+
+    model = LlamaModel(cfg)
+    ts = make_train_step(model)
+    params, opt_state = ts.init_fn(jax.random.key(0))
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    bt = (tokens, targets)
+
+    for _ in range(warmup):
+        params, opt_state, metrics = ts.step_fn(params, opt_state, bt)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, metrics = ts.step_fn(params, opt_state, bt)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    n_params = cfg.num_params()
+    # MFU convention: 6*N useful FLOPs/token (fwd 2N + bwd 4N); remat
+    # recompute is NOT counted as useful work.
+    mfu = (tokens_per_sec * 6 * n_params / _roofline_flops(dev)
+           if on_tpu else 0.0)
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu, 4),
+        "detail": {
+            "model_params": n_params,
+            "config": "llama_400m" if on_tpu else "debug",
+            "batch": batch, "seq": seq, "steps": steps,
+            "device": getattr(dev, "device_kind", dev.platform),
+            "step_ms": round(dt / steps * 1000, 2),
+            "loss": float(metrics["loss"]),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

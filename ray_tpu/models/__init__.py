@@ -1,0 +1,15 @@
+"""Model families, TPU-first.
+
+Pure-functional JAX models (param pytrees + logical sharding axes — no
+framework lock-in), scan-over-layers for O(1) compile scaling, bfloat16
+matmuls on the MXU, sharding expressed by logical axis names resolved
+against the 6-axis mesh of ``ray_tpu.parallel.mesh``.
+
+Coverage mirrors BASELINE.md target configs: Llama-3 family (flagship),
+GPT-2, MLP (Fashion-MNIST baseline), ViT (ImageNet streaming).
+"""
+
+from ray_tpu.models.llama import LlamaConfig, LlamaModel
+from ray_tpu.models.mlp import MLPConfig, MLPModel
+
+__all__ = ["LlamaConfig", "LlamaModel", "MLPConfig", "MLPModel"]

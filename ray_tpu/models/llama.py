@@ -1,0 +1,236 @@
+"""Llama-3 family, TPU-native.
+
+Reference capability: Ray trains Llama via TorchTrainer+FSDP wrappers
+(`release/train_tests/benchmark/train_benchmark.py`) and serves it via vLLM
+(`python/ray/llm`) — the model itself lives outside the reference tree. Here
+it is in-tree and TPU-first:
+
+- params are a pytree of stacked-layer arrays; the transformer stack is a
+  single ``lax.scan`` (one compiled block regardless of depth);
+- every param/activation carries logical axis names resolved to the 6-axis
+  mesh (dp/fsdp/pp/tp/sp/ep) by ``ray_tpu.parallel.mesh`` rules —
+  Megatron-style TP, ZeRO-style fsdp sharding, ring-attention SP all come
+  from the same annotations;
+- compute dtype bfloat16 (MXU-native), params/optimizer f32;
+- ``remat`` on each layer trades FLOPs for HBM (the standard TPU recipe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14_336
+    max_seq_len: int = 8192
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def num_params(self) -> int:
+        d, f, v = self.dim, self.ffn_dim, self.vocab_size
+        kv = self.n_kv_heads * self.head_dim
+        per_layer = d * d + 2 * d * kv + d * d + 3 * d * f + 2 * d
+        heads = 0 if self.tie_embeddings else v * d
+        return v * d + self.n_layers * per_layer + d + heads
+
+    # -- presets (sizes match the public Llama-3 family) --
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def llama3_1b() -> "LlamaConfig":
+        return LlamaConfig(dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+                           ffn_dim=8192)
+
+    @staticmethod
+    def bench_400m(max_seq_len: int = 2048) -> "LlamaConfig":
+        """~440M params: sized so f32 params+adam+grads fit a 16GB chip."""
+        return LlamaConfig(vocab_size=32_000, dim=1024, n_layers=24,
+                           n_heads=16, n_kv_heads=8, ffn_dim=4096,
+                           max_seq_len=max_seq_len)
+
+    @staticmethod
+    def debug(vocab_size: int = 256, max_seq_len: int = 128) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=vocab_size, dim=64, n_layers=2,
+                           n_heads=4, n_kv_heads=2, ffn_dim=128,
+                           max_seq_len=max_seq_len, remat=False)
+
+
+# Logical axis names per param leaf (see parallel/mesh.py DEFAULT_RULES).
+def param_logical_axes(cfg: LlamaConfig) -> Params:
+    axes = {
+        "embed": ("vocab", "embed_in"),
+        "layers": {
+            "attn_norm": (None, "embed_in"),
+            "wq": (None, "embed_in", "heads", None),
+            "wk": (None, "embed_in", "kv_heads", None),
+            "wv": (None, "embed_in", "kv_heads", None),
+            "wo": (None, "heads", None, "embed_in"),
+            "mlp_norm": (None, "embed_in"),
+            "w_gate": (None, "embed_in", "mlp"),
+            "w_up": (None, "embed_in", "mlp"),
+            "w_down": (None, "mlp", "embed_in"),
+        },
+        "norm_f": ("embed_in",),
+    }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = ("embed_in", "vocab")
+    return axes
+
+
+class LlamaModel:
+    """Functional model: ``init`` makes params, ``apply`` runs the forward.
+
+    ``mesh``/``rules`` (optional) activate sharding constraints on
+    activations and select ring attention when the sp axis is >1.
+    """
+
+    def __init__(self, cfg: LlamaConfig, mesh=None,
+                 rules: Optional[Dict] = None):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.rules = rules
+        self._sp = 1 if mesh is None else mesh.shape.get("sp", 1)
+        self._angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                        theta=cfg.rope_theta)
+
+    # -- init ---------------------------------------------------------------
+    def init(self, rng: jax.Array) -> Params:
+        cfg = self.cfg
+        d, hd = cfg.dim, cfg.head_dim
+        k = iter(jax.random.split(rng, 16))
+
+        def dense(key, shape, fan_in):
+            return (jax.random.normal(key, shape, jnp.float32)
+                    * (fan_in ** -0.5))
+
+        L = cfg.n_layers
+        params: Params = {
+            "embed": dense(next(k), (cfg.vocab_size, d), d),
+            "layers": {
+                "attn_norm": jnp.ones((L, d), jnp.float32),
+                "wq": dense(next(k), (L, d, cfg.n_heads, hd), d),
+                "wk": dense(next(k), (L, d, cfg.n_kv_heads, hd), d),
+                "wv": dense(next(k), (L, d, cfg.n_kv_heads, hd), d),
+                "wo": dense(next(k), (L, cfg.n_heads, hd, d), d),
+                "mlp_norm": jnp.ones((L, d), jnp.float32),
+                "w_gate": dense(next(k), (L, d, cfg.ffn_dim), d),
+                "w_up": dense(next(k), (L, d, cfg.ffn_dim), d),
+                "w_down": dense(next(k), (L, cfg.ffn_dim, d), cfg.ffn_dim),
+            },
+            "norm_f": jnp.ones((d,), jnp.float32),
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = dense(next(k), (d, cfg.vocab_size), d)
+        return params
+
+    # -- sharding helpers ---------------------------------------------------
+    def _constrain(self, x, *names):
+        if self.mesh is None:
+            return x
+        from ray_tpu.parallel.mesh import shard_constraint
+        return shard_constraint(x, self.mesh, *names, rules=self.rules)
+
+    def param_shardings(self):
+        """NamedSharding pytree for params (pass to jit in_shardings)."""
+        from ray_tpu.parallel.mesh import named_sharding
+        axes = param_logical_axes(self.cfg)
+        return jax.tree.map(
+            lambda names: named_sharding(self.mesh, *names,
+                                         rules=self.rules),
+            axes, is_leaf=lambda x: isinstance(x, tuple))
+
+    # -- forward ------------------------------------------------------------
+    def _attention(self, q, k, v, positions):
+        if self._sp > 1:
+            if positions is not None:
+                raise NotImplementedError(
+                    "explicit positions are not supported with sp>1: the "
+                    "ring-attention causal mask assumes contiguous 0..S-1")
+            # Inside pjit the arrays are globally-shaped; shard_map splits
+            # them per-device and runs the ppermute ring over ICI.
+            from ray_tpu.ops.ring_attention import ring_attention_sharded
+            return ring_attention_sharded(q, k, v, self.mesh, causal=True)
+        return attention(q, k, v, causal=True, positions_q=positions,
+                         positions_k=positions)
+
+    def _block(self, x, layer: Params, positions):
+        cfg = self.cfg
+        dt = cfg.dtype
+        h = rms_norm(x, layer["attn_norm"], eps=cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
+        kk = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(dt))
+        vv = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(dt))
+        q = self._constrain(q, "batch", "seq", "heads", None)
+        q = apply_rope(q, self._angles, positions)
+        kk = apply_rope(kk, self._angles, positions)
+        o = self._attention(q, kk, vv, positions)
+        o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
+        x = x + self._constrain(o, "batch", "seq", "embed")
+
+        h = rms_norm(x, layer["mlp_norm"], eps=cfg.norm_eps)
+        gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(dt))
+        up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(dt))
+        ff = jax.nn.silu(gate) * up
+        ff = self._constrain(ff, "batch", "seq", "mlp")
+        down = jnp.einsum("bsf,fd->bsd", ff, layer["w_down"].astype(dt))
+        return x + self._constrain(down, "batch", "seq", "embed")
+
+    def apply(self, params: Params, tokens: jax.Array,
+              positions: Optional[jax.Array] = None) -> jax.Array:
+        """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
+        cfg = self.cfg
+        x = params["embed"].astype(cfg.dtype)[tokens]
+        x = self._constrain(x, "batch", "seq", "embed")
+
+        block = self._block
+        if cfg.remat:
+            block = jax.checkpoint(block, static_argnums=())
+
+        def scan_body(x, layer):
+            return block(x, layer, positions), None
+
+        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        x = rms_norm(x, params["norm_f"], eps=cfg.norm_eps)
+        head = (params["embed"].T if cfg.tie_embeddings
+                else params["lm_head"])
+        logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype))
+        logits = self._constrain(logits, "batch", "seq", "vocab")
+        return logits.astype(jnp.float32)
+
+    def loss(self, params: Params, tokens: jax.Array,
+             targets: jax.Array,
+             mask: Optional[jax.Array] = None) -> jax.Array:
+        """Mean next-token cross-entropy."""
+        logits = self.apply(params, tokens)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None],
+                                   axis=-1).squeeze(-1)
+        if mask is not None:
+            return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+        return jnp.mean(nll)

@@ -1,0 +1,61 @@
+"""MLP classifier — BASELINE.md config 1 (Fashion-MNIST DDP baseline).
+
+The reference trains this via TorchTrainer+gloo over 2 CPU workers
+(`python/ray/train/examples`); here the same capability is a pjit
+data-parallel program over a dp mesh axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: Sequence[int] = (512, 512)
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+
+class MLPModel:
+    def __init__(self, cfg: MLPConfig, mesh=None):
+        self.cfg = cfg
+        self.mesh = mesh
+
+    def init(self, rng: jax.Array) -> Params:
+        dims = [self.cfg.in_dim, *self.cfg.hidden, self.cfg.num_classes]
+        params = []
+        keys = jax.random.split(rng, len(dims) - 1)
+        for k, d_in, d_out in zip(keys, dims[:-1], dims[1:]):
+            params.append({
+                "w": jax.random.normal(k, (d_in, d_out), jnp.float32)
+                * (2.0 / d_in) ** 0.5,
+                "b": jnp.zeros((d_out,), jnp.float32),
+            })
+        return {"layers": params}
+
+    def apply(self, params: Params, x: jax.Array) -> jax.Array:
+        x = x.astype(self.cfg.dtype)
+        layers = params["layers"]
+        for layer in layers[:-1]:
+            x = jax.nn.relu(x @ layer["w"] + layer["b"])
+        last = layers[-1]
+        return x @ last["w"] + last["b"]
+
+    def loss(self, params: Params, x: jax.Array,
+             labels: jax.Array) -> jax.Array:
+        logits = self.apply(params, x)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+    def accuracy(self, params: Params, x: jax.Array,
+                 labels: jax.Array) -> jax.Array:
+        return jnp.mean(jnp.argmax(self.apply(params, x), -1) == labels)

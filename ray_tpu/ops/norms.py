@@ -1,0 +1,36 @@
+"""Normalization ops.
+
+Computed in float32 regardless of input dtype (bf16-safe), cast back on the
+way out — the standard TPU recipe: VPU work stays elementwise and fuses into
+the surrounding matmuls under XLA, so no Pallas kernel is warranted here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, *, eps: float = 1e-5,
+             upcast: bool = True) -> jax.Array:
+    """RMSNorm (Llama-family). weight shape [dim]."""
+    dtype = x.dtype
+    if upcast:
+        x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(x.dtype)).astype(dtype)
+
+
+def layer_norm(x: jax.Array, weight: jax.Array, bias: jax.Array | None = None,
+               *, eps: float = 1e-5) -> jax.Array:
+    """LayerNorm (GPT-2/ViT-family)."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    x = x * weight.astype(x.dtype)
+    if bias is not None:
+        x = x + bias.astype(x.dtype)
+    return x.astype(dtype)

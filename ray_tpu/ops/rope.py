@@ -1,0 +1,45 @@
+"""Rotary position embeddings (RoPE), Llama-3 style with NTK scaling hooks.
+
+Frequencies are precomputed once per model (static shapes keep the table out
+of the jit trace); application is pure elementwise VPU work that XLA fuses
+into the attention projections.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int, *,
+                     theta: float = 500_000.0,
+                     scaling_factor: Optional[float] = None) -> jax.Array:
+    """[max_seq_len, head_dim//2] complex-free cos/sin basis angles."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                           dtype=jnp.float32) / head_dim))
+    pos = jnp.arange(max_seq_len, dtype=jnp.float32)
+    if scaling_factor is not None:
+        pos = pos / scaling_factor
+    return jnp.outer(pos, inv_freq)  # [S, D/2]
+
+
+def apply_rope(x: jax.Array, angles: jax.Array,
+               positions: Optional[jax.Array] = None) -> jax.Array:
+    """Rotate q or k. x: [..., S, H, D]; angles: [max_S, D/2].
+
+    positions: optional [.., S] int32 absolute positions (for sequence-
+    parallel shards and decode steps); defaults to 0..S-1.
+    """
+    seq_len = x.shape[-3]
+    if positions is None:
+        ang = angles[:seq_len]                      # [S, D/2]
+        ang = ang[None, :, None, :]                 # [1, S, 1, D/2]
+    else:
+        ang = angles[positions]                     # [..., S, D/2]
+        ang = ang[..., :, None, :]                  # [..., S, 1, D/2]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

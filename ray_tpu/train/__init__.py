@@ -1,0 +1,12 @@
+"""ray_tpu.train — distributed training orchestration, TPU-native.
+
+Reference: Ray Train (`python/ray/train`, SURVEY.md §2.2) — TorchTrainer /
+worker-group actors / NCCL process groups. Here the unit of distributed
+work is a jitted SPMD program over a named mesh: the worker group exists
+for *host* orchestration (data ingest, checkpoints, elasticity), while
+gradient communication is XLA collectives over ICI, not NCCL.
+"""
+
+from ray_tpu.train.spmd import TrainStep, make_train_step
+
+__all__ = ["TrainStep", "make_train_step"]

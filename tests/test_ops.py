@@ -1,0 +1,161 @@
+"""Ops layer: norms, rope, attention, ring attention (8 virtual devices)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops import (apply_rope, attention, layer_norm, ring_attention,
+                         rms_norm, rope_frequencies)
+from ray_tpu.ops.attention import reference_attention
+from ray_tpu.ops.ring_attention import ring_attention_sharded
+
+
+def test_rms_norm_matches_numpy():
+    x = np.random.default_rng(0).normal(size=(2, 8, 16)).astype(np.float32)
+    w = np.random.default_rng(1).normal(size=(16,)).astype(np.float32)
+    out = rms_norm(jnp.asarray(x), jnp.asarray(w), eps=1e-5)
+    expect = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-5) * w
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_layer_norm_zero_mean_unit_var():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 32)), jnp.float32)
+    out = layer_norm(x, jnp.ones((32,)), jnp.zeros((32,)))
+    np.testing.assert_allclose(np.mean(np.asarray(out), -1), 0, atol=1e-5)
+    np.testing.assert_allclose(np.var(np.asarray(out), -1), 1, atol=1e-3)
+
+
+def test_rope_preserves_norm_and_relative_property():
+    angles = rope_frequencies(8, 64, theta=10_000.0)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(1, 16, 2, 8)),
+                    jnp.float32)
+    out = apply_rope(x, angles)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+    # explicit positions == default positions
+    pos = jnp.arange(16)[None, :]
+    out2 = apply_rope(x, angles, pos)
+    np.testing.assert_allclose(out, out2, rtol=1e-6)
+
+
+def _naive_attention(q, k, v, causal=True):
+    B, S, H, D = q.shape
+    Hkv = k.shape[2]
+    rep = H // Hkv
+    k = np.repeat(k, rep, axis=2)
+    v = np.repeat(v, rep, axis=2)
+    out = np.zeros_like(q)
+    for b in range(B):
+        for h in range(H):
+            s = q[b, :, h] @ k[b, :, h].T / np.sqrt(D)
+            if causal:
+                mask = np.tril(np.ones((S, S), bool))
+                s = np.where(mask, s, -1e30)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            p /= p.sum(-1, keepdims=True)
+            out[b, :, h] = p @ v[b, :, h]
+    return out
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("kv_heads", [4, 2])
+def test_reference_attention_vs_naive(causal, kv_heads):
+    rng = np.random.default_rng(0)
+    q = rng.normal(size=(2, 16, 4, 8)).astype(np.float32)
+    k = rng.normal(size=(2, 16, kv_heads, 8)).astype(np.float32)
+    v = rng.normal(size=(2, 16, kv_heads, 8)).astype(np.float32)
+    out = attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                    causal=causal, use_flash=False)
+    np.testing.assert_allclose(out, _naive_attention(q, k, v, causal),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_attention_grad_finite():
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(1, 8, 2, 4)), jnp.float32)
+
+    def f(q):
+        return attention(q, q, q, causal=True, use_flash=False).sum()
+
+    g = jax.grad(f)(q)
+    assert np.all(np.isfinite(np.asarray(g)))
+
+
+def test_ring_attention_single_axis_matches_reference():
+    """shard_map ring over sp=4 must equal full attention."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("sp",))
+    rng = np.random.default_rng(0)
+    B, S, H, D = 2, 32, 4, 8
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, 2, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, 2, D)), jnp.float32)
+
+    out = ring_attention_sharded(q, k, v, mesh, batch_axes=(), head_axis=None)
+    expect = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_noncausal():
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[:2]).reshape(2)
+    mesh = Mesh(devs, ("sp",))
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(1, 16, 2, 4)), jnp.float32)
+    out = ring_attention_sharded(q, q, q, mesh, causal=False,
+                                 batch_axes=(), head_axis=None)
+    expect = reference_attention(q, q, q, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_gradients_match_reference():
+    """Regression: stop_gradient on the online-softmax max broke grads."""
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("sp",))
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.normal(size=(1, 32, 2, 4)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 32, 2, 4)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 32, 2, 4)), jnp.float32)
+    cot = jnp.asarray(rng.normal(size=(1, 32, 2, 4)), jnp.float32)
+
+    def f_ring(q, k, v):
+        return (ring_attention_sharded(q, k, v, mesh, batch_axes=(),
+                                       head_axis=None) * cot).sum()
+
+    def f_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=True) * cot).sum()
+
+    g_ring = jax.grad(f_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_blockwise_attention_matches_reference_fwd_and_grad():
+    from ray_tpu.ops.attention import blockwise_attention
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(2, 40, 4, 8)), jnp.float32)  # 40 % 16 != 0
+    k = jnp.asarray(rng.normal(size=(2, 40, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 40, 2, 8)), jnp.float32)
+    for causal in (True, False):
+        out = blockwise_attention(q, k, v, causal=causal, block_k=16)
+        expect = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
+
+    def f_blk(q):
+        return blockwise_attention(q, k, v, block_k=16).sum()
+
+    def f_ref(q):
+        return reference_attention(q, k, v).sum()
+
+    np.testing.assert_allclose(np.asarray(jax.grad(f_blk)(q)),
+                               np.asarray(jax.grad(f_ref)(q)),
+                               rtol=1e-4, atol=1e-5)
