@@ -7,6 +7,18 @@ for *host* orchestration (data ingest, checkpoints, elasticity), while
 gradient communication is XLA collectives over ICI, not NCCL.
 """
 
-from ray_tpu.train.spmd import TrainStep, make_train_step
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
+                                  ScalingConfig)
+from ray_tpu.train.session import (get_checkpoint, get_context,
+                                   get_dataset_shard, report)
+from ray_tpu.train.spmd import TrainStep, make_train_step, shard_batch
+from ray_tpu.train.trainer import JaxTrainer, Result
 
-__all__ = ["TrainStep", "make_train_step"]
+__all__ = [
+    "TrainStep", "make_train_step", "shard_batch",
+    "Checkpoint", "CheckpointManager",
+    "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
+    "report", "get_context", "get_checkpoint", "get_dataset_shard",
+    "JaxTrainer", "Result",
+]

@@ -1,0 +1,66 @@
+"""Train/run configuration (reference: `python/ray/air/config.py` —
+ScalingConfig/RunConfig/FailureConfig/CheckpointConfig — re-shaped for
+meshes: scaling is (workers × mesh axes), not just a worker count)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+from ray_tpu.parallel.mesh import MeshSpec
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How much hardware, and how it is meshed.
+
+    num_workers = host-level orchestration workers (one per host in a real
+    pod; N virtual workers in tests). ``mesh`` describes the device mesh the
+    SPMD program runs over — the TPU-native generalization of
+    use_gpu/resources_per_worker.
+    """
+
+    num_workers: int = 1
+    resources_per_worker: Optional[Dict[str, float]] = None
+    use_tpu: bool = False
+    mesh: Optional[MeshSpec] = None
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker:
+            return dict(self.resources_per_worker)
+        res = {"CPU": 1.0}
+        if self.use_tpu:
+            res["TPU"] = 1.0
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """max_failures: <0 = infinite retries (reference air.FailureConfig)."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")
+        name = self.name or "train_run"
+        return os.path.join(base, name)

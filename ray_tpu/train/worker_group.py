@@ -1,0 +1,125 @@
+"""Worker group: gang-scheduled train workers polled for health/results.
+
+Reference: `train/v2/_internal/execution/worker_group/worker_group.py:99`
+(start :236, poll_status :443) — actors in a placement group, each running
+the user train fn on a thread while the controller polls. Here workers are
+``max_concurrency=2`` actors: one lane runs the train fn, the other serves
+``poll()``.
+"""
+
+from __future__ import annotations
+
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.session import TrainContext, _set_context
+from ray_tpu.util.placement_group import (placement_group,
+                                          remove_placement_group)
+
+
+class _TrainWorker:
+    """Actor hosting one rank of the train fn."""
+
+    def __init__(self, rank: int, world_size: int, experiment_name: str):
+        self.rank = rank
+        self.world_size = world_size
+        self.experiment_name = experiment_name
+        self._buffer: List[Dict] = []
+        self._status = "idle"
+        self._error: Optional[str] = None
+
+    def run(self, fn: Callable, config: Optional[Dict],
+            latest_checkpoint=None, dataset_shards=None) -> str:
+        ctx = TrainContext(
+            world_rank=self.rank, world_size=self.world_size,
+            experiment_name=self.experiment_name,
+            latest_checkpoint=latest_checkpoint,
+            dataset_shards=dataset_shards)
+        # Late-bound: poll() swaps self._buffer out, so the callback must
+        # resolve the attribute at call time, not capture the list object.
+        ctx._report_cb = lambda entry: self._buffer.append(entry)
+        _set_context(ctx)
+        self._status = "running"
+        try:
+            import inspect
+            takes_config = bool(inspect.signature(fn).parameters)
+            if takes_config:
+                fn(config if config is not None else {})
+            else:
+                fn()
+            self._status = "finished"
+            return "finished"
+        except StopIteration:
+            self._status = "finished"
+            return "stopped"
+        except Exception:
+            self._status = "failed"
+            self._error = traceback.format_exc()
+            raise
+        finally:
+            _set_context(None)
+
+    def poll(self) -> Dict[str, Any]:
+        drained, self._buffer = self._buffer, []
+        return {"rank": self.rank, "status": self._status,
+                "reports": drained, "error": self._error}
+
+    def ping(self) -> bool:
+        return True
+
+
+class WorkerGroup:
+    def __init__(self, num_workers: int, resources_per_worker: Dict,
+                 placement_strategy: str = "PACK",
+                 experiment_name: str = ""):
+        self.num_workers = num_workers
+        self.experiment_name = experiment_name
+        self.pg = placement_group(
+            [dict(resources_per_worker) for _ in range(num_workers)],
+            strategy=placement_strategy)
+        ray_tpu.get(self.pg.ready())
+        worker_cls = ray_tpu.remote(_TrainWorker)
+        from ray_tpu._private.task_spec import PlacementGroupSchedulingStrategy
+        self.workers = [
+            worker_cls.options(
+                max_concurrency=2,
+                num_cpus=resources_per_worker.get("CPU", 1),
+                resources={k: v for k, v in resources_per_worker.items()
+                           if k != "CPU"},
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=self.pg, placement_group_bundle_index=i),
+            ).remote(i, num_workers, experiment_name)
+            for i in range(num_workers)]
+        ray_tpu.get([w.ping.remote() for w in self.workers])
+
+    def start_run(self, fn: Callable, config: Optional[Dict],
+                  latest_checkpoint=None,
+                  dataset_shards: Optional[List[Dict]] = None):
+        """Kick off the train fn on every rank; returns completion refs."""
+        return [
+            w.run.remote(fn, config, latest_checkpoint,
+                         dataset_shards[i] if dataset_shards else None)
+            for i, w in enumerate(self.workers)]
+
+    def poll(self) -> List[Dict[str, Any]]:
+        out = []
+        for w in self.workers:
+            try:
+                out.append(ray_tpu.get(w.poll.remote(), timeout=30))
+            except Exception as e:
+                out.append({"rank": None, "status": "dead",
+                            "reports": [], "error": repr(e)})
+        return out
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        try:
+            remove_placement_group(self.pg)
+        except Exception:
+            pass
