@@ -39,6 +39,13 @@ class ReferenceCounter:
         self._lock = threading.RLock()
         self._refs: Dict[ObjectID, Reference] = {}
         self._on_zero = on_zero
+        # Per-thread deferral queue: freeing an object can drop values whose
+        # ObjectRef.__del__ re-enters this counter from inside on_zero (and
+        # from inside store/lineage locks). Cascaded decrements are queued
+        # and drained iteratively by the outermost call — no recursion, no
+        # lock re-entry (reference: reference_count.h runs deletions on the
+        # owner's io_service for the same reason).
+        self._tls = threading.local()
 
     def set_on_zero(self, cb: Callable[[ObjectID], None]) -> None:
         self._on_zero = cb
@@ -89,6 +96,20 @@ class ReferenceCounter:
 
     # -- internals ---------------------------------------------------------
     def _dec(self, oid: ObjectID, attr: str) -> None:
+        pending = getattr(self._tls, "pending", None)
+        if pending is not None:     # nested call: defer to outermost frame
+            pending.append((oid, attr))
+            return
+        self._tls.pending = pending = []
+        try:
+            self._dec_now(oid, attr)
+            while pending:
+                nxt_oid, nxt_attr = pending.pop(0)
+                self._dec_now(nxt_oid, nxt_attr)
+        finally:
+            self._tls.pending = None
+
+    def _dec_now(self, oid: ObjectID, attr: str) -> None:
         with self._lock:
             ref = self._refs.get(oid)
             if ref is None:
@@ -156,7 +177,10 @@ class LineageTable:
 
     def release(self, oid: ObjectID) -> None:
         with self._lock:
-            self._producers.pop(oid, None)
+            spec = self._producers.pop(oid, None)
+        # The spec's arg ObjectRefs are dropped OUTSIDE the lock: their
+        # __del__ can cascade back into refcounting/lineage.
+        del spec
 
     def num_entries(self) -> int:
         with self._lock:

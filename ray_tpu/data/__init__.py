@@ -1,0 +1,24 @@
+"""ray_tpu.data — streaming distributed datasets.
+
+Reference: Ray Data (`python/ray/data`, SURVEY.md §2.2, §3.6): lazy
+Dataset → logical plan → rule optimizer → physical operators →
+streaming executor with backpressure; Arrow blocks in the object store.
+TPU-native extension: ``DataIterator.to_jax`` double-buffers batches into
+HBM (device_put overlap), the ingest path of BASELINE.md config 4.
+"""
+
+from ray_tpu.data.aggregate import Count, Max, Mean, Min, Std, Sum
+from ray_tpu.data.dataset import Dataset, GroupedData
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
+                                   from_pandas, range, read_binary_files,
+                                   read_csv, read_json, read_parquet,
+                                   read_text)
+
+__all__ = [
+    "Dataset", "GroupedData", "DataIterator",
+    "range", "from_items", "from_numpy", "from_pandas", "from_arrow",
+    "read_parquet", "read_csv", "read_json", "read_text",
+    "read_binary_files",
+    "Count", "Sum", "Min", "Max", "Mean", "Std",
+]

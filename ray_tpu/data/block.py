@@ -1,0 +1,117 @@
+"""Blocks: the unit of distributed data.
+
+Reference: `python/ray/data/block.py:51` — a Block is an Arrow table (or
+pandas) stored in the object store; BlockAccessor adapts formats. Here the
+canonical block is a ``pyarrow.Table``; batches convert to numpy dicts /
+pandas / arrow on demand. TPU relevance: numpy-dict batches feed
+``jax.device_put`` zero-copy (arrow→numpy is zero-copy for primitive
+types).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+Row = Dict[str, Any]
+Batch = Union[Dict[str, np.ndarray], "pa.Table", Any]
+
+
+def block_from_rows(rows: List[Row]) -> Block:
+    if not rows:
+        return pa.table({})
+    if not isinstance(rows[0], dict):
+        rows = [{"item": r} for r in rows]
+    cols: Dict[str, List] = {k: [] for k in rows[0]}
+    for r in rows:
+        for k in cols:
+            cols[k].append(r.get(k))
+    return pa.table({k: pa.array(v) for k, v in cols.items()})
+
+
+def block_from_batch(batch: Batch) -> Block:
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, dict):
+        return pa.table({k: pa.array(np.asarray(v).tolist())
+                         if np.asarray(v).ndim > 1 else pa.array(v)
+                         for k, v in batch.items()})
+    try:
+        import pandas as pd
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:
+        pass
+    raise TypeError(f"cannot convert {type(batch)} to a block")
+
+
+class BlockAccessor:
+    """Format adapter over a block (reference: BlockAccessor.for_block)."""
+
+    def __init__(self, block: Block):
+        self.block = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    def num_rows(self) -> int:
+        return self.block.num_rows
+
+    def size_bytes(self) -> int:
+        return self.block.nbytes
+
+    def schema(self):
+        return self.block.schema
+
+    def to_rows(self) -> List[Row]:
+        return self.block.to_pylist()
+
+    def to_batch(self, batch_format: str = "numpy") -> Batch:
+        if batch_format in ("numpy", "dict"):
+            out: Dict[str, np.ndarray] = {}
+            for name in self.block.column_names:
+                col = self.block.column(name)
+                try:
+                    out[name] = col.to_numpy(zero_copy_only=False)
+                except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                    out[name] = np.asarray(col.to_pylist(), dtype=object)
+                if out[name].dtype == object and len(out[name]) and \
+                        isinstance(out[name][0], (list, np.ndarray)):
+                    try:
+                        out[name] = np.stack(
+                            [np.asarray(x) for x in out[name]])
+                    except ValueError:
+                        pass  # ragged: keep object array
+            return out
+        if batch_format in ("pyarrow", "arrow"):
+            return self.block
+        if batch_format == "pandas":
+            return self.block.to_pandas()
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    def slice(self, start: int, end: int) -> Block:
+        return self.block.slice(start, end - start)
+
+    def take_rows(self, indices: np.ndarray) -> Block:
+        return self.block.take(pa.array(indices))
+
+
+def concat_blocks(blocks: Iterable[Block]) -> Block:
+    blocks = [b for b in blocks if b.num_rows > 0]
+    if not blocks:
+        return pa.table({})
+    return pa.concat_tables(blocks, promote_options="default")
+
+
+def split_block(block: Block, num_splits: int) -> List[Block]:
+    n = block.num_rows
+    out = []
+    for i in range(num_splits):
+        lo = i * n // num_splits
+        hi = (i + 1) * n // num_splits
+        out.append(block.slice(lo, hi - lo))
+    return out

@@ -1,0 +1,436 @@
+"""Streaming execution: physical operators over ray_tpu tasks/actors.
+
+Reference: `data/_internal/execution/streaming_executor.py:52,99,271,325`
+(scheduling loop, `select_operator_to_run` hot loop
+`streaming_executor_state.py:643`, backpressure policies, actor-pool map
+operator). The shape is preserved — pull-based streaming topology with
+per-operator in-flight caps and bounded output queues — on ray_tpu tasks;
+all-to-all ops (shuffle/sort/repartition/aggregate) are materialization
+barriers exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (Block, BlockAccessor, block_from_batch,
+                                block_from_rows, concat_blocks, split_block)
+from ray_tpu.data import logical as L
+
+DEFAULT_MAX_IN_FLIGHT = 8       # concurrent tasks per operator
+DEFAULT_MAX_OUT_QUEUE = 16      # blocks buffered between operators
+
+
+# ---------------------------------------------------------------------------
+# Block transform payloads (run inside remote tasks; must be picklable)
+# ---------------------------------------------------------------------------
+
+def _apply_stage(block: Block, stage) -> Block:
+    acc = BlockAccessor(block)
+    if isinstance(stage, L.MapBatches):
+        batch = acc.to_batch(stage.batch_format)
+        out = stage.fn(batch)
+        return block_from_batch(out)
+    if isinstance(stage, L.MapRows):
+        rows = acc.to_rows()
+        if stage.kind == "map":
+            return block_from_rows([stage.fn(r) for r in rows])
+        if stage.kind == "filter":
+            return block_from_rows([r for r in rows if stage.fn(r)])
+        if stage.kind == "flat_map":
+            return block_from_rows(
+                [o for r in rows for o in stage.fn(r)])
+    raise TypeError(f"unknown stage {stage!r}")
+
+
+def _map_block_task(block: Block, stages) -> Block:
+    for stage in stages:
+        block = _apply_stage(block, stage)
+    return block
+
+
+def _read_task(read_fn: Callable) -> Block:
+    return read_fn()
+
+
+class _MapWorker:
+    """Actor for stateful (fn_constructor) map_batches."""
+
+    def __init__(self, ctor, batch_format: str):
+        self.fn = ctor()
+        self.batch_format = batch_format
+
+    def apply(self, block: Block) -> Block:
+        batch = BlockAccessor(block).to_batch(self.batch_format)
+        return block_from_batch(self.fn(batch))
+
+
+# ---------------------------------------------------------------------------
+# Physical operators
+# ---------------------------------------------------------------------------
+
+class PhysicalOperator:
+    """One stage of the streaming topology."""
+
+    def __init__(self, name: str, max_in_flight: int = DEFAULT_MAX_IN_FLIGHT):
+        self.name = name
+        self.inqueue: collections.deque = collections.deque()
+        self.outqueue: collections.deque = collections.deque()
+        self.active: Dict[Any, bool] = {}   # ref -> True
+        self.max_in_flight = max_in_flight
+        self.inputs_done = False
+        self.downstream: Optional[PhysicalOperator] = None
+
+    # -- scheduling hooks --
+    def can_launch(self, max_out: int) -> bool:
+        return (bool(self.inqueue) and len(self.active) < self.max_in_flight
+                and len(self.outqueue) + len(self.active) < max_out)
+
+    def launch(self) -> None:
+        raise NotImplementedError
+
+    def on_task_done(self, ref, error: Optional[Exception]) -> None:
+        self.active.pop(ref, None)
+        if error is not None:
+            raise error
+        self.outqueue.append(ref)
+
+    def done(self) -> bool:
+        return (self.inputs_done and not self.inqueue and not self.active)
+
+    def shutdown(self) -> None:
+        pass
+
+
+class SourceOperator(PhysicalOperator):
+    """Feeds read tasks / pre-materialized refs."""
+
+    def __init__(self, name: str, read_fns: List[Callable] = None,
+                 refs: List[Any] = None, owner=None):
+        super().__init__(name)
+        self._read_fns = list(read_fns or [])
+        self._refs = list(refs or [])
+        self.inqueue.extend(range(len(self._read_fns)) if self._read_fns
+                            else [])
+        if not self._read_fns:
+            self.outqueue.extend(self._refs)
+        self.inputs_done = True
+        self._task = ray_tpu.remote(_read_task)
+
+    def can_launch(self, max_out: int) -> bool:
+        return (bool(self.inqueue) and len(self.active) < self.max_in_flight
+                and len(self.outqueue) + len(self.active) < max_out)
+
+    def launch(self) -> None:
+        idx = self.inqueue.popleft()
+        ref = self._task.remote(self._read_fns[idx])
+        self.active[ref] = True
+
+
+class MapOperator(PhysicalOperator):
+    def __init__(self, name: str, stages: List[L.LogicalOp]):
+        super().__init__(name)
+        self.stages = stages
+        self._task = ray_tpu.remote(_map_block_task)
+
+    def launch(self) -> None:
+        block_ref = self.inqueue.popleft()
+        ref = self._task.remote(block_ref, self.stages)
+        self.active[ref] = True
+
+
+class ActorPoolMapOperator(PhysicalOperator):
+    """Stateful map over a pool of actors (reference:
+    `execution/operators/actor_pool_map_operator.py`)."""
+
+    def __init__(self, name: str, op: L.MapBatches):
+        size = (op.concurrency[1] if op.concurrency else 2)
+        super().__init__(name, max_in_flight=size)
+        worker_cls = ray_tpu.remote(_MapWorker)
+        self.workers = [worker_cls.remote(op.fn_constructor, op.batch_format)
+                        for _ in range(size)]
+        self._next = 0
+        self._ref_worker: Dict[Any, int] = {}
+
+    def launch(self) -> None:
+        block_ref = self.inqueue.popleft()
+        w = self._next % len(self.workers)
+        self._next += 1
+        ref = self.workers[w].apply.remote(block_ref)
+        self.active[ref] = True
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+
+
+class LimitOperator(PhysicalOperator):
+    """Streaming limit: slices blocks until the budget is spent."""
+
+    def __init__(self, limit: int):
+        super().__init__(f"limit={limit}", max_in_flight=1)
+        self.remaining = limit
+
+    def can_launch(self, max_out: int) -> bool:
+        return bool(self.inqueue)
+
+    def launch(self) -> None:
+        ref = self.inqueue.popleft()
+        if self.remaining <= 0:
+            return
+        block = ray_tpu.get(ref)
+        n = block.num_rows
+        if n <= self.remaining:
+            self.remaining -= n
+            self.outqueue.append(ray_tpu.put(block))
+        else:
+            self.outqueue.append(
+                ray_tpu.put(block.slice(0, self.remaining)))
+            self.remaining = 0
+
+    def done(self) -> bool:
+        return super().done() or self.remaining <= 0
+
+
+# ---------------------------------------------------------------------------
+# All-to-all barriers (materializing)
+# ---------------------------------------------------------------------------
+
+def _split_task(block: Block, n: int):
+    out = split_block(block, n)
+    return out if n > 1 else out[0]
+
+
+def _concat_task(*blocks: Block) -> Block:
+    return concat_blocks(blocks)
+
+
+def _sort_block_task(block: Block, key: str, descending: bool) -> Block:
+    return block.sort_by([(key, "descending" if descending
+                           else "ascending")])
+
+
+def _range_partition_task(block: Block, key: str, bounds: List,
+                          descending: bool) -> List[Block]:
+    col = block.column(key).to_numpy(zero_copy_only=False)
+    idx = np.searchsorted(np.asarray(bounds), col, side="right")
+    out = [block.take(np.nonzero(idx == p)[0])
+           for p in range(len(bounds) + 1)]
+    return out if len(out) > 1 else out[0]
+
+
+def _hash_partition_task(block: Block, key: str, n: int) -> List[Block]:
+    col = block.column(key).to_numpy(zero_copy_only=False)
+    h = np.asarray([hash(x) % n for x in col], np.int64)
+    out = [block.take(np.nonzero(h == p)[0]) for p in range(n)]
+    return out if n > 1 else out[0]
+
+
+def _perm_partition_task(block: Block, n: int, seed) -> List[Block]:
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, n, block.num_rows)
+    out = [block.take(np.nonzero(idx == p)[0]) for p in range(n)]
+    return out if n > 1 else out[0]
+
+
+def _shuffle_reduce_task(seed, part_idx, *blocks: Block) -> Block:
+    block = concat_blocks(blocks)
+    rng = np.random.default_rng(None if seed is None else seed + part_idx)
+    return block.take(rng.permutation(block.num_rows))
+
+
+def run_all_to_all(op: L.AllToAll, block_refs: List[Any]) -> List[Any]:
+    """Execute a materializing all-to-all over already-computed blocks."""
+    if not block_refs:
+        return []
+    n_out = op.num_outputs or len(block_refs)
+    n_out = max(1, n_out)
+    split = ray_tpu.remote(_split_task)
+    concat = ray_tpu.remote(_concat_task)
+
+    if op.kind == "repartition":
+        parts = [split.options(num_returns=n_out).remote(r, n_out)
+                 for r in block_refs]
+        parts = [p if isinstance(p, list) else [p] for p in parts]
+        return [concat.remote(*[parts[i][j] for i in range(len(parts))])
+                for j in range(n_out)]
+
+    if op.kind == "shuffle":
+        perm = ray_tpu.remote(_perm_partition_task)
+        reduce = ray_tpu.remote(_shuffle_reduce_task)
+        parts = [perm.options(num_returns=n_out).remote(r, n_out, op.seed)
+                 for r in block_refs]
+        parts = [p if isinstance(p, list) else [p] for p in parts]
+        return [reduce.remote(op.seed, j,
+                              *[parts[i][j] for i in range(len(parts))])
+                for j in range(n_out)]
+
+    if op.kind == "sort":
+        # Sample → pick boundaries → range partition → per-partition sort.
+        blocks = ray_tpu.get(list(block_refs))
+        col = np.concatenate([
+            b.column(op.key).to_numpy(zero_copy_only=False)
+            for b in blocks if b.num_rows > 0])
+        if col.size == 0:
+            return block_refs
+        quantiles = np.linspace(0, 1, n_out + 1)[1:-1]
+        bounds = list(np.quantile(col, quantiles, method="nearest"))
+        rp = ray_tpu.remote(_range_partition_task)
+        sb = ray_tpu.remote(_sort_block_task)
+        nparts = len(bounds) + 1
+        parts = [rp.options(num_returns=nparts).remote(
+            r, op.key, bounds, op.descending) for r in block_refs]
+        parts = [p if isinstance(p, list) else [p] for p in parts]
+        out = []
+        order = (range(nparts - 1, -1, -1) if op.descending
+                 else range(nparts))
+        for j in order:
+            merged = concat.remote(*[parts[i][j] for i in range(len(parts))])
+            out.append(sb.remote(merged, op.key, op.descending))
+        return out
+
+    raise ValueError(f"unknown all-to-all kind {op.kind!r}")
+
+
+def _agg_partition_task(key, aggs, map_groups_fn, batch_format,
+                        *blocks: Block) -> Block:
+    """Reduce one hash partition: group rows by key, apply aggs/fn."""
+    block = concat_blocks(blocks)
+    if block.num_rows == 0:
+        return block
+    if key is None:
+        groups = {None: block}
+    else:
+        col = block.column(key).to_numpy(zero_copy_only=False)
+        groups = {}
+        for val in np.unique(col):
+            groups[val] = block.take(np.nonzero(col == val)[0])
+    rows = []
+    for val, sub in sorted(groups.items(),
+                           key=lambda kv: (kv[0] is None, kv[0])):
+        if map_groups_fn is not None:
+            out = map_groups_fn(
+                BlockAccessor(sub).to_batch(batch_format))
+            rows.extend(block_from_batch(out).to_pylist())
+            continue
+        row = {} if key is None else {key: val}
+        for agg in aggs:
+            row[agg.name] = agg.compute(sub)
+        rows.append(row)
+    return block_from_rows(rows)
+
+
+def run_aggregate(op: L.Aggregate, block_refs: List[Any],
+                  num_partitions: Optional[int] = None) -> List[Any]:
+    """Hash-shuffle aggregation (reference: SURVEY.md §8.7 —
+    `hash_shuffle.py` partition/streams → stateful aggregators)."""
+    if not block_refs:
+        return []
+    if op.key is None:
+        nparts = 1
+        parts = [[r] for r in block_refs]
+        agg = ray_tpu.remote(_agg_partition_task)
+        return [agg.remote(None, op.aggs, op.map_groups_fn, op.batch_format,
+                           *block_refs)]
+    nparts = num_partitions or min(len(block_refs), 8)
+    hp = ray_tpu.remote(_hash_partition_task)
+    agg = ray_tpu.remote(_agg_partition_task)
+    parts = [hp.options(num_returns=nparts).remote(r, op.key, nparts)
+             for r in block_refs]
+    parts = [p if isinstance(p, list) else [p] for p in parts]
+    return [agg.remote(op.key, op.aggs, op.map_groups_fn, op.batch_format,
+                       *[parts[i][j] for i in range(len(parts))])
+            for j in range(nparts)]
+
+
+# ---------------------------------------------------------------------------
+# Streaming executor
+# ---------------------------------------------------------------------------
+
+class StreamingExecutor:
+    """Drives a linear operator topology; yields output block refs as they
+    become available (true streaming: a downstream consumer sees block 0
+    while upstream still reads block N)."""
+
+    def __init__(self, operators: List[PhysicalOperator],
+                 max_out_queue: int = DEFAULT_MAX_OUT_QUEUE):
+        self.ops = operators
+        self.max_out_queue = max_out_queue
+        for a, b in zip(operators[:-1], operators[1:]):
+            a.downstream = b
+
+    def execute(self) -> Iterator[Any]:
+        ops = self.ops
+        sink = ops[-1]
+        try:
+            while True:
+                # route outputs downstream
+                for op in ops[:-1]:
+                    while op.outqueue:
+                        op.downstream.inqueue.append(op.outqueue.popleft())
+                    if op.done():
+                        op.downstream.inputs_done = True
+                # yield whatever reached the sink
+                while sink.outqueue:
+                    yield sink.outqueue.popleft()
+                if all(op.done() for op in ops):
+                    break
+                # launch work: prefer operators furthest downstream
+                # (select_operator_to_run heuristic — drain before read)
+                launched = False
+                for op in reversed(ops):
+                    while op.can_launch(self.max_out_queue):
+                        op.launch()
+                        launched = True
+                # poll in-flight tasks
+                in_flight = [r for op in ops for r in op.active]
+                if in_flight:
+                    done, _ = ray_tpu.wait(
+                        in_flight, num_returns=1, timeout=0.5)
+                    for ref in done:
+                        owner = next(o for o in ops if ref in o.active)
+                        try:
+                            ray_tpu.get(ref)
+                            owner.on_task_done(ref, None)
+                        except Exception as e:
+                            owner.active.pop(ref, None)
+                            raise
+                elif not launched and not any(
+                        op.outqueue for op in ops[:-1]):
+                    # nothing running, nothing to move: avoid spin
+                    if all(op.done() for op in ops):
+                        break
+            while sink.outqueue:
+                yield sink.outqueue.popleft()
+        finally:
+            for op in ops:
+                op.shutdown()
+
+
+def plan_chain(chain: List[L.LogicalOp]) -> List[PhysicalOperator]:
+    """Lower a logical chain to physical operators."""
+    phys: List[PhysicalOperator] = []
+    for op in chain:
+        if isinstance(op, L.InputData):
+            phys.append(SourceOperator("input", refs=op.block_refs))
+        elif isinstance(op, L.Read):
+            phys.append(SourceOperator("read", read_fns=op.read_tasks))
+        elif isinstance(op, L.FusedMap):
+            phys.append(MapOperator(op.name, op.stages))
+        elif isinstance(op, L.MapBatches) and op.fn_constructor is not None:
+            phys.append(ActorPoolMapOperator(op.name, op))
+        elif isinstance(op, (L.MapBatches, L.MapRows)):
+            phys.append(MapOperator(op.name, [op]))
+        elif isinstance(op, L.Limit):
+            phys.append(LimitOperator(op.limit))
+        else:
+            raise TypeError(f"cannot stream {op!r}; requires materialization")
+    return phys

@@ -1,0 +1,146 @@
+"""Data iteration: batches with prefetch and HBM double-buffering.
+
+Reference: `python/ray/data/iterator.py:106` (iter_batches with
+prefetch_batches, formats, local shuffle). TPU-native addition
+(BASELINE.md config 4): ``to_jax`` overlaps host→HBM transfer of batch
+N+1 with compute on batch N via ``jax.device_put`` double-buffering.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import Block, BlockAccessor, concat_blocks
+
+
+def _batches_from_blocks(block_iter: Iterator[Block], batch_size:
+                         Optional[int], batch_format: str,
+                         drop_last: bool,
+                         shuffle_buffer_size: Optional[int] = None,
+                         shuffle_seed: Optional[int] = None):
+    """Re-chunk a stream of blocks into fixed-size batches."""
+    rng = np.random.default_rng(shuffle_seed)
+    buffer: List[Block] = []
+    buffered = 0
+
+    def emit(table: Block):
+        return BlockAccessor(table).to_batch(batch_format)
+
+    carry: Optional[Block] = None
+    for block in block_iter:
+        if block.num_rows == 0:
+            continue
+        if shuffle_buffer_size:
+            buffer.append(block)
+            buffered += block.num_rows
+            if buffered < shuffle_buffer_size:
+                continue
+            block = concat_blocks(buffer)
+            block = block.take(rng.permutation(block.num_rows))
+            buffer, buffered = [], 0
+        carry = block if carry is None else concat_blocks([carry, block])
+        if batch_size is None:
+            yield emit(carry)
+            carry = None
+            continue
+        while carry is not None and carry.num_rows >= batch_size:
+            yield emit(carry.slice(0, batch_size))
+            rest = carry.slice(batch_size, carry.num_rows - batch_size)
+            carry = rest if rest.num_rows else None
+    if buffer:
+        block = concat_blocks(buffer)
+        block = block.take(rng.permutation(block.num_rows))
+        carry = block if carry is None else concat_blocks([carry, block])
+        while (carry is not None and batch_size is not None
+               and carry.num_rows >= batch_size):
+            yield emit(carry.slice(0, batch_size))
+            rest = carry.slice(batch_size, carry.num_rows - batch_size)
+            carry = rest if rest.num_rows else None
+    if carry is not None and carry.num_rows and not drop_last:
+        yield emit(carry)
+
+
+def _prefetched(it: Iterator, n: int) -> Iterator:
+    """Run the upstream iterator in a thread, buffering up to n items."""
+    if n <= 0:
+        yield from it
+        return
+    q: queue.Queue = queue.Queue(maxsize=n)
+    DONE = object()
+
+    def pump():
+        try:
+            for item in it:
+                q.put(item)
+            q.put(DONE)
+        except BaseException as e:  # propagate into consumer
+            q.put(e)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is DONE:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item
+
+
+class DataIterator:
+    """Iterator facade over a stream of blocks (one per consumer shard)."""
+
+    def __init__(self, block_iter_factory: Callable[[], Iterator[Block]]):
+        self._factory = block_iter_factory
+
+    def iter_blocks(self) -> Iterator[Block]:
+        return self._factory()
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for block in self._factory():
+            yield from BlockAccessor(block).to_rows()
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy",
+                     prefetch_batches: int = 1,
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None
+                     ) -> Iterator[Any]:
+        batches = _batches_from_blocks(
+            self._factory(), batch_size, batch_format, drop_last,
+            local_shuffle_buffer_size, local_shuffle_seed)
+        return _prefetched(batches, prefetch_batches)
+
+    def to_jax(self, *, batch_size: int, sharding=None,
+               prefetch: int = 2, drop_last: bool = True,
+               dtypes: Optional[Dict[str, Any]] = None) -> Iterator[Any]:
+        """Device-prefetching iterator: batch N+1 is already transferring
+        to HBM while batch N computes."""
+        import jax
+
+        def to_device(batch: Dict[str, np.ndarray]):
+            out = {}
+            for k, v in batch.items():
+                if dtypes and k in dtypes:
+                    v = v.astype(dtypes[k])
+                out[k] = (jax.device_put(v, sharding) if sharding is not None
+                          else jax.device_put(v))
+            return out
+
+        host = self.iter_batches(batch_size=batch_size,
+                                 batch_format="numpy",
+                                 prefetch_batches=prefetch,
+                                 drop_last=drop_last)
+        buf: collections.deque = collections.deque()
+        for batch in host:
+            buf.append(to_device(batch))   # starts async H2D copy
+            if len(buf) > prefetch:
+                yield buf.popleft()
+        while buf:
+            yield buf.popleft()

@@ -1,0 +1,117 @@
+"""Dataset creation (reference: `python/ray/data/read_api.py` — 41
+datasources; here: range/items/numpy/pandas/arrow + parquet/csv/json/text/
+binary files, each file a parallel read task)."""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import logical as L
+from ray_tpu.data.block import block_from_batch, block_from_rows
+from ray_tpu.data.dataset import Dataset
+
+DEFAULT_BLOCK_ROWS = 1000
+
+
+def _from_blocks(blocks: List[pa.Table]) -> Dataset:
+    refs = [ray_tpu.put(b) for b in blocks]
+    return Dataset(L.InputData("input", [], block_refs=refs))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    import builtins
+    if parallelism <= 0:
+        parallelism = max(1, min(64, n // DEFAULT_BLOCK_ROWS or 1))
+    tasks = []
+    for i in builtins.range(parallelism):
+        lo = i * n // parallelism
+        hi = (i + 1) * n // parallelism
+        tasks.append(lambda lo=lo, hi=hi: pa.table(
+            {"id": pa.array(np.arange(lo, hi))}))
+    return Dataset(L.Read("read_range", [], read_tasks=tasks))
+
+
+def from_items(items: List[Any], *, parallelism: int = 4) -> Dataset:
+    import builtins
+    if not items:
+        return _from_blocks([pa.table({})])
+    rows = [it if isinstance(it, dict) else {"item": it} for it in items]
+    n = len(rows)
+    parallelism = max(1, min(parallelism, n))
+    blocks = []
+    for i in builtins.range(parallelism):
+        lo, hi = i * n // parallelism, (i + 1) * n // parallelism
+        blocks.append(block_from_rows(rows[lo:hi]))
+    return _from_blocks(blocks)
+
+
+def from_numpy(arr: np.ndarray, column: str = "data") -> Dataset:
+    return _from_blocks([block_from_batch({column: arr})])
+
+
+def from_pandas(df) -> Dataset:
+    return _from_blocks([pa.Table.from_pandas(df, preserve_index=False)])
+
+
+def from_arrow(table: pa.Table) -> Dataset:
+    return _from_blocks([table])
+
+
+def _expand_paths(paths, suffix: str) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(_glob.glob(os.path.join(p, f"*{suffix}"))))
+        elif "*" in p:
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    return out
+
+
+def _file_read_dataset(paths, suffix: str, reader: Callable,
+                       name: str) -> Dataset:
+    files = _expand_paths(paths, suffix)
+    tasks = [lambda f=f: reader(f) for f in files]
+    return Dataset(L.Read(name, [], read_tasks=tasks))
+
+
+def read_parquet(paths) -> Dataset:
+    import pyarrow.parquet as pq
+    return _file_read_dataset(paths, ".parquet",
+                              lambda f: pq.read_table(f), "read_parquet")
+
+
+def read_csv(paths) -> Dataset:
+    import pyarrow.csv as pacsv
+    return _file_read_dataset(paths, ".csv",
+                              lambda f: pacsv.read_csv(f), "read_csv")
+
+
+def read_json(paths) -> Dataset:
+    import pyarrow.json as pajson
+    return _file_read_dataset(paths, ".json",
+                              lambda f: pajson.read_json(f), "read_json")
+
+
+def read_text(paths) -> Dataset:
+    def reader(f):
+        with open(f) as fh:
+            return block_from_rows(
+                [{"text": line.rstrip("\n")} for line in fh])
+    return _file_read_dataset(paths, ".txt", reader, "read_text")
+
+
+def read_binary_files(paths) -> Dataset:
+    def reader(f):
+        with open(f, "rb") as fh:
+            return block_from_rows([{"bytes": fh.read(), "path": f}])
+    return _file_read_dataset(paths, "", reader, "read_binary_files")
