@@ -1,0 +1,22 @@
+"""ray_tpu.tune — hyperparameter search / experiment execution.
+
+Reference: Ray Tune (`python/ray/tune`, SURVEY.md §2.2): Tuner → trials →
+searchers + schedulers (ASHA/PBT) → trainable actors, with intermediate
+reporting and checkpoint plumbing shared with Train.
+"""
+
+from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
+                                     PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.search_space import (choice, grid_search, loguniform,
+                                       randint, sample_from, uniform)
+from ray_tpu.tune.tuner import (ResultGrid, Trial, TuneConfig, Tuner, report,
+                                with_parameters)
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "Trial", "report",
+    "with_parameters",
+    "uniform", "loguniform", "randint", "choice", "grid_search",
+    "sample_from",
+    "FIFOScheduler", "AsyncHyperBandScheduler", "PopulationBasedTraining",
+    "TrialScheduler",
+]

@@ -1,0 +1,216 @@
+"""Tuner + trial controller (reference: `tune/tuner.py`, `tune/tune.py`,
+`tune/execution/tune_controller.py:68,666`).
+
+Trials run as gang of actors polled by the controller event loop; the
+scheduler (FIFO/ASHA/PBT) acts on every intermediate `tune.report`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import TrainContext, _set_context
+from ray_tpu.tune.schedulers import (CONTINUE, STOP, FIFOScheduler,
+                                     TrialScheduler)
+from ray_tpu.tune.search_space import generate_variants
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: str = "loss"
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Optional[TrialScheduler] = None
+    seed: int = 0
+
+
+class Trial:
+    _ids = itertools.count()
+
+    def __init__(self, config: Dict[str, Any]):
+        self.id = f"trial_{next(Trial._ids):05d}"
+        self.config = config
+        self.status = "PENDING"
+        self.results: List[Dict[str, Any]] = []
+        self.error: Optional[str] = None
+        self.actor = None
+        self.run_ref = None
+        self.pbt_exploited = False
+
+    @property
+    def last_result(self) -> Dict[str, Any]:
+        return self.results[-1] if self.results else {}
+
+
+class _TrialActor:
+    """Runs one trial's trainable; buffers intermediate reports."""
+
+    def __init__(self):
+        self._buffer: List[Dict] = []
+        self._stop = None
+
+    def run(self, fn: Callable, config: Dict[str, Any]) -> Optional[Dict]:
+        ctx = TrainContext(world_rank=0, world_size=1,
+                           experiment_name="tune")
+        ctx._report_cb = lambda e: self._buffer.append(e)
+        self._stop = ctx._stop_event
+        _set_context(ctx)
+        try:
+            out = fn(config)
+            if isinstance(out, dict):
+                self._buffer.append({"metrics": out, "checkpoint": None,
+                                     "rank": 0})
+            return out
+        finally:
+            _set_context(None)
+
+    def poll(self) -> List[Dict]:
+        drained, self._buffer = self._buffer, []
+        return drained
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+
+    def ping(self) -> bool:
+        return True
+
+
+@dataclasses.dataclass
+class ResultGrid:
+    trials: List[Trial]
+    metric: str
+    mode: str
+
+    def get_best_result(self) -> "TrialResult":
+        def score(t: Trial) -> float:
+            v = t.last_result.get(self.metric)
+            if v is None:
+                return -math.inf
+            return float(v) if self.mode == "max" else -float(v)
+        best = max(self.trials, key=score)
+        return TrialResult(best)
+
+    def __iter__(self):
+        return (TrialResult(t) for t in self.trials)
+
+    def __len__(self):
+        return len(self.trials)
+
+    @property
+    def errors(self) -> List[str]:
+        return [t.error for t in self.trials if t.error]
+
+
+@dataclasses.dataclass
+class TrialResult:
+    trial: Trial
+
+    @property
+    def metrics(self) -> Dict[str, Any]:
+        return self.trial.last_result
+
+    @property
+    def config(self) -> Dict[str, Any]:
+        return self.trial.config
+
+    @property
+    def error(self) -> Optional[str]:
+        return self.trial.error
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.cfg = tune_config or TuneConfig()
+
+    def fit(self) -> ResultGrid:
+        scheduler = self.cfg.scheduler or FIFOScheduler()
+        variants = generate_variants(self.param_space,
+                                     self.cfg.num_samples, self.cfg.seed)
+        trials = [Trial(v) for v in variants]
+        limit = self.cfg.max_concurrent_trials or max(
+            1, int(ray_tpu.cluster_resources().get("CPU", 4)))
+        actor_cls = ray_tpu.remote(_TrialActor)
+
+        pending = list(trials)
+        running: List[Trial] = []
+        while pending or running:
+            while pending and len(running) < limit:
+                trial = pending.pop(0)
+                trial.actor = actor_cls.options(max_concurrency=2).remote()
+                trial.run_ref = trial.actor.run.remote(
+                    self.trainable, trial.config)
+                trial.status = "RUNNING"
+                running.append(trial)
+
+            # Drain intermediate reports; let the scheduler stop trials.
+            for trial in list(running):
+                try:
+                    entries = ray_tpu.get(trial.actor.poll.remote(),
+                                          timeout=30)
+                except Exception:
+                    entries = []
+                for entry in entries:
+                    trial.results.append(entry["metrics"])
+                    if scheduler.on_result(trial, entry["metrics"]) == STOP:
+                        trial.actor.stop.remote()
+                        trial.status = "STOPPED"
+
+            done, _ = ray_tpu.wait([t.run_ref for t in running],
+                                   num_returns=len(running), timeout=0.1)
+            done_set = set(done)
+            for trial in list(running):
+                if trial.run_ref in done_set:
+                    self._finalize(trial, scheduler)
+                    running.remove(trial)
+        return ResultGrid(trials=trials, metric=self.cfg.metric,
+                          mode=self.cfg.mode)
+
+    def _finalize(self, trial: Trial, scheduler: TrialScheduler) -> None:
+        try:
+            ray_tpu.get(trial.run_ref)
+            if trial.status != "STOPPED":
+                trial.status = "TERMINATED"
+        except Exception as e:
+            msg = repr(e)
+            if "StopIteration" in msg or trial.status == "STOPPED":
+                trial.status = "STOPPED"
+            else:
+                trial.status = "ERROR"
+                trial.error = msg
+        # drain any last reports
+        try:
+            for entry in ray_tpu.get(trial.actor.poll.remote(), timeout=10):
+                trial.results.append(entry["metrics"])
+        except Exception:
+            pass
+        scheduler.on_trial_complete(trial)
+        try:
+            ray_tpu.kill(trial.actor)
+        except Exception:
+            pass
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """`tune.report` — alias of the train session report."""
+    from ray_tpu.train.session import report as _report
+    _report(metrics, checkpoint)
+
+
+def with_parameters(fn: Callable, **params) -> Callable:
+    def wrapped(config):
+        return fn(config, **params)
+    return wrapped

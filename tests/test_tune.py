@@ -1,0 +1,98 @@
+"""Tune: search spaces, Tuner, ASHA early stopping, PBT."""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import (AsyncHyperBandScheduler, PopulationBasedTraining,
+                          TuneConfig, Tuner)
+from ray_tpu.tune.search_space import generate_variants
+
+
+def test_generate_variants_grid_and_random():
+    space = {"lr": tune.grid_search([0.1, 0.01]),
+             "wd": tune.uniform(0, 1), "fixed": 7}
+    variants = generate_variants(space, num_samples=3, seed=0)
+    assert len(variants) == 6
+    assert {v["lr"] for v in variants} == {0.1, 0.01}
+    assert all(0 <= v["wd"] <= 1 and v["fixed"] == 7 for v in variants)
+
+
+def test_tuner_finds_minimum(ray_start_regular):
+    def objective(config):
+        x = config["x"]
+        return {"loss": (x - 3.0) ** 2}
+
+    grid = Tuner(objective,
+                 param_space={"x": tune.grid_search(
+                     [0.0, 1.0, 2.0, 3.0, 4.0])},
+                 tune_config=TuneConfig(metric="loss", mode="min"))
+    results = grid.fit()
+    best = results.get_best_result()
+    assert best.config["x"] == 3.0
+    assert best.metrics["loss"] == 0.0
+    assert len(results) == 5
+
+
+def test_tuner_intermediate_reports(ray_start_regular):
+    def trainable(config):
+        for i in range(1, 4):
+            tune.report({"training_iteration": i, "score": i * config["m"]})
+
+    results = Tuner(trainable,
+                    param_space={"m": tune.grid_search([1, 2])},
+                    tune_config=TuneConfig(metric="score",
+                                           mode="max")).fit()
+    best = results.get_best_result()
+    assert best.config["m"] == 2
+    assert best.metrics["score"] == 6
+
+
+def test_asha_early_stops_bad_trials(ray_start_regular):
+    def trainable(config):
+        import time
+        for i in range(1, 17):
+            tune.report({"training_iteration": i,
+                         "loss": config["q"] + 1.0 / i})
+            time.sleep(0.02)  # give the controller a chance to intervene
+
+    sched = AsyncHyperBandScheduler(metric="loss", mode="min", max_t=16,
+                                    grace_period=2, reduction_factor=2)
+    results = Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([0.0, 5.0, 10.0, 20.0])},
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               scheduler=sched,
+                               max_concurrent_trials=1)).fit()
+    best = results.get_best_result()
+    assert best.config["q"] == 0.0
+    trials = {r.config["q"]: r.trial for r in results}
+    # the worst trial must have been stopped before finishing 16 iters
+    assert len(trials[20.0].results) < 16
+    assert results.errors == []
+
+
+def test_pbt_exploits(ray_start_regular):
+    def trainable(config):
+        for i in range(1, 9):
+            tune.report({"training_iteration": i,
+                         "score": config["lr"] * i})
+
+    sched = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=2,
+        hyperparam_mutations={"lr": [0.1, 1.0, 10.0]})
+    results = Tuner(
+        trainable, param_space={"lr": tune.grid_search([0.1, 10.0])},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               scheduler=sched)).fit()
+    assert len(results) == 2
+    assert results.errors == []
+
+
+def test_trial_error_captured(ray_start_regular):
+    def bad(config):
+        raise RuntimeError("boom")
+
+    results = Tuner(bad, param_space={},
+                    tune_config=TuneConfig(num_samples=1)).fit()
+    assert len(results.errors) == 1
