@@ -1,0 +1,143 @@
+"""serve API: run/shutdown/status/get_deployment_handle + HTTP proxy.
+
+Reference: `serve/api.py:691` (serve.run), `serve/_private/proxy.py:697`
+(HTTPProxy ASGI). The proxy here is a threaded stdlib HTTP server that
+JSON-decodes request bodies and routes to the application's ingress
+handle — the data plane (handle → P2C router → replica actor) is identical
+in shape to the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve.deployment import Application
+from ray_tpu.serve.router import DeploymentHandle
+
+_apps: Dict[str, str] = {}       # app name -> ingress deployment name
+_http_server = None
+_http_thread = None
+
+
+def _get_controller(create: bool = True):
+    try:
+        return ray_tpu.get_actor("serve_controller")
+    except Exception:
+        if not create:
+            raise
+    from ray_tpu.serve.controller import ServeController
+    controller_cls = ray_tpu.remote(ServeController)
+    handle = controller_cls.options(
+        name="serve_controller", lifetime="detached",
+        max_concurrency=32).remote()
+    ray_tpu.get(handle.ping.remote())
+    return handle
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = None,
+        blocking: bool = False) -> DeploymentHandle:
+    """Deploy an application; returns the ingress handle."""
+    controller = _get_controller()
+    ingress = ray_tpu.get(controller.deploy_application.remote(app))
+    _apps[name] = ingress
+    return DeploymentHandle(ingress, controller)
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, _get_controller(create=False))
+
+def get_app_handle(app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(_apps[app_name], _get_controller(create=False))
+
+
+def status() -> Dict[str, Any]:
+    controller = _get_controller(create=False)
+    return ray_tpu.get(controller.status.remote())
+
+
+def delete(app_name: str) -> None:
+    controller = _get_controller(create=False)
+    ingress = _apps.pop(app_name, None)
+    if ingress:
+        ray_tpu.get(controller.delete_deployment.remote(ingress))
+
+
+def shutdown() -> None:
+    global _http_server, _http_thread
+    if _http_server is not None:
+        _http_server.shutdown()
+        _http_server = None
+        _http_thread = None
+    try:
+        controller = _get_controller(create=False)
+        ray_tpu.get(controller.shutdown.remote())
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+    _apps.clear()
+
+
+# ---------------------------------------------------------------------------
+# HTTP proxy
+# ---------------------------------------------------------------------------
+
+class _ProxyHandler(BaseHTTPRequestHandler):
+    handles: Dict[str, DeploymentHandle] = {}
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _route(self) -> Optional[DeploymentHandle]:
+        app = self.path.strip("/").split("/")[0] or "default"
+        return self.handles.get(app) or self.handles.get("default")
+
+    def _respond(self, code: int, payload: Any) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        handle = self._route()
+        if handle is None:
+            self._respond(404, {"error": "no such application"})
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length) if length else b"{}"
+        try:
+            payload = json.loads(raw) if raw else {}
+        except json.JSONDecodeError:
+            payload = raw.decode()
+        try:
+            result = handle.remote(payload).result(timeout=60)
+            self._respond(200, result)
+        except Exception as e:
+            self._respond(500, {"error": repr(e)})
+
+    def do_GET(self):
+        self.do_POST()
+
+
+def start_http_proxy(port: int = 8000, host: str = "127.0.0.1") -> int:
+    """Start the HTTP proxy serving all running applications. Returns the
+    bound port (0 picks a free one)."""
+    global _http_server, _http_thread
+    if _http_server is not None:
+        return _http_server.server_address[1]
+    controller = _get_controller(create=False)
+    _ProxyHandler.handles = {
+        app: DeploymentHandle(ingress, controller)
+        for app, ingress in _apps.items()}
+    _http_server = ThreadingHTTPServer((host, port), _ProxyHandler)
+    _http_thread = threading.Thread(
+        target=_http_server.serve_forever, daemon=True)
+    _http_thread.start()
+    return _http_server.server_address[1]

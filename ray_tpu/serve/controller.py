@@ -1,0 +1,234 @@
+"""ServeController: deploy/reconcile/autoscale.
+
+Reference: `serve/_private/controller.py:92` (ServeController actor),
+`deployment_state.py` (replica reconciliation), `autoscaling_state.py:261`
++ `autoscaling_policy.py:12` (`_calculate_desired_num_replicas` targets
+``target_ongoing_requests`` per replica), `proxy_state.py`.
+
+The controller is an actor; a daemon thread runs the reconcile+autoscale
+loop. Handles learn replica membership via ``get_replicas`` (versioned
+pull — the long-poll equivalent).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.deployment import Application, AutoscalingConfig, Deployment
+from ray_tpu.serve.replica import Replica
+
+
+class _DeploymentState:
+    def __init__(self, deployment: Deployment, init_args, init_kwargs):
+        self.deployment = deployment
+        self.init_args = init_args
+        self.init_kwargs = init_kwargs
+        self.target_replicas = deployment.num_replicas
+        if deployment.autoscaling_config:
+            self.target_replicas = max(
+                deployment.autoscaling_config.min_replicas,
+                min(self.target_replicas,
+                    deployment.autoscaling_config.max_replicas))
+        self.replicas: List[Any] = []
+        self.version = 0
+        self.last_scale_ts = 0.0
+
+
+class ServeController:
+    def __init__(self):
+        self._state: Dict[str, _DeploymentState] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._tick_s = 0.5
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # -- deploy --------------------------------------------------------
+    def deploy_application(self, app: Application,
+                           route_name: Optional[str] = None) -> str:
+        """Deploy an application graph depth-first; bound Application args
+        become DeploymentHandles (model composition)."""
+        from ray_tpu.serve.router import DeploymentHandle
+
+        name = route_name or app.deployment.name
+        self._deploy_node(app)
+        return name
+
+    def _deploy_node(self, app: Application) -> str:
+        from ray_tpu.serve.router import DeploymentHandle
+
+        dep = app.deployment
+        args = []
+        for a in app.args:
+            if isinstance(a, Application):
+                child = self._deploy_node(a)
+                args.append(DeploymentHandle(child, self._self_handle()))
+            else:
+                args.append(a)
+        kwargs = {}
+        for k, v in app.kwargs.items():
+            if isinstance(v, Application):
+                child = self._deploy_node(v)
+                kwargs[k] = DeploymentHandle(child, self._self_handle())
+            else:
+                kwargs[k] = v
+        with self._lock:
+            st = _DeploymentState(dep, tuple(args), kwargs)
+            self._state[dep.name] = st
+        self._reconcile_one(dep.name)
+        return dep.name
+
+    def _self_handle(self):
+        return ray_tpu.get_actor("serve_controller")
+
+    # -- reconciliation ------------------------------------------------
+    def _start_replica(self, st: _DeploymentState):
+        opts = dict(st.deployment.ray_actor_options or {})
+        replica_cls = ray_tpu.remote(Replica)
+        handle = replica_cls.options(
+            max_concurrency=st.deployment.max_ongoing_requests,
+            max_restarts=st.deployment.max_restarts, **opts,
+        ).remote(st.deployment.func_or_class, st.init_args, st.init_kwargs,
+                 st.deployment.user_config)
+        ray_tpu.get(handle.ping.remote())   # fail fast on ctor errors
+        return handle
+
+    def _reconcile_one(self, name: str) -> None:
+        with self._lock:
+            st = self._state.get(name)
+            if st is None:
+                return
+            target = st.target_replicas
+            changed = False
+            while len(st.replicas) < target:
+                st.replicas.append(self._start_replica(st))
+                changed = True
+            while len(st.replicas) > target:
+                victim = st.replicas.pop()
+                changed = True
+                try:
+                    ray_tpu.kill(victim)
+                except Exception:
+                    pass
+            if changed:
+                st.version += 1
+
+    def _check_health(self, name: str) -> None:
+        with self._lock:
+            st = self._state.get(name)
+            if st is None:
+                return
+            alive = []
+            changed = False
+            for r in st.replicas:
+                try:
+                    ray_tpu.get(r.ping.remote(), timeout=5)
+                    alive.append(r)
+                except Exception:
+                    changed = True
+            if changed:
+                st.replicas = alive
+                st.version += 1
+        if changed:
+            self._reconcile_one(name)
+
+    # -- autoscaling ---------------------------------------------------
+    def _autoscale_one(self, name: str) -> None:
+        with self._lock:
+            st = self._state.get(name)
+        if st is None or st.deployment.autoscaling_config is None:
+            return
+        cfg = st.deployment.autoscaling_config
+        total_ongoing = 0.0
+        for r in list(st.replicas):
+            try:
+                m = ray_tpu.get(r.metrics.remote(), timeout=5)
+                total_ongoing += m["ongoing"]
+            except Exception:
+                pass
+        desired = math.ceil(total_ongoing / cfg.target_ongoing_requests) \
+            if cfg.target_ongoing_requests > 0 else cfg.min_replicas
+        desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+        now = time.time()
+        with self._lock:
+            current = st.target_replicas
+            if desired > current:
+                delay = cfg.upscale_delay_s
+            elif desired < current:
+                delay = cfg.downscale_delay_s
+            else:
+                return
+            if now - st.last_scale_ts < delay:
+                return
+            st.target_replicas = desired
+            st.last_scale_ts = now
+        self._reconcile_one(name)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._tick_s):
+            try:
+                for name in list(self._state):
+                    self._check_health(name)
+                    self._autoscale_one(name)
+            except Exception:
+                traceback.print_exc()
+
+    # -- introspection (handles, status API) ---------------------------
+    def get_replicas(self, name: str) -> Dict[str, Any]:
+        with self._lock:
+            st = self._state.get(name)
+            if st is None:
+                raise KeyError(f"no deployment named {name!r}")
+            return {"replicas": list(st.replicas), "version": st.version}
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                name: {
+                    "target_replicas": st.target_replicas,
+                    "num_replicas": len(st.replicas),
+                    "version": st.version,
+                    "autoscaling": st.deployment.autoscaling_config
+                    is not None,
+                }
+                for name, st in self._state.items()}
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            st = self._state.pop(name, None)
+        if st:
+            for r in st.replicas:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+
+    def reconfigure_deployment(self, name: str, user_config: Dict) -> None:
+        with self._lock:
+            st = self._state.get(name)
+            if st is None:
+                raise KeyError(name)
+            replicas = list(st.replicas)
+            st.deployment = st.deployment.options(user_config=user_config)
+        ray_tpu.get([r.reconfigure.remote(user_config) for r in replicas])
+
+    def set_target_replicas(self, name: str, n: int) -> None:
+        with self._lock:
+            st = self._state.get(name)
+            if st is None:
+                raise KeyError(name)
+            st.target_replicas = n
+        self._reconcile_one(name)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for name in list(self._state):
+            self.delete_deployment(name)
+
+    def ping(self) -> bool:
+        return True

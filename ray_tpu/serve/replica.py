@@ -1,0 +1,56 @@
+"""Replica actor: hosts the user callable (reference:
+`serve/_private/replica.py:918,1028` ReplicaActor + UserCallableWrapper).
+
+Runs with ``max_concurrency = max_ongoing_requests`` so concurrent
+requests interleave; tracks ongoing/total counters that feed autoscaling.
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+class Replica:
+    def __init__(self, func_or_class, init_args, init_kwargs,
+                 user_config: Optional[Dict] = None):
+        self._lock = threading.Lock()
+        self._ongoing = 0
+        self._total = 0
+        self._user_config = user_config
+        if inspect.isclass(func_or_class):
+            self._callable = func_or_class(*init_args, **init_kwargs)
+            if user_config is not None and hasattr(
+                    self._callable, "reconfigure"):
+                self._callable.reconfigure(user_config)
+        else:
+            self._callable = func_or_class
+
+    def reconfigure(self, user_config: Dict) -> None:
+        if hasattr(self._callable, "reconfigure"):
+            self._callable.reconfigure(user_config)
+        self._user_config = user_config
+
+    def handle_request(self, method_name: str, args, kwargs) -> Any:
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if method_name == "__call__":
+                target = self._callable   # function, or instance __call__
+            else:
+                target = getattr(self._callable, method_name)
+            return target(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    def metrics(self) -> Dict[str, float]:
+        with self._lock:
+            return {"ongoing": self._ongoing, "total": self._total,
+                    "ts": time.time()}
+
+    def ping(self) -> bool:
+        return True

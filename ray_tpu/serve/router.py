@@ -1,0 +1,116 @@
+"""DeploymentHandle + request router.
+
+Reference: `serve/_private/router.py:341,365,676` (AsyncioRouter),
+`serve/_private/request_router/pow_2_router.py:27` (power-of-two-choices on
+queue length), `serve/_private/long_poll.py` (membership push). Here the
+handle pulls the replica set from the controller when its cached version
+goes stale (poll-on-miss) and routes by P2C over locally-tracked in-flight
+counts.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DeploymentResponse:
+    """Future-like response (reference: DeploymentResponse)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller,
+                 method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self._controller = controller
+        self._method_name = method_name
+        self._lock = threading.Lock()
+        self._replicas: List = []
+        self._version = -1
+        self._inflight: Dict[int, int] = {}
+        self._rng = random.Random(0)
+
+    # composition: handle.other_method.remote(...)
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        h = DeploymentHandle(self.deployment_name, self._controller, name)
+        h._replicas = self._replicas
+        h._version = self._version
+        return h
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        return self.__getattr__(method_name)
+
+    def _refresh(self, force: bool = False) -> None:
+        with self._lock:
+            stale = force or not self._replicas
+        if not stale:
+            return
+        info = ray_tpu.get(self._controller.get_replicas.remote(
+            self.deployment_name))
+        with self._lock:
+            self._replicas = info["replicas"]
+            self._version = info["version"]
+            self._inflight = {i: 0 for i in range(len(self._replicas))}
+
+    def _pick(self) -> int:
+        """Power-of-two-choices on local in-flight counts."""
+        n = len(self._replicas)
+        if n == 1:
+            return 0
+        a, b = self._rng.sample(range(n), 2)
+        return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) \
+            else b
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        self._refresh()
+        last_err = None
+        for _ in range(3):
+            with self._lock:
+                if not self._replicas:
+                    raise RuntimeError(
+                        f"no replicas for {self.deployment_name}")
+                idx = self._pick()
+                replica = self._replicas[idx]
+                self._inflight[idx] = self._inflight.get(idx, 0) + 1
+            try:
+                ref = replica.handle_request.remote(
+                    self._method_name, args, kwargs)
+                resp = DeploymentResponse(ref)
+                self._attach_decrement(resp, idx)
+                return resp
+            except Exception as e:       # replica died: refresh + retry
+                last_err = e
+                self._refresh(force=True)
+        raise RuntimeError(
+            f"routing to {self.deployment_name} failed: {last_err!r}")
+
+    def _attach_decrement(self, resp: DeploymentResponse, idx: int) -> None:
+        def waiter():
+            try:
+                ray_tpu.get(resp._ref)
+            except Exception:
+                pass
+            with self._lock:
+                self._inflight[idx] = max(
+                    0, self._inflight.get(idx, 0) - 1)
+        threading.Thread(target=waiter, daemon=True).start()
+
+    def __repr__(self):
+        return (f"DeploymentHandle({self.deployment_name!r}, "
+                f"method={self._method_name!r})")
