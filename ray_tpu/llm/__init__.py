@@ -1,0 +1,18 @@
+"""ray_tpu.llm — LLM serving + batch inference.
+
+Reference: Ray LLM (`python/ray/llm`, SURVEY.md §2.2): vLLM-backed
+deployments, TP/PP placement, prefix routing, batch inference. Here the
+engine itself is in-tree and TPU-native (continuous batching over a
+slot-major HBM KV cache; see engine.py).
+"""
+
+from ray_tpu.llm.engine import (ContinuousBatchingEngine, Request,
+                                SamplingParams)
+from ray_tpu.llm.serving import LLMConfig, LLMServer, build_llm_app
+from ray_tpu.llm.tokenizer import ByteTokenizer, load_tokenizer
+
+__all__ = [
+    "ContinuousBatchingEngine", "SamplingParams", "Request",
+    "LLMConfig", "LLMServer", "build_llm_app",
+    "ByteTokenizer", "load_tokenizer",
+]

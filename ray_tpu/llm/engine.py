@@ -1,0 +1,255 @@
+"""TPU continuous-batching inference engine.
+
+Reference capability: ray.llm serves via the vLLM engine (outside the
+reference tree, `llm/_internal/serve/deployments/llm/vllm/`); this engine
+is the in-tree TPU-native equivalent (BASELINE.md config 5):
+
+- slot-major KV cache [L, max_slots, max_seq, Hkv, D] resident in HBM;
+- requests admitted into free slots at any time (continuous batching —
+  decode never drains to admit);
+- prefill at bucketed lengths (static shapes → one jit specialization per
+  bucket, no recompation churn), scattered into the slot cache;
+- decode is ONE jitted step for all slots every iteration (inactive slots
+  masked), sampling on-device (greedy/temperature/top-k), only B int32s
+  return to host per step;
+- per-request TTFT / throughput stats (the reference's
+  `release/llm_tests/serve/benchmark/load_test.py` metrics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    max_tokens: int = 64
+    temperature: float = 0.0
+    top_k: int = 0                 # 0 = no top-k
+    stop_token_ids: tuple = ()
+    seed: int = 0
+
+
+class Request:
+    _ids = itertools.count()
+
+    def __init__(self, prompt_tokens: List[int], sampling: SamplingParams):
+        self.id = next(Request._ids)
+        self.prompt = list(prompt_tokens)
+        self.sampling = sampling
+        self.output: List[int] = []
+        self.stream: "queue.Queue" = queue.Queue()
+        self.submitted_at = time.perf_counter()
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.done = threading.Event()
+        self.finish_reason: Optional[str] = None
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+    def iter_tokens(self):
+        """Stream tokens as they are generated."""
+        while True:
+            tok = self.stream.get()
+            if tok is None:
+                return
+            yield tok
+
+
+class ContinuousBatchingEngine:
+    def __init__(self, model, params, *, max_slots: int = 8,
+                 max_seq: int = 1024,
+                 prefill_buckets: tuple = (32, 64, 128, 256, 512)):
+        self.model = model
+        self.params = params
+        self.max_slots = max_slots
+        self.max_seq = max_seq
+        self.buckets = tuple(b for b in sorted(prefill_buckets)
+                             if b <= max_seq)
+        self.cache = model.init_kv_cache(max_slots, max_seq)
+
+        self.slots: List[Optional[Request]] = [None] * max_slots
+        self.offsets = np.zeros(max_slots, np.int32)   # tokens cached/slot
+        self.waiting: "queue.Queue[Request]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._rng_key = jax.random.key(0)
+
+        # jitted programs ------------------------------------------------
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._prefill = jax.jit(self._prefill_impl)
+        self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
+        self._sample = jax.jit(self._sample_impl)
+
+        self.stats = {"requests": 0, "tokens_generated": 0,
+                      "decode_steps": 0, "prefills": 0}
+
+    # -- jitted internals --------------------------------------------------
+    def _decode_impl(self, params, cache, tokens, offsets):
+        logits, cache = self.model.forward_step(
+            params, tokens[:, None], cache, offsets)
+        return logits[:, 0], cache
+
+    def _prefill_impl(self, params, tokens, length):
+        """tokens [1, Tb]; returns last-valid-token logits + tiny cache."""
+        small = self.model.init_kv_cache(1, self.max_seq)
+        logits, small = self.model.forward_step(
+            params, tokens, small, jnp.zeros((1,), jnp.int32))
+        last = logits[0, length - 1]
+        return last, small
+
+    def _insert_impl(self, cache, small, slot):
+        k = jax.lax.dynamic_update_slice(
+            cache["k"], small["k"], (0, slot, 0, 0, 0))
+        v = jax.lax.dynamic_update_slice(
+            cache["v"], small["v"], (0, slot, 0, 0, 0))
+        return {"k": k, "v": v}
+
+    def _sample_impl(self, logits, temps, top_ks, key):
+        """logits [B, V] → tokens [B] on-device."""
+        B, V = logits.shape
+        keys = jax.random.split(key, B)
+        greedy = jnp.argmax(logits, axis=-1)
+
+        def sample_row(lg, temp, tk, k):
+            scaled = lg / jnp.maximum(temp, 1e-6)
+            # top-k masking with static k = full V (mask below threshold)
+            def apply_topk(s):
+                kth = jnp.sort(s)[V - jnp.maximum(tk, 1)]
+                return jnp.where(s >= kth, s, -1e30)
+            scaled = jax.lax.cond(tk > 0, apply_topk, lambda s: s, scaled)
+            return jax.random.categorical(k, scaled)
+
+        sampled = jax.vmap(sample_row)(logits, temps, top_ks, keys)
+        return jnp.where(temps <= 0.0, greedy, sampled)
+
+    # -- public API --------------------------------------------------------
+    def submit(self, prompt_tokens: List[int],
+               sampling: Optional[SamplingParams] = None) -> Request:
+        req = Request(prompt_tokens, sampling or SamplingParams())
+        self.stats["requests"] += 1
+        self.waiting.put(req)
+        return req
+
+    def has_work(self) -> bool:
+        return (not self.waiting.empty()
+                or any(s is not None for s in self.slots))
+
+    def step(self) -> int:
+        """One engine iteration: admit+prefill, then one decode step for
+        all active slots. Returns number of active slots."""
+        with self._lock:
+            self._admit()
+            return self._decode_step()
+
+    def _bucket_for(self, n: int) -> Optional[int]:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return None
+
+    def _admit(self) -> None:
+        for slot in range(self.max_slots):
+            if self.slots[slot] is not None:
+                continue
+            try:
+                req = self.waiting.get_nowait()
+            except queue.Empty:
+                return
+            n = len(req.prompt)
+            bucket = self._bucket_for(n)
+            if bucket is None or n >= self.max_seq:
+                req.finish_reason = "prompt_too_long"
+                req.done.set()
+                req.stream.put(None)
+                continue
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :n] = req.prompt
+            last_logits, small = self._prefill(
+                self.params, jnp.asarray(toks), n)
+            self.cache = self._insert(self.cache, small, slot)
+            self.stats["prefills"] += 1
+            # sample the first generated token right out of prefill
+            tok = self._sample_one(last_logits, req)
+            req.first_token_at = time.perf_counter()
+            self.slots[slot] = req
+            self.offsets[slot] = n
+            self._emit(slot, int(tok))
+
+    def _sample_one(self, logits_1d, req: Request):
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        tok = self._sample(
+            logits_1d[None, :],
+            jnp.asarray([req.sampling.temperature], jnp.float32),
+            jnp.asarray([req.sampling.top_k], jnp.int32), sub)
+        return int(tok[0])
+
+    def _decode_step(self) -> int:
+        active = [i for i, r in enumerate(self.slots) if r is not None]
+        if not active:
+            return 0
+        last_tokens = np.zeros(self.max_slots, np.int32)
+        temps = np.zeros(self.max_slots, np.float32)
+        top_ks = np.zeros(self.max_slots, np.int32)
+        for i in active:
+            req = self.slots[i]
+            last_tokens[i] = req.output[-1] if req.output else \
+                (req.prompt[-1] if req.prompt else 0)
+            temps[i] = req.sampling.temperature
+            top_ks[i] = req.sampling.top_k
+        logits, self.cache = self._decode(
+            self.params, self.cache, jnp.asarray(last_tokens),
+            jnp.asarray(self.offsets))
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        toks = np.asarray(self._sample(
+            logits, jnp.asarray(temps), jnp.asarray(top_ks), sub))
+        self.stats["decode_steps"] += 1
+        for i in active:
+            self.offsets[i] += 1
+            self._emit(i, int(toks[i]))
+        return len(active)
+
+    def _emit(self, slot: int, tok: int) -> None:
+        req = self.slots[slot]
+        req.output.append(tok)
+        req.stream.put(tok)
+        self.stats["tokens_generated"] += 1
+        stop = (tok in req.sampling.stop_token_ids
+                or len(req.output) >= req.sampling.max_tokens
+                or self.offsets[slot] + 1 >= self.max_seq)
+        if stop:
+            req.finish_reason = ("stop" if tok in req.sampling.stop_token_ids
+                                 else "length")
+            req.finished_at = time.perf_counter()
+            req.stream.put(None)
+            req.done.set()
+            self.slots[slot] = None
+            self.offsets[slot] = 0
+
+    # -- convenience -------------------------------------------------------
+    def generate(self, prompts: List[List[int]],
+                 sampling: Optional[SamplingParams] = None
+                 ) -> List[Request]:
+        reqs = [self.submit(p, sampling) for p in prompts]
+        while self.has_work():
+            self.step()
+        return reqs
+
+    def run_forever(self, stop_event: threading.Event,
+                    idle_sleep_s: float = 0.002) -> None:
+        """Background engine loop (used by the serving integration)."""
+        while not stop_event.is_set():
+            if self.step() == 0 and self.waiting.empty():
+                time.sleep(idle_sleep_s)

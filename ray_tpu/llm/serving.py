@@ -1,0 +1,97 @@
+"""LLM serving on ray_tpu.serve.
+
+Reference: `python/ray/llm` — `build_openai_app` (`serve/builders/`),
+`LLMConfig` (`serve/configs/server_models.py:159`), vLLM engine
+deployments (`deployments/llm/vllm/vllm_models.py`). Here the engine is
+the in-tree TPU continuous-batching engine; the deployment runs it on a
+background thread and requests stream through per-request queues.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import serve
+from ray_tpu.llm.engine import (ContinuousBatchingEngine, SamplingParams)
+from ray_tpu.llm.tokenizer import ByteTokenizer, load_tokenizer
+
+
+@dataclasses.dataclass
+class LLMConfig:
+    model_id: str = "llama-debug"
+    model_config: Optional[Any] = None       # LlamaConfig; debug if None
+    tokenizer: Optional[str] = None          # None -> ByteTokenizer
+    max_slots: int = 8
+    max_seq: int = 512
+    num_replicas: int = 1
+    max_ongoing_requests: int = 64
+    seed: int = 0
+
+
+class LLMServer:
+    """Serve deployment class hosting one engine per replica."""
+
+    def __init__(self, config: LLMConfig):
+        import jax
+
+        from ray_tpu.models.llama import LlamaConfig, LlamaModel
+
+        self.config = config
+        cfg = config.model_config or LlamaConfig.debug(
+            vocab_size=512, max_seq_len=config.max_seq)
+        self.model = LlamaModel(cfg)
+        params = self.model.init(jax.random.key(config.seed))
+        self.tokenizer = (load_tokenizer(config.tokenizer)
+                          if config.tokenizer else ByteTokenizer())
+        self.engine = ContinuousBatchingEngine(
+            self.model, params, max_slots=config.max_slots,
+            max_seq=config.max_seq)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self.engine.run_forever, args=(self._stop,), daemon=True)
+        self._thread.start()
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """OpenAI-completions-shaped request/response."""
+        prompt = request.get("prompt", "")
+        sampling = SamplingParams(
+            max_tokens=int(request.get("max_tokens", 32)),
+            temperature=float(request.get("temperature", 0.0)),
+            top_k=int(request.get("top_k", 0)),
+            stop_token_ids=(self.tokenizer.EOS,) if isinstance(
+                self.tokenizer, ByteTokenizer) else ())
+        ids = (prompt if isinstance(prompt, list)
+               else self.tokenizer.encode(prompt))
+        req = self.engine.submit(ids, sampling)
+        req.done.wait(timeout=300)
+        text = self.tokenizer.decode(req.output)
+        return {
+            "id": f"cmpl-{req.id}",
+            "model": self.config.model_id,
+            "text": text,
+            "token_ids": list(req.output),
+            "finish_reason": req.finish_reason,
+            "usage": {"prompt_tokens": len(ids),
+                      "completion_tokens": len(req.output)},
+            "ttft_s": req.ttft_s,
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        return dict(self.engine.stats)
+
+    def __del__(self):
+        try:
+            self._stop.set()
+        except Exception:
+            pass
+
+
+def build_llm_app(config: LLMConfig) -> serve.Application:
+    """`build_openai_app` equivalent: one autoscalable LLM deployment."""
+    dep = serve.deployment(
+        LLMServer, name=config.model_id,
+        num_replicas=config.num_replicas,
+        max_ongoing_requests=config.max_ongoing_requests)
+    return dep.bind(config)

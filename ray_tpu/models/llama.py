@@ -223,6 +223,73 @@ class LlamaModel:
         logits = self._constrain(logits, "batch", "seq", "vocab")
         return logits.astype(jnp.float32)
 
+    # -- KV-cache inference path (serving; BASELINE.md config 5) ----------
+    def init_kv_cache(self, batch: int, max_seq: int) -> Params:
+        """Slot-major cache: [L, B, S, Hkv, D] per k/v, bf16 in HBM."""
+        cfg = self.cfg
+        shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+        return {"k": jnp.zeros(shape, cfg.dtype),
+                "v": jnp.zeros(shape, cfg.dtype)}
+
+    def forward_step(self, params: Params, tokens: jax.Array,
+                     cache: Params, offsets: jax.Array
+                     ) -> Tuple[jax.Array, Params]:
+        """Unified prefill/decode step with KV cache.
+
+        tokens  [B, T] — T = padded prompt length (prefill) or 1 (decode)
+        offsets [B]    — how many tokens each slot has already cached
+        Returns (logits [B, T, V], updated cache). Static shapes: the same
+        jit specialization serves every request of a given (B, T, S).
+        """
+        cfg = self.cfg
+        B, T = tokens.shape
+        S = cache["k"].shape[2]
+        q_pos = offsets[:, None] + jnp.arange(T)[None, :]        # [B, T]
+        x = params["embed"].astype(cfg.dtype)[tokens]
+
+        batch_idx = jnp.arange(B)[:, None]
+
+        def block(carry, layer_and_cache):
+            x = carry
+            layer, k_cache, v_cache = layer_and_cache
+            dt = cfg.dtype
+            h = rms_norm(x, layer["attn_norm"], eps=cfg.norm_eps)
+            q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
+            k_new = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(dt))
+            v_new = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(dt))
+            q = apply_rope(q, self._angles, q_pos)
+            k_new = apply_rope(k_new, self._angles, q_pos)
+            # scatter new k/v into the cache at each slot's write offsets
+            k_cache = k_cache.at[batch_idx, q_pos].set(k_new)
+            v_cache = v_cache.at[batch_idx, q_pos].set(v_new)
+            # attend over cache positions <= own position
+            from ray_tpu.ops.attention import NEG_INF, _repeat_kv
+            kk = _repeat_kv(k_cache, cfg.n_heads)
+            vv = _repeat_kv(v_cache, cfg.n_heads)
+            s = jnp.einsum("bthd,bshd->bhts", q, kk,
+                           preferred_element_type=jnp.float32)
+            s = s * (cfg.head_dim ** -0.5)
+            mask = (jnp.arange(S)[None, None, :] <= q_pos[:, :, None])
+            s = jnp.where(mask[:, None], s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhts,bshd->bthd", p.astype(dt), vv)
+            o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
+            x = x + o
+            h = rms_norm(x, layer["mlp_norm"], eps=cfg.norm_eps)
+            gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(dt))
+            up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(dt))
+            down = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                              layer["w_down"].astype(dt))
+            return x + down, (k_cache, v_cache)
+
+        x, (k_out, v_out) = jax.lax.scan(
+            block, x, (params["layers"], cache["k"], cache["v"]))
+        x = rms_norm(x, params["norm_f"], eps=cfg.norm_eps)
+        head = (params["embed"].T if cfg.tie_embeddings
+                else params["lm_head"])
+        logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype))
+        return logits.astype(jnp.float32), {"k": k_out, "v": v_out}
+
     def loss(self, params: Params, tokens: jax.Array,
              targets: jax.Array,
              mask: Optional[jax.Array] = None) -> jax.Array:
