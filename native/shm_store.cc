@@ -1,0 +1,287 @@
+// Shared-memory object store: the native host-buffer tier.
+//
+// Reference capability (NOT a port): plasma, src/ray/object_manager/plasma/
+//   - store.h / object_store.h  : object table, create/seal/get/release
+//   - plasma_allocator.cc       : allocator over shared memory (dlmalloc
+//                                 there; first-fit coalescing free list here)
+//   - eviction_policy.h         : LRU eviction of sealed, unreferenced
+//   - create_request_queue.h    : create backpressure (here: create fails
+//                                 with RTPU_ERR_FULL after eviction fails;
+//                                 the Python layer queues/spills)
+//
+// Objects are immutable after seal. Clients map the same shm segment and
+// read payloads zero-copy (numpy frombuffer on the offset). A pthread
+// mutex (process-shared when needed) guards the metadata.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <cstdlib>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int RTPU_OK = 0;
+constexpr int RTPU_ERR_FULL = -1;
+constexpr int RTPU_ERR_NOT_FOUND = -2;
+constexpr int RTPU_ERR_EXISTS = -3;
+constexpr int RTPU_ERR_NOT_SEALED = -4;
+constexpr int RTPU_ERR_BAD = -5;
+
+struct FreeBlock {
+  uint64_t offset;
+  uint64_t size;
+};
+
+struct ObjectEntry {
+  uint64_t offset = 0;
+  uint64_t size = 0;
+  bool sealed = false;
+  int64_t refcount = 0;
+  uint64_t lru_tick = 0;  // last release time; eviction order
+};
+
+struct Store {
+  void* base = nullptr;
+  uint64_t capacity = 0;
+  uint64_t used = 0;
+  uint64_t tick = 0;
+  int shm_fd = -1;
+  std::string shm_name;
+  pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+  std::map<std::string, ObjectEntry> objects;
+  std::vector<FreeBlock> free_list;  // sorted by offset, coalesced
+
+  uint64_t allocate(uint64_t size) {
+    // first fit
+    for (size_t i = 0; i < free_list.size(); ++i) {
+      if (free_list[i].size >= size) {
+        uint64_t off = free_list[i].offset;
+        free_list[i].offset += size;
+        free_list[i].size -= size;
+        if (free_list[i].size == 0) free_list.erase(free_list.begin() + i);
+        used += size;
+        return off;
+      }
+    }
+    return UINT64_MAX;
+  }
+
+  void deallocate(uint64_t offset, uint64_t size) {
+    used -= size;
+    // insert sorted, coalesce neighbours
+    size_t i = 0;
+    while (i < free_list.size() && free_list[i].offset < offset) ++i;
+    free_list.insert(free_list.begin() + i, FreeBlock{offset, size});
+    // coalesce right
+    if (i + 1 < free_list.size() &&
+        free_list[i].offset + free_list[i].size == free_list[i + 1].offset) {
+      free_list[i].size += free_list[i + 1].size;
+      free_list.erase(free_list.begin() + i + 1);
+    }
+    // coalesce left
+    if (i > 0 &&
+        free_list[i - 1].offset + free_list[i - 1].size ==
+            free_list[i].offset) {
+      free_list[i - 1].size += free_list[i].size;
+      free_list.erase(free_list.begin() + i);
+    }
+  }
+
+  // Evict sealed refcount-0 objects (oldest release first) until
+  // `needed` bytes could be contiguously available or nothing evictable.
+  uint64_t evict(uint64_t needed) {
+    uint64_t freed = 0;
+    while (true) {
+      if (allocatable(needed)) return freed;
+      const std::string* victim = nullptr;
+      uint64_t best_tick = UINT64_MAX;
+      for (auto& kv : objects) {
+        if (kv.second.sealed && kv.second.refcount == 0 &&
+            kv.second.lru_tick < best_tick) {
+          best_tick = kv.second.lru_tick;
+          victim = &kv.first;
+        }
+      }
+      if (victim == nullptr) return freed;
+      auto it = objects.find(*victim);
+      deallocate(it->second.offset, it->second.size);
+      freed += it->second.size;
+      objects.erase(it);
+    }
+  }
+
+  bool allocatable(uint64_t size) const {
+    for (const auto& b : free_list)
+      if (b.size >= size) return true;
+    return false;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+Store* rtpu_store_open(const char* name, uint64_t capacity) {
+  std::string shm_name = std::string("/") + name;
+  int fd = shm_open(shm_name.c_str(), O_CREAT | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)capacity) != 0) {
+    close(fd);
+    shm_unlink(shm_name.c_str());
+    return nullptr;
+  }
+  void* base =
+      mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    shm_unlink(shm_name.c_str());
+    return nullptr;
+  }
+  Store* s = new Store();
+  s->base = base;
+  s->capacity = capacity;
+  s->shm_fd = fd;
+  s->shm_name = shm_name;
+  s->free_list.push_back(FreeBlock{0, capacity});
+  return s;
+}
+
+void rtpu_store_close(Store* s, int unlink) {
+  if (s == nullptr) return;
+  munmap(s->base, s->capacity);
+  close(s->shm_fd);
+  if (unlink) shm_unlink(s->shm_name.c_str());
+  delete s;
+}
+
+void* rtpu_store_base(Store* s) { return s->base; }
+uint64_t rtpu_store_capacity(Store* s) { return s->capacity; }
+
+uint64_t rtpu_store_used(Store* s) {
+  pthread_mutex_lock(&s->mu);
+  uint64_t u = s->used;
+  pthread_mutex_unlock(&s->mu);
+  return u;
+}
+
+uint64_t rtpu_store_num_objects(Store* s) {
+  pthread_mutex_lock(&s->mu);
+  uint64_t n = s->objects.size();
+  pthread_mutex_unlock(&s->mu);
+  return n;
+}
+
+// Reserve an unsealed buffer. Returns RTPU_OK and sets *offset, or error.
+int rtpu_create(Store* s, const char* id, uint64_t size, uint64_t* offset) {
+  pthread_mutex_lock(&s->mu);
+  if (s->objects.count(id)) {
+    pthread_mutex_unlock(&s->mu);
+    return RTPU_ERR_EXISTS;
+  }
+  if (size == 0 || size > s->capacity) {
+    pthread_mutex_unlock(&s->mu);
+    return RTPU_ERR_BAD;
+  }
+  uint64_t off = s->allocate(size);
+  if (off == UINT64_MAX) {
+    s->evict(size);
+    off = s->allocate(size);
+  }
+  if (off == UINT64_MAX) {
+    pthread_mutex_unlock(&s->mu);
+    return RTPU_ERR_FULL;  // create backpressure: caller queues or spills
+  }
+  ObjectEntry e;
+  e.offset = off;
+  e.size = size;
+  e.sealed = false;
+  e.refcount = 1;  // creator holds a ref until seal+release
+  s->objects[id] = e;
+  *offset = off;
+  pthread_mutex_unlock(&s->mu);
+  return RTPU_OK;
+}
+
+int rtpu_seal(Store* s, const char* id) {
+  pthread_mutex_lock(&s->mu);
+  auto it = s->objects.find(id);
+  if (it == s->objects.end()) {
+    pthread_mutex_unlock(&s->mu);
+    return RTPU_ERR_NOT_FOUND;
+  }
+  it->second.sealed = true;
+  pthread_mutex_unlock(&s->mu);
+  return RTPU_OK;
+}
+
+// Get a sealed object: increfs and returns offset+size.
+int rtpu_get(Store* s, const char* id, uint64_t* offset, uint64_t* size) {
+  pthread_mutex_lock(&s->mu);
+  auto it = s->objects.find(id);
+  if (it == s->objects.end()) {
+    pthread_mutex_unlock(&s->mu);
+    return RTPU_ERR_NOT_FOUND;
+  }
+  if (!it->second.sealed) {
+    pthread_mutex_unlock(&s->mu);
+    return RTPU_ERR_NOT_SEALED;
+  }
+  it->second.refcount++;
+  *offset = it->second.offset;
+  *size = it->second.size;
+  pthread_mutex_unlock(&s->mu);
+  return RTPU_OK;
+}
+
+int rtpu_release(Store* s, const char* id) {
+  pthread_mutex_lock(&s->mu);
+  auto it = s->objects.find(id);
+  if (it == s->objects.end()) {
+    pthread_mutex_unlock(&s->mu);
+    return RTPU_ERR_NOT_FOUND;
+  }
+  if (it->second.refcount > 0) it->second.refcount--;
+  it->second.lru_tick = ++s->tick;
+  pthread_mutex_unlock(&s->mu);
+  return RTPU_OK;
+}
+
+int rtpu_contains(Store* s, const char* id) {
+  pthread_mutex_lock(&s->mu);
+  auto it = s->objects.find(id);
+  int out = (it != s->objects.end() && it->second.sealed) ? 1 : 0;
+  pthread_mutex_unlock(&s->mu);
+  return out;
+}
+
+// Force-delete regardless of refcount (owner decided the object is dead).
+int rtpu_delete(Store* s, const char* id) {
+  pthread_mutex_lock(&s->mu);
+  auto it = s->objects.find(id);
+  if (it == s->objects.end()) {
+    pthread_mutex_unlock(&s->mu);
+    return RTPU_ERR_NOT_FOUND;
+  }
+  s->deallocate(it->second.offset, it->second.size);
+  s->objects.erase(it);
+  pthread_mutex_unlock(&s->mu);
+  return RTPU_OK;
+}
+
+uint64_t rtpu_evict_bytes(Store* s, uint64_t needed) {
+  pthread_mutex_lock(&s->mu);
+  uint64_t freed = s->evict(needed);
+  pthread_mutex_unlock(&s->mu);
+  return freed;
+}
+
+}  // extern "C"

@@ -100,6 +100,12 @@ class ObjectEntry:
     device_tier: bool = False
     spilled_path: Optional[str] = None
     pinned: int = 0  # pin count: >0 means not evictable/spillable
+    # native shm tier: (dtype, shape) of the array parked in the C++ store
+    native_meta: Optional[tuple] = None
+
+
+# numpy arrays at least this large go to the native shm arena when built
+NATIVE_TIER_MIN_BYTES = 64 * 1024
 
 
 class LocalObjectStore:
@@ -115,7 +121,19 @@ class LocalObjectStore:
         self._entries: "OrderedDict[ObjectID, ObjectEntry]" = OrderedDict()
         self._used = 0
         self.stats = {"puts": 0, "gets": 0, "spills": 0, "restores": 0,
-                      "evictions": 0}
+                      "evictions": 0, "native_puts": 0}
+        # Native C++ shm tier (plasma equivalent): holds large numpy
+        # payloads as zero-copy mmap views. Optional — absent without g++.
+        self._native = None
+        if os.environ.get("RAY_TPU_NATIVE_STORE", "1") != "0":
+            try:
+                from ray_tpu.native_store import ShmObjectStore, available
+                if available():
+                    self._native = ShmObjectStore(
+                        f"rtpu_{os.getpid()}_{node_id.hex()[:8]}",
+                        capacity_bytes)
+            except Exception:
+                self._native = None
 
     # -- basic ops ---------------------------------------------------------
     def put(self, object_id: ObjectID, value: Any,
@@ -131,14 +149,39 @@ class LocalObjectStore:
                 raise OutOfMemoryError(
                     f"object of {size} bytes exceeds store capacity "
                     f"{self.capacity_bytes}")
-            if not device:
-                self._ensure_space(size)
             entry = ObjectEntry(value=value, nbytes=size, device_tier=device)
-            self._entries[object_id] = entry
             if not device:
-                self._used += size
+                native_meta = self._try_native_put(object_id, value, size)
+                if native_meta is not None:
+                    entry.value = None
+                    entry.native_meta = native_meta
+                    self.stats["native_puts"] += 1
+                else:
+                    self._ensure_space(size)
+                    self._used += size
+            self._entries[object_id] = entry
             self.stats["puts"] += 1
             return size
+
+    def _try_native_put(self, object_id: ObjectID, value: Any,
+                        size: int) -> Optional[tuple]:
+        """Park a large contiguous numpy array in the C++ shm arena."""
+        import numpy as np
+
+        if (self._native is None or not isinstance(value, np.ndarray)
+                or size < NATIVE_TIER_MIN_BYTES
+                or value.dtype == object
+                or not value.flags.c_contiguous):
+            return None
+        from ray_tpu.native_store import ShmStoreFull
+        try:
+            # pin: this layer's refcounting owns lifetime; native LRU must
+            # not evict behind our back (falls back to python tier + disk
+            # spill when the arena is full)
+            self._native.put(object_id.binary(), value, pin=True)
+            return (value.dtype, value.shape)
+        except (ShmStoreFull, KeyError):
+            return None
 
     def get(self, object_id: ObjectID) -> Any:
         with self._lock:
@@ -149,6 +192,14 @@ class LocalObjectStore:
             if entry.spilled_path is not None:
                 self._restore(object_id, entry)
             self.stats["gets"] += 1
+            if entry.native_meta is not None:
+                import numpy as np
+                dtype, shape = entry.native_meta
+                view = self._native.get_view(object_id.binary())
+                self._native.release(object_id.binary())
+                arr = np.frombuffer(view, dtype=dtype).reshape(shape)
+                arr.flags.writeable = False
+                return arr
             return entry.value
 
     def contains(self, object_id: ObjectID) -> bool:
@@ -164,6 +215,11 @@ class LocalObjectStore:
                 try:
                     os.unlink(entry.spilled_path)
                 except OSError:
+                    pass
+            elif entry.native_meta is not None:
+                try:
+                    self._native.delete(object_id.binary())
+                except Exception:
                     pass
             elif not entry.device_tier:
                 self._used -= entry.nbytes
@@ -192,6 +248,16 @@ class LocalObjectStore:
         with self._lock:
             for oid in list(self._entries):
                 self.delete(oid)
+
+    def close(self) -> None:
+        """Release the native shm arena (unlinks /dev/shm segment)."""
+        self.clear()
+        if self._native is not None:
+            try:
+                self._native.close(unlink=True)
+            except Exception:
+                pass
+            self._native = None
 
     # -- pressure handling -------------------------------------------------
     def _ensure_space(self, size: int) -> None:

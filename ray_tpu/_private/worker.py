@@ -232,7 +232,7 @@ class Runtime:
                         del self._locations[oid]
                         self.futures.reset(oid)
                         self._lost.add(oid)
-        node.store.clear()
+        node.store.close()
         self.pg_manager.on_node_death(node.node_id)
         # Actors on this node die (and may restart).
         for actor_id, pending in pending_by_actor.items():
@@ -1066,6 +1066,7 @@ class Runtime:
         self._shutdown = True
         for node in self.nodes():
             node.shutdown(fail_tasks=False)
+            node.store.close()
         with self._nodes_lock:
             self._nodes.clear()
         self.memory_store.clear()

@@ -1,0 +1,169 @@
+"""ctypes binding to the native shared-memory object store.
+
+Builds `native/libray_tpu_native.so` on first use (g++; cached). Falls
+back gracefully (``available() == False``) where no compiler exists —
+callers keep the pure-Python tier.
+
+Reference parity: plasma client API surface (create/seal/get/release/
+delete, zero-copy buffers) — `src/ray/object_manager/plasma/client.h`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libray_tpu_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> Optional[str]:
+    src = os.path.join(_NATIVE_DIR, "shm_store.cc")
+    if not os.path.exists(src):
+        return None
+    if os.path.exists(_SO_PATH) and (
+            os.path.getmtime(_SO_PATH) >= os.path.getmtime(src)):
+        return _SO_PATH
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+             "-o", _SO_PATH, src, "-lpthread", "-lrt"],
+            check=True, capture_output=True, timeout=120)
+        return _SO_PATH
+    except Exception:
+        return None
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = _build()
+        if path is None:
+            return None
+        lib = ctypes.CDLL(path)
+        lib.rtpu_store_open.restype = ctypes.c_void_p
+        lib.rtpu_store_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.rtpu_store_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rtpu_store_base.restype = ctypes.c_void_p
+        lib.rtpu_store_base.argtypes = [ctypes.c_void_p]
+        lib.rtpu_store_capacity.restype = ctypes.c_uint64
+        lib.rtpu_store_capacity.argtypes = [ctypes.c_void_p]
+        lib.rtpu_store_used.restype = ctypes.c_uint64
+        lib.rtpu_store_used.argtypes = [ctypes.c_void_p]
+        lib.rtpu_store_num_objects.restype = ctypes.c_uint64
+        lib.rtpu_store_num_objects.argtypes = [ctypes.c_void_p]
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        lib.rtpu_create.restype = ctypes.c_int
+        lib.rtpu_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64, u64p]
+        lib.rtpu_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_get.restype = ctypes.c_int
+        lib.rtpu_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u64p,
+                                 u64p]
+        lib.rtpu_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_contains.restype = ctypes.c_int
+        lib.rtpu_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_evict_bytes.restype = ctypes.c_uint64
+        lib.rtpu_evict_bytes.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class ShmStoreFull(Exception):
+    pass
+
+
+class ShmObjectStore:
+    """One shared-memory arena; objects are immutable byte buffers."""
+
+    def __init__(self, name: str, capacity_bytes: int):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native store unavailable (no g++?)")
+        self._lib = lib
+        self._handle = lib.rtpu_store_open(
+            name.encode(), ctypes.c_uint64(capacity_bytes))
+        if not self._handle:
+            raise RuntimeError(f"shm_open failed for {name}")
+        base = lib.rtpu_store_base(self._handle)
+        self._buf = (ctypes.c_char * capacity_bytes).from_address(base)
+        self._closed = False
+
+    # -- plasma-like client API -----------------------------------------
+    def put(self, object_id: bytes, payload, pin: bool = False) -> None:
+        """create + write + seal. With ``pin`` the creator's ref is kept:
+        the object is not LRU-evictable until delete (used when a host
+        refcounting layer owns the lifetime)."""
+        payload = memoryview(payload).cast("B")
+        size = payload.nbytes
+        off = ctypes.c_uint64()
+        rc = self._lib.rtpu_create(self._handle, object_id,
+                                   ctypes.c_uint64(size),
+                                   ctypes.byref(off))
+        if rc == -3:
+            raise KeyError(f"object {object_id!r} already exists")
+        if rc != 0:
+            raise ShmStoreFull(
+                f"cannot allocate {size} bytes (rc={rc})")
+        dst = np.frombuffer(self._buf, np.uint8, count=size,
+                            offset=off.value)
+        dst[:] = np.frombuffer(payload, np.uint8)
+        self._lib.rtpu_seal(self._handle, object_id)
+        if not pin:
+            self._lib.rtpu_release(self._handle, object_id)
+
+    def get_view(self, object_id: bytes) -> np.ndarray:
+        """Zero-copy read-only view into the shm arena (increfs)."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rtpu_get(self._handle, object_id,
+                                ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            raise KeyError(f"object {object_id!r} not in store (rc={rc})")
+        view = np.frombuffer(self._buf, np.uint8, count=size.value,
+                             offset=off.value)
+        view.flags.writeable = False
+        return view
+
+    def release(self, object_id: bytes) -> None:
+        self._lib.rtpu_release(self._handle, object_id)
+
+    def contains(self, object_id: bytes) -> bool:
+        return bool(self._lib.rtpu_contains(self._handle, object_id))
+
+    def delete(self, object_id: bytes) -> None:
+        self._lib.rtpu_delete(self._handle, object_id)
+
+    def used_bytes(self) -> int:
+        return self._lib.rtpu_store_used(self._handle)
+
+    def capacity(self) -> int:
+        return self._lib.rtpu_store_capacity(self._handle)
+
+    def num_objects(self) -> int:
+        return self._lib.rtpu_store_num_objects(self._handle)
+
+    def evict(self, nbytes: int) -> int:
+        return self._lib.rtpu_evict_bytes(self._handle,
+                                          ctypes.c_uint64(nbytes))
+
+    def close(self, unlink: bool = True) -> None:
+        if not self._closed:
+            self._closed = True
+            self._lib.rtpu_store_close(self._handle, 1 if unlink else 0)

@@ -1,0 +1,88 @@
+"""Native shared-memory object store (C++ tier)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu.native_store import ShmObjectStore, ShmStoreFull, available
+
+pytestmark = pytest.mark.skipif(not available(),
+                                reason="no native toolchain")
+
+
+@pytest.fixture
+def store():
+    s = ShmObjectStore(f"rtpu_test_{os.getpid()}", 1 << 20)  # 1 MiB
+    yield s
+    s.close()
+
+
+def test_put_get_zero_copy(store):
+    data = np.arange(1000, dtype=np.float64)
+    store.put(b"obj1", data.tobytes())
+    view = store.get_view(b"obj1")
+    back = np.frombuffer(view, np.float64)
+    np.testing.assert_array_equal(back, data)
+    assert not view.flags.writeable
+    assert store.num_objects() == 1
+    assert store.used_bytes() == data.nbytes
+    store.release(b"obj1")
+
+
+def test_duplicate_put_rejected(store):
+    store.put(b"x", b"abc")
+    with pytest.raises(KeyError):
+        store.put(b"x", b"def")
+
+
+def test_delete_frees_space(store):
+    store.put(b"a", b"\x00" * 1000)
+    used = store.used_bytes()
+    store.delete(b"a")
+    assert store.used_bytes() == used - 1000
+    assert not store.contains(b"a")
+
+
+def test_lru_eviction_under_pressure(store):
+    # fill the 1 MiB store with 5 x 200 KiB objects (1,024,000 bytes);
+    # a sixth requires evicting the least-recently-released object (o0).
+    blob = b"\x01" * (200 * 1024)
+    for i in range(5):
+        store.put(f"o{i}".encode(), blob)
+    # bump recency of o1..o4, leaving o0 as the LRU victim
+    for i in range(1, 5):
+        store.get_view(f"o{i}".encode())
+        store.release(f"o{i}".encode())
+    store.put(b"new", blob)
+    assert store.contains(b"new")
+    assert not store.contains(b"o0")
+    assert all(store.contains(f"o{i}".encode()) for i in range(1, 5))
+
+
+def test_pinned_objects_not_evicted(store):
+    # hold refs on everything -> nothing evictable -> create must fail
+    blob = b"\x02" * (300 * 1024)
+    for name in (b"a", b"b", b"c"):
+        store.put(name, blob)
+        store.get_view(name)          # pin
+    with pytest.raises(ShmStoreFull):
+        store.put(b"d", blob)
+    assert all(store.contains(n) for n in (b"a", b"b", b"c"))
+    for name in (b"a", b"b", b"c"):
+        store.release(name)
+    store.put(b"d", blob)             # now eviction can proceed
+    assert store.contains(b"d")
+
+
+def test_free_list_coalescing(store):
+    # allocate three adjacent objects, free the middle then the first;
+    # a object larger than any single freed chunk must still fit after
+    # coalescing.
+    third = (1 << 20) // 3 - 64
+    for name in (b"a", b"b", b"c"):
+        store.put(name, b"\x03" * third)
+    store.delete(b"a")
+    store.delete(b"b")
+    store.put(b"big", b"\x04" * (2 * third))  # needs coalesced a+b
+    assert store.contains(b"big")
