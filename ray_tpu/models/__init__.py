@@ -9,7 +9,12 @@ Coverage mirrors BASELINE.md target configs: Llama-3 family (flagship),
 GPT-2, MLP (Fashion-MNIST baseline), ViT (ImageNet streaming).
 """
 
+from ray_tpu.models.gpt2 import GPT2Config, GPT2Model
 from ray_tpu.models.llama import LlamaConfig, LlamaModel
 from ray_tpu.models.mlp import MLPConfig, MLPModel
+from ray_tpu.models.moe import MoEConfig, MoEModel
+from ray_tpu.models.vit import ViTConfig, ViTModel
 
-__all__ = ["LlamaConfig", "LlamaModel", "MLPConfig", "MLPModel"]
+__all__ = ["LlamaConfig", "LlamaModel", "MLPConfig", "MLPModel",
+           "GPT2Config", "GPT2Model", "ViTConfig", "ViTModel",
+           "MoEConfig", "MoEModel"]

@@ -1,0 +1,197 @@
+"""Mixture-of-Experts Llama — expert parallelism (SURVEY.md §2.3: EP is
+absent in the reference — vLLM handles MoE internally — so this is a
+native capability).
+
+GShard/Switch-style top-k routing with capacity-based einsum dispatch:
+- all routing math is dense one-hot einsums (no gather/scatter in the hot
+  path — XLA maps these straight onto the MXU);
+- the expert dimension carries the ``experts`` logical axis → ``ep`` mesh
+  axis; expert FFNs run where their weights live, dispatch/combine
+  einsums become all-to-alls over ICI;
+- tokens beyond an expert's capacity are dropped (standard
+  capacity_factor trade).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig, LlamaModel, Params
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(LlamaConfig):
+    num_experts: int = 8
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    router_z_loss: float = 1e-3
+    load_balance_loss: float = 1e-2
+
+    @staticmethod
+    def debug_moe(num_experts: int = 4) -> "MoEConfig":
+        return MoEConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                         n_kv_heads=2, ffn_dim=128, max_seq_len=128,
+                         remat=False, num_experts=num_experts)
+
+
+def moe_param_logical_axes(cfg: MoEConfig) -> Params:
+    from ray_tpu.models.llama import param_logical_axes
+    axes = param_logical_axes(cfg)
+    layers = dict(axes["layers"])
+    for key in ("w_gate", "w_up", "w_down"):
+        del layers[key]
+    layers["router"] = (None, "embed_in", "experts")
+    layers["e_gate"] = (None, "experts", "embed_in", "mlp")
+    layers["e_up"] = (None, "experts", "embed_in", "mlp")
+    layers["e_down"] = (None, "experts", "mlp", "embed_in")
+    axes["layers"] = layers
+    return axes
+
+
+class MoEModel(LlamaModel):
+    """Llama with MoE FFN blocks. Aux losses accumulated per forward."""
+
+    def __init__(self, cfg: MoEConfig, mesh=None,
+                 rules: Optional[Dict] = None):
+        super().__init__(cfg, mesh=mesh, rules=rules)
+
+    def init(self, rng: jax.Array) -> Params:
+        params = super().init(rng)
+        cfg: MoEConfig = self.cfg
+        d, f, E, L = cfg.dim, cfg.ffn_dim, cfg.num_experts, cfg.n_layers
+        keys = jax.random.split(jax.random.fold_in(rng, 1), 4)
+        layers = params["layers"]
+        for key in ("w_gate", "w_up", "w_down"):
+            del layers[key]
+        layers["router"] = jax.random.normal(
+            keys[0], (L, d, E), jnp.float32) * 0.02
+        layers["e_gate"] = jax.random.normal(
+            keys[1], (L, E, d, f), jnp.float32) * d ** -0.5
+        layers["e_up"] = jax.random.normal(
+            keys[2], (L, E, d, f), jnp.float32) * d ** -0.5
+        layers["e_down"] = jax.random.normal(
+            keys[3], (L, E, f, d), jnp.float32) * f ** -0.5
+        return params
+
+    def param_shardings(self):
+        from ray_tpu.parallel.mesh import named_sharding
+        axes = moe_param_logical_axes(self.cfg)
+        return jax.tree.map(
+            lambda names: named_sharding(self.mesh, *names,
+                                         rules=self.rules),
+            axes, is_leaf=lambda x: isinstance(x, tuple))
+
+    # -- MoE FFN -----------------------------------------------------------
+    def _moe_ffn(self, h: jax.Array, layer: Params
+                 ) -> Tuple[jax.Array, jax.Array]:
+        """h [B, S, D] → (out [B, S, D], aux_loss scalar)."""
+        cfg: MoEConfig = self.cfg
+        dt = cfg.dtype
+        B, S, D = h.shape
+        E, K = cfg.num_experts, cfg.expert_top_k
+        T = B * S
+        C = max(1, int(cfg.capacity_factor * T * K / E))
+
+        x = h.reshape(T, D)
+        logits = jnp.einsum("td,de->te", x.astype(jnp.float32),
+                            layer["router"])                   # [T, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # aux losses: z-loss + Switch load-balance
+        z = jax.scipy.special.logsumexp(logits, axis=-1)
+        z_loss = jnp.mean(z ** 2) * cfg.router_z_loss
+        me = jnp.mean(probs, axis=0)                          # router mass
+        top1 = jnp.argmax(probs, axis=-1)
+        ce = jnp.mean(jax.nn.one_hot(top1, E), axis=0)        # token share
+        lb_loss = cfg.load_balance_loss * E * jnp.sum(me * ce)
+        aux = z_loss + lb_loss
+
+        # top-k dispatch with per-expert capacity
+        gate_vals, gate_idx = jax.lax.top_k(probs, K)          # [T, K]
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
+        combine = jnp.zeros((T, E, C), jnp.float32)
+        dispatch = jnp.zeros((T, E, C), jnp.bool_)
+        for k in range(K):                                    # K static, ≤2
+            onehot = jax.nn.one_hot(gate_idx[:, k], E)         # [T, E]
+            pos = (jnp.cumsum(onehot, axis=0) - onehot)        # rank in e
+            pos = jnp.sum(pos * onehot, axis=-1)               # [T]
+            in_cap = pos < C
+            pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C)  # [T, C]
+            slot = onehot[:, :, None] * pos_oh[:, None, :]     # [T, E, C]
+            slot = slot * in_cap[:, None, None]
+            dispatch = dispatch | (slot > 0)
+            combine = combine + slot * gate_vals[:, k][:, None, None]
+
+        expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dt),
+                               x.astype(dt))                   # [E, C, D]
+        gate = jnp.einsum("ecd,edf->ecf", expert_in,
+                          layer["e_gate"].astype(dt))
+        up = jnp.einsum("ecd,edf->ecf", expert_in,
+                        layer["e_up"].astype(dt))
+        act = jax.nn.silu(gate) * up
+        expert_out = jnp.einsum("ecf,efd->ecd", act,
+                                layer["e_down"].astype(dt))    # [E, C, D]
+        out = jnp.einsum("tec,ecd->td", combine.astype(dt), expert_out)
+        return out.reshape(B, S, D), aux
+
+    def _moe_block(self, x, layer: Params, positions):
+        """Returns (x, aux) — aux threads through the scan carry."""
+        from ray_tpu.ops.norms import rms_norm
+        from ray_tpu.ops.rope import apply_rope
+        cfg = self.cfg
+        dt = cfg.dtype
+        h = rms_norm(x, layer["attn_norm"], eps=cfg.norm_eps)
+        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
+        kk = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(dt))
+        vv = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(dt))
+        q = apply_rope(q, self._angles, positions)
+        kk = apply_rope(kk, self._angles, positions)
+        o = self._attention(q, kk, vv, positions)
+        o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
+        x = x + o
+        h = rms_norm(x, layer["mlp_norm"], eps=cfg.norm_eps)
+        ffn, aux = self._moe_ffn(h, layer)
+        return x + ffn, aux
+
+    def apply_with_aux(self, params: Params, tokens: jax.Array,
+                       positions=None):
+        from ray_tpu.ops.norms import rms_norm
+        cfg = self.cfg
+        x = params["embed"].astype(cfg.dtype)[tokens]
+        x = self._constrain(x, "batch", "seq", "embed")
+
+        block = self._moe_block
+        if cfg.remat:
+            block = jax.checkpoint(block)
+
+        def scan_body(carry, layer):
+            x, aux = carry
+            x, aux_i = block(x, layer, positions)
+            return (x, aux + aux_i), None
+
+        (x, aux), _ = jax.lax.scan(
+            scan_body, (x, jnp.float32(0.0)), params["layers"])
+        x = rms_norm(x, params["norm_f"], eps=cfg.norm_eps)
+        head = (params["embed"].T if cfg.tie_embeddings
+                else params["lm_head"])
+        logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype))
+        return logits.astype(jnp.float32), aux
+
+    def apply(self, params: Params, tokens: jax.Array,
+              positions=None) -> jax.Array:
+        return self.apply_with_aux(params, tokens, positions)[0]
+
+    def loss(self, params: Params, tokens: jax.Array, targets: jax.Array,
+             mask=None) -> jax.Array:
+        logits, aux = self.apply_with_aux(params, tokens)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None],
+                                   axis=-1).squeeze(-1)
+        ce = (jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+              if mask is not None else jnp.mean(nll))
+        return ce + aux

@@ -1,0 +1,100 @@
+"""GPT-2, ViT, MoE model families."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.models import (GPT2Config, GPT2Model, MoEConfig, MoEModel,
+                            ViTConfig, ViTModel)
+from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+from ray_tpu.train.spmd import make_train_step, shard_batch
+
+
+def test_gpt2_forward_and_training():
+    cfg = GPT2Config.debug()
+    model = GPT2Model(cfg)
+    ts = make_train_step(model, optimizer=optax.adam(1e-3))
+    params, opt = ts.init_fn(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 32)), jnp.int32)
+    losses = []
+    for _ in range(8):
+        params, opt, m = ts.step_fn(params, opt, (toks,
+                                                  jnp.roll(toks, -1, 1)))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+
+
+def test_gpt2_causality():
+    cfg = GPT2Config.debug()
+    model = GPT2Model(cfg)
+    params = model.init(jax.random.key(0))
+    t1 = jnp.zeros((1, 16), jnp.int32)
+    t2 = t1.at[0, 12].set(9)
+    l1, l2 = model.apply(params, t1), model.apply(params, t2)
+    np.testing.assert_allclose(np.asarray(l1[0, :12]),
+                               np.asarray(l2[0, :12]), atol=1e-4)
+
+
+def test_vit_forward_and_training():
+    cfg = ViTConfig.debug()
+    model = ViTModel(cfg)
+    ts = make_train_step(model, optimizer=optax.adam(1e-3))
+    params, opt = ts.init_fn(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.normal(size=(8, 32, 32, 3)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, (8,)), jnp.int32)
+    logits = model.apply(params, imgs)
+    assert logits.shape == (8, 10)
+    losses = []
+    for _ in range(10):
+        params, opt, m = ts.step_fn(params, opt, (imgs, labels))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+
+
+def test_moe_forward_loss_and_training():
+    cfg = MoEConfig.debug_moe()
+    model = MoEModel(cfg)
+    ts = make_train_step(model, optimizer=optax.adam(1e-3))
+    params, opt = ts.init_fn(jax.random.key(0))
+    assert "e_gate" in params["layers"] and "w_gate" not in params["layers"]
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 32)), jnp.int32)
+    losses = []
+    for _ in range(8):
+        params, opt, m = ts.step_fn(params, opt, (toks,
+                                                  jnp.roll(toks, -1, 1)))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_moe_expert_parallel_sharded_step():
+    """MoE trains over an ep=4 mesh axis; experts sharded."""
+    spec = MeshSpec.auto(8, ep=4)
+    mesh = build_mesh(spec, jax.devices()[:8])
+    cfg = MoEConfig.debug_moe(num_experts=4)
+    model = MoEModel(cfg, mesh=mesh)
+    ts = make_train_step(model, mesh=mesh)
+    params, opt = ts.init_fn(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 32)), jnp.int32)
+    batch = shard_batch((toks, jnp.roll(toks, -1, 1)), ts)
+    params, opt, m = ts.step_fn(params, opt, batch)
+    assert np.isfinite(float(m["loss"]))
+    # expert weights actually sharded over ep
+    sh = jax.tree.leaves(ts.param_shardings)
+    e_gate_sharding = ts.param_shardings["layers"]["e_gate"]
+    assert "ep" in str(e_gate_sharding.spec)
+
+
+def test_moe_router_balance_loss_positive():
+    cfg = MoEConfig.debug_moe()
+    model = MoEModel(cfg)
+    params = model.init(jax.random.key(0))
+    toks = jnp.zeros((1, 16), jnp.int32)
+    logits, aux = model.apply_with_aux(params, toks)
+    assert float(aux) > 0
+    assert logits.shape == (1, 16, cfg.vocab_size)
