@@ -1,0 +1,11 @@
+"""ray_tpu.rl — reinforcement learning (RLlib-capability layer).
+
+Reference: RLlib (`rllib/`, SURVEY.md §2.2): AlgorithmConfig/Algorithm,
+EnvRunnerGroup rollout actors, jax Learners (PPO, DQN), env registry.
+"""
+
+from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rl.env import CartPoleEnv, EnvRunner, register_env
+
+__all__ = ["Algorithm", "AlgorithmConfig", "CartPoleEnv", "EnvRunner",
+           "register_env"]

@@ -1,0 +1,114 @@
+"""Algorithm + AlgorithmConfig (reference: `rllib/algorithms/algorithm.py`
+Algorithm.step :986/training_step :2047 and `algorithm_config.py` fluent
+config; `env_runner_group.py` parallel sample + sync_weights
+:570 — SURVEY.md §8.11).
+
+Control loop per iteration: EnvRunner actors sample in parallel →
+learner.update (jitted jax) → broadcast weights back to runners.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.env import EnvRunner, make_env
+
+
+@dataclasses.dataclass
+class AlgorithmConfig:
+    algo: str = "PPO"
+    env: Any = "CartPole-v1"
+    num_env_runners: int = 2
+    rollout_fragment_length: int = 256
+    train_iterations_per_call: int = 1
+    learner_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    seed: int = 0
+
+    # fluent API (reference AlgorithmConfig.environment/.env_runners/...)
+    def environment(self, env) -> "AlgorithmConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, num_env_runners: int,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "AlgorithmConfig":
+        self.num_env_runners = num_env_runners
+        if rollout_fragment_length:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        self.learner_kwargs.update(kwargs)
+        return self
+
+    def build(self) -> "Algorithm":
+        return Algorithm(self)
+
+
+class Algorithm:
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        probe = make_env(config.env, seed=0)
+        obs_dim = probe.obs_dim
+        n_actions = probe.n_actions
+
+        if config.algo.upper() == "PPO":
+            from ray_tpu.rl.ppo import ActorCriticPolicy, PPOLearner
+            self.learner = PPOLearner(obs_dim, n_actions,
+                                      seed=config.seed,
+                                      **config.learner_kwargs)
+            policy_factory = lambda: ActorCriticPolicy(  # noqa: E731
+                obs_dim, n_actions, seed=config.seed)
+        elif config.algo.upper() == "DQN":
+            from ray_tpu.rl.dqn import DQNLearner, QPolicy
+            self.learner = DQNLearner(obs_dim, n_actions,
+                                      seed=config.seed,
+                                      **config.learner_kwargs)
+            policy_factory = lambda: QPolicy(  # noqa: E731
+                obs_dim, n_actions, seed=config.seed)
+        else:
+            raise ValueError(f"unknown algo {config.algo!r}")
+
+        runner_cls = ray_tpu.remote(EnvRunner)
+        self.runners = [
+            runner_cls.remote(config.env, policy_factory,
+                              seed=config.seed + 1 + i)
+            for i in range(config.num_env_runners)]
+        self._sync_weights()
+        self.iteration = 0
+
+    def _sync_weights(self) -> None:
+        w = ray_tpu.put(self.learner.get_weights())
+        ray_tpu.get([r.set_weights.remote(w) for r in self.runners])
+
+    def train(self) -> Dict[str, Any]:
+        """One training iteration (reference Algorithm.step)."""
+        cfg = self.config
+        metrics: Dict[str, Any] = {}
+        for _ in range(cfg.train_iterations_per_call):
+            rollouts = ray_tpu.get([
+                r.sample.remote(cfg.rollout_fragment_length)
+                for r in self.runners])
+            metrics = self.learner.update(rollouts)
+            self._sync_weights()
+        self.iteration += 1
+        returns = [x for r in self.runners
+                   for x in ray_tpu.get(r.episode_returns.remote())]
+        metrics.update({
+            "training_iteration": self.iteration,
+            "episode_return_mean": float(np.mean(returns))
+            if returns else float("nan"),
+            "num_episodes": len(returns),
+        })
+        return metrics
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,142 @@
+"""DQN learner (reference: `rllib/algorithms/dqn/` — replay buffer,
+target network, epsilon-greedy)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.ppo import _mlp_apply, _mlp_init
+
+
+class ReplayBuffer:
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros(capacity, np.int32)
+        self.rewards = np.zeros(capacity, np.float32)
+        self.dones = np.zeros(capacity, np.bool_)
+        self.size = 0
+        self.pos = 0
+        self.rng = np.random.default_rng(seed)
+
+    def add_rollout(self, r: Dict[str, np.ndarray]) -> None:
+        T = len(r["rewards"])
+        obs = r["obs"]
+        next_obs = np.concatenate([obs[1:], r["next_obs_last"][None]])
+        # episode boundaries: next_obs after done is a reset obs — the
+        # (1 - done) mask in the target makes the value irrelevant.
+        for t in range(T):
+            i = self.pos
+            self.obs[i] = obs[t]
+            self.next_obs[i] = next_obs[t]
+            self.actions[i] = r["actions"][t]
+            self.rewards[i] = r["rewards"][t]
+            self.dones[i] = r["dones"][t]
+            self.pos = (self.pos + 1) % self.capacity
+            self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self.rng.integers(0, self.size, batch_size)
+        return {"obs": self.obs[idx], "next_obs": self.next_obs[idx],
+                "actions": self.actions[idx],
+                "rewards": self.rewards[idx], "dones": self.dones[idx]}
+
+
+class QPolicy:
+    """Epsilon-greedy behavior policy over a Q-network."""
+
+    def __init__(self, obs_dim: int, n_actions: int, hidden=(64, 64),
+                 seed: int = 0, epsilon: float = 1.0):
+        self.params = {"q": _mlp_init(jax.random.key(seed),
+                                      [obs_dim, *hidden, n_actions])}
+        self.n_actions = n_actions
+        self.epsilon = epsilon
+        self._rng = np.random.default_rng(seed)
+        self._np_q = jax.tree.map(np.asarray, self.params["q"])
+
+    def set_weights(self, payload):
+        params, epsilon = payload
+        self.params = params
+        self.epsilon = epsilon
+        self._np_q = jax.tree.map(np.asarray, self.params["q"])
+
+    def act(self, obs: np.ndarray) -> Tuple[int, float]:
+        if self._rng.random() < self.epsilon:
+            return int(self._rng.integers(self.n_actions)), 0.0
+        x = obs
+        n = len(self._np_q)
+        for i, layer in enumerate(self._np_q):
+            x = x @ layer["w"] + layer["b"]
+            if i < n - 1:
+                x = np.tanh(x)
+        return int(np.argmax(x)), 0.0
+
+
+class DQNLearner:
+    def __init__(self, obs_dim: int, n_actions: int, *, hidden=(64, 64),
+                 lr: float = 1e-3, gamma: float = 0.99,
+                 buffer_size: int = 50_000, batch_size: int = 64,
+                 target_update_every: int = 10,
+                 epsilon_decay: float = 0.97, epsilon_min: float = 0.05,
+                 updates_per_iter: int = 32, seed: int = 0):
+        self.policy = QPolicy(obs_dim, n_actions, hidden, seed)
+        self.target_params = jax.tree.map(jnp.copy, self.policy.params)
+        self.buffer = ReplayBuffer(buffer_size, obs_dim, seed)
+        self.optimizer = optax.adam(lr)
+        self.opt_state = self.optimizer.init(self.policy.params)
+        self.gamma = gamma
+        self.batch_size = batch_size
+        self.target_update_every = target_update_every
+        self.epsilon_decay = epsilon_decay
+        self.epsilon_min = epsilon_min
+        self.updates_per_iter = updates_per_iter
+        self._updates = 0
+        self._step = jax.jit(self._step_impl)
+
+    def _step_impl(self, params, target, opt_state, batch):
+        def loss_fn(p):
+            q = _mlp_apply(p["q"], batch["obs"])
+            q_sel = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=1)[:, 0]
+            q_next = _mlp_apply(target["q"], batch["next_obs"])
+            tgt = batch["rewards"] + self.gamma * jnp.max(q_next, -1) * (
+                1.0 - batch["dones"].astype(jnp.float32))
+            return jnp.mean((q_sel - jax.lax.stop_gradient(tgt)) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def update(self, rollouts: List[Dict[str, np.ndarray]]
+               ) -> Dict[str, float]:
+        for r in rollouts:
+            self.buffer.add_rollout(r)
+        if self.buffer.size < self.batch_size:
+            return {"td_loss": float("nan")}
+        loss = 0.0
+        for _ in range(self.updates_per_iter):
+            batch = {k: jnp.asarray(v)
+                     for k, v in self.buffer.sample(self.batch_size)
+                     .items()}
+            self.policy.params, self.opt_state, loss = self._step(
+                self.policy.params, self.target_params, self.opt_state,
+                batch)
+            self._updates += 1
+            if self._updates % self.target_update_every == 0:
+                self.target_params = jax.tree.map(jnp.copy,
+                                                  self.policy.params)
+        self.policy.epsilon = max(self.epsilon_min,
+                                  self.policy.epsilon
+                                  * self.epsilon_decay)
+        return {"td_loss": float(loss),
+                "epsilon": self.policy.epsilon}
+
+    def get_weights(self):
+        return (self.policy.params, self.policy.epsilon)

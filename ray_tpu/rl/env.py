@@ -1,0 +1,128 @@
+"""RL environments + EnvRunner actors.
+
+Reference: RLlib `rllib/env/env_runner_group.py` (rollout worker actors),
+`rllib/env/single_agent_env_runner.py`. Env API is gymnasium-shaped:
+reset() -> (obs, info); step(a) -> (obs, reward, terminated, truncated,
+info). CartPole ships in-tree (classic dynamics) so tests need no gym.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class CartPoleEnv:
+    """Classic cart-pole balancing (standard physics constants)."""
+
+    n_actions = 2
+    obs_dim = 4
+
+    def __init__(self, seed: int = 0, max_steps: int = 500):
+        self.rng = np.random.default_rng(seed)
+        self.max_steps = max_steps
+        self.gravity = 9.8
+        self.masscart, self.masspole = 1.0, 0.1
+        self.length = 0.5
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_lim = 12 * 2 * np.pi / 360
+        self.x_lim = 2.4
+        self._steps = 0
+        self.state = None
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self.state = self.rng.uniform(-0.05, 0.05, size=4)
+        self._steps = 0
+        return self.state.astype(np.float32), {}
+
+    def step(self, action: int):
+        x, x_dot, th, th_dot = self.state
+        force = self.force_mag if action == 1 else -self.force_mag
+        costh, sinth = np.cos(th), np.sin(th)
+        total_mass = self.masscart + self.masspole
+        pml = self.masspole * self.length
+        temp = (force + pml * th_dot ** 2 * sinth) / total_mass
+        th_acc = (self.gravity * sinth - costh * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costh ** 2
+                           / total_mass))
+        x_acc = temp - pml * th_acc * costh / total_mass
+        x += self.tau * x_dot
+        x_dot += self.tau * x_acc
+        th += self.tau * th_dot
+        th_dot += self.tau * th_acc
+        self.state = np.array([x, x_dot, th, th_dot])
+        self._steps += 1
+        terminated = bool(abs(x) > self.x_lim or abs(th) > self.theta_lim)
+        truncated = self._steps >= self.max_steps
+        return (self.state.astype(np.float32), 1.0, terminated, truncated,
+                {})
+
+
+ENV_REGISTRY: Dict[str, Callable] = {"CartPole-v1": CartPoleEnv}
+
+
+def register_env(name: str, creator: Callable) -> None:
+    ENV_REGISTRY[name] = creator
+
+
+def make_env(name_or_creator, seed: int = 0):
+    if callable(name_or_creator):
+        return name_or_creator(seed)
+    creator = ENV_REGISTRY.get(name_or_creator)
+    if creator is None:
+        raise KeyError(f"unknown env {name_or_creator!r} "
+                       f"(register_env first)")
+    return creator(seed=seed)
+
+
+class EnvRunner:
+    """Actor: collects rollouts with the current policy weights."""
+
+    def __init__(self, env_spec, policy_factory, seed: int = 0):
+        self.env = make_env(env_spec, seed=seed)
+        self.policy = policy_factory()
+        self.seed = seed
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_return = 0.0
+        self.completed_returns: List[float] = []
+
+    def set_weights(self, weights) -> None:
+        self.policy.set_weights(weights)
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Collect num_steps transitions (episodes auto-reset)."""
+        obs_buf, act_buf, rew_buf, done_buf, logp_buf = [], [], [], [], []
+        for _ in range(num_steps):
+            action, logp = self.policy.act(self._obs)
+            nobs, rew, term, trunc, _ = self.env.step(action)
+            obs_buf.append(self._obs)
+            act_buf.append(action)
+            rew_buf.append(rew)
+            done_buf.append(term or trunc)
+            logp_buf.append(logp)
+            self._episode_return += rew
+            if term or trunc:
+                self.completed_returns.append(self._episode_return)
+                self._episode_return = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = nobs
+        obs_buf.append(self._obs)   # bootstrap observation
+        return {
+            "obs": np.asarray(obs_buf[:-1], np.float32),
+            "next_obs_last": np.asarray(obs_buf[-1], np.float32),
+            "actions": np.asarray(act_buf, np.int32),
+            "rewards": np.asarray(rew_buf, np.float32),
+            "dones": np.asarray(done_buf, np.bool_),
+            "logp": np.asarray(logp_buf, np.float32),
+        }
+
+    def episode_returns(self, clear: bool = True) -> List[float]:
+        out = list(self.completed_returns)
+        if clear:
+            self.completed_returns = []
+        return out

@@ -1,0 +1,179 @@
+"""PPO learner (reference: `rllib/algorithms/ppo/` — clipped surrogate +
+GAE; the Learner role of `rllib/core/learner/learner.py:108`).
+
+Policy/value network and update are jitted jax; rollout-time action
+sampling runs the same network on host-side numpy weights.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def _mlp_init(rng, sizes) -> List[Dict]:
+    params = []
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for k, din, dout in zip(keys, sizes[:-1], sizes[1:]):
+        params.append({
+            "w": jax.random.normal(k, (din, dout), jnp.float32)
+            * (2.0 / din) ** 0.5,
+            "b": jnp.zeros((dout,), jnp.float32)})
+    return params
+
+
+def _mlp_apply(params, x, final_tanh=False):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class ActorCriticPolicy:
+    """Shared-nothing actor/critic MLPs with numpy act() for rollouts."""
+
+    def __init__(self, obs_dim: int, n_actions: int, hidden=(64, 64),
+                 seed: int = 0):
+        rng = jax.random.key(seed)
+        k1, k2 = jax.random.split(rng)
+        self.params = {
+            "pi": _mlp_init(k1, [obs_dim, *hidden, n_actions]),
+            "vf": _mlp_init(k2, [obs_dim, *hidden, 1]),
+        }
+        self._np_pi = None
+        self._rng = np.random.default_rng(seed)
+        self._sync_np()
+
+    def _sync_np(self):
+        self._np_pi = jax.tree.map(np.asarray, self.params["pi"])
+
+    def set_weights(self, params):
+        self.params = params
+        self._sync_np()
+
+    def get_weights(self):
+        return self.params
+
+    def act(self, obs: np.ndarray) -> Tuple[int, float]:
+        x = obs
+        n = len(self._np_pi)
+        for i, layer in enumerate(self._np_pi):
+            x = x @ layer["w"] + layer["b"]
+            if i < n - 1:
+                x = np.tanh(x)
+        z = x - x.max()
+        p = np.exp(z)
+        p /= p.sum()
+        a = int(self._rng.choice(len(p), p=p))
+        return a, float(np.log(p[a] + 1e-9))
+
+
+def compute_gae(rewards, dones, values, last_value, gamma=0.99,
+                lam=0.95):
+    """Host-side GAE over a rollout (numpy; T small)."""
+    T = len(rewards)
+    adv = np.zeros(T, np.float32)
+    last = 0.0
+    for t in range(T - 1, -1, -1):
+        nonterm = 0.0 if dones[t] else 1.0
+        next_v = last_value if t == T - 1 else values[t + 1]
+        delta = rewards[t] + gamma * next_v * nonterm - values[t]
+        last = delta + gamma * lam * nonterm * last
+        adv[t] = last
+    returns = adv + values
+    return adv, returns
+
+
+class PPOLearner:
+    def __init__(self, obs_dim: int, n_actions: int, *, hidden=(64, 64),
+                 lr: float = 3e-4, clip: float = 0.2, vf_coef: float = 0.5,
+                 ent_coef: float = 0.01, epochs: int = 4,
+                 minibatch_size: int = 128, gamma: float = 0.99,
+                 gae_lambda: float = 0.95, seed: int = 0):
+        self.policy = ActorCriticPolicy(obs_dim, n_actions, hidden, seed)
+        self.optimizer = optax.adam(lr)
+        self.opt_state = self.optimizer.init(self.policy.params)
+        self.clip = clip
+        self.vf_coef = vf_coef
+        self.ent_coef = ent_coef
+        self.epochs = epochs
+        self.minibatch_size = minibatch_size
+        self.gamma = gamma
+        self.lam = gae_lambda
+        self._rng = np.random.default_rng(seed)
+        self._update = jax.jit(self._update_impl)
+        self._values = jax.jit(
+            lambda params, obs: _mlp_apply(params["vf"], obs)[:, 0])
+
+    def _update_impl(self, params, opt_state, batch):
+        def loss_fn(p):
+            logits = _mlp_apply(p["pi"], batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=1)[:, 0]
+            ratio = jnp.exp(logp - batch["logp_old"])
+            adv = batch["adv"]
+            unclipped = ratio * adv
+            clipped = jnp.clip(ratio, 1 - self.clip, 1 + self.clip) * adv
+            pi_loss = -jnp.mean(jnp.minimum(unclipped, clipped))
+            v = _mlp_apply(p["vf"], batch["obs"])[:, 0]
+            vf_loss = jnp.mean((v - batch["returns"]) ** 2)
+            ent = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = pi_loss + self.vf_coef * vf_loss - self.ent_coef * ent
+            return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                           "entropy": ent}
+
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = self.optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics["total_loss"] = loss
+        return params, opt_state, metrics
+
+    def update(self, rollouts: List[Dict[str, np.ndarray]]
+               ) -> Dict[str, float]:
+        """GAE + minibatched clipped-surrogate epochs over the rollouts."""
+        obs = np.concatenate([r["obs"] for r in rollouts])
+        actions = np.concatenate([r["actions"] for r in rollouts])
+        logp_old = np.concatenate([r["logp"] for r in rollouts])
+        advs, rets = [], []
+        for r in rollouts:
+            values = np.asarray(self._values(self.policy.params,
+                                             jnp.asarray(r["obs"])))
+            last_v = float(self._values(
+                self.policy.params,
+                jnp.asarray(r["next_obs_last"][None]))[0])
+            adv, ret = compute_gae(r["rewards"], r["dones"], values,
+                                   last_v, self.gamma, self.lam)
+            advs.append(adv)
+            rets.append(ret)
+        adv = np.concatenate(advs)
+        ret = np.concatenate(rets)
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        n = len(obs)
+        metrics = {}
+        for _ in range(self.epochs):
+            perm = self._rng.permutation(n)
+            for lo in range(0, n, self.minibatch_size):
+                idx = perm[lo:lo + self.minibatch_size]
+                batch = {
+                    "obs": jnp.asarray(obs[idx]),
+                    "actions": jnp.asarray(actions[idx]),
+                    "logp_old": jnp.asarray(logp_old[idx]),
+                    "adv": jnp.asarray(adv[idx]),
+                    "returns": jnp.asarray(ret[idx]),
+                }
+                self.policy.params, self.opt_state, metrics = self._update(
+                    self.policy.params, self.opt_state, batch)
+        self.policy._sync_np()
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return self.policy.params

@@ -1,0 +1,89 @@
+"""RL layer: env dynamics, PPO/DQN learning on CartPole."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import Algorithm, AlgorithmConfig, CartPoleEnv
+
+
+def test_cartpole_dynamics():
+    env = CartPoleEnv(seed=0)
+    obs, _ = env.reset()
+    assert obs.shape == (4,)
+    total = 0
+    for _ in range(600):
+        obs, rew, term, trunc, _ = env.step(1)
+        total += rew
+        if term or trunc:
+            break
+    assert term  # always pushing right must topple the pole
+    assert 5 < total < 200
+
+
+def test_ppo_improves_on_cartpole(ray_start_regular):
+    algo = (AlgorithmConfig(algo="PPO")
+            .environment("CartPole-v1")
+            .env_runners(2, rollout_fragment_length=256)
+            .training(lr=1e-3, epochs=4, minibatch_size=128)
+            .build())
+    try:
+        first = None
+        last = None
+        for i in range(12):
+            m = algo.train()
+            if first is None and np.isfinite(m["episode_return_mean"]):
+                first = m["episode_return_mean"]
+            if np.isfinite(m["episode_return_mean"]):
+                last = m["episode_return_mean"]
+        assert first is not None and last is not None
+        assert last > first  # learning happened
+        assert last > 40     # clearly better than random (~20)
+    finally:
+        algo.stop()
+
+
+def test_dqn_runs_and_decays_epsilon(ray_start_regular):
+    algo = (AlgorithmConfig(algo="DQN")
+            .environment("CartPole-v1")
+            .env_runners(2, rollout_fragment_length=128)
+            .training(lr=1e-3, updates_per_iter=16)
+            .build())
+    try:
+        eps = []
+        for _ in range(4):
+            m = algo.train()
+            eps.append(m["epsilon"])
+        assert eps[-1] < eps[0]
+        assert np.isfinite(m["td_loss"])
+    finally:
+        algo.stop()
+
+
+def test_custom_env_registry(ray_start_regular):
+    from ray_tpu.rl import register_env
+
+    class ConstEnv:
+        n_actions = 2
+        obs_dim = 2
+
+        def __init__(self, seed=0):
+            self.t = 0
+
+        def reset(self, seed=None):
+            self.t = 0
+            return np.zeros(2, np.float32), {}
+
+        def step(self, a):
+            self.t += 1
+            return (np.zeros(2, np.float32), float(a), False,
+                    self.t >= 10, {})
+
+    register_env("Const-v0", lambda seed=0: ConstEnv(seed))
+    algo = (AlgorithmConfig(algo="PPO").environment("Const-v0")
+            .env_runners(1, rollout_fragment_length=64).build())
+    try:
+        m = algo.train()
+        assert m["num_episodes"] > 0
+    finally:
+        algo.stop()
