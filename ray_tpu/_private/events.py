@@ -1,0 +1,71 @@
+"""Task event buffer + chrome-trace timeline export.
+
+Reference: `src/ray/core_worker/task_event_buffer.cc` (per-worker event
+buffering) → `gcs/gcs_task_manager.h:94` (cluster task events) →
+`ray timeline` chrome-trace dump (`_private/state.py:438`).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+
+class TaskEventBuffer:
+    """Ring buffer of task lifecycle events."""
+
+    def __init__(self, capacity: int = 100_000):
+        self._events: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    def record(self, *, task_id: str, name: str, event: str,
+               node_id: str = "", actor_id: str = "",
+               extra: Optional[Dict] = None) -> None:
+        with self._lock:
+            self._events.append({
+                "task_id": task_id, "name": name, "event": event,
+                "node_id": node_id, "actor_id": actor_id,
+                "ts_us": (time.perf_counter() - self._t0) * 1e6,
+                "wall_ts": time.time(),
+                **(extra or {})})
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    # -- chrome trace ----------------------------------------------------
+    def chrome_trace(self) -> List[Dict[str, Any]]:
+        """Pair RUNNING/FINISHED events into chrome 'X' duration slices."""
+        started: Dict[str, Dict] = {}
+        slices: List[Dict[str, Any]] = []
+        for ev in self.events():
+            if ev["event"] == "RUNNING":
+                started[ev["task_id"]] = ev
+            elif ev["event"] in ("FINISHED", "FAILED"):
+                beg = started.pop(ev["task_id"], None)
+                if beg is None:
+                    continue
+                slices.append({
+                    "name": ev["name"] or ev["task_id"][:8],
+                    "cat": "task",
+                    "ph": "X",
+                    "ts": beg["ts_us"],
+                    "dur": max(ev["ts_us"] - beg["ts_us"], 1.0),
+                    "pid": ev["node_id"][:8] or "driver",
+                    "tid": ev["task_id"][:8],
+                    "args": {"status": ev["event"]},
+                })
+        return slices
+
+    def dump_chrome_trace(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path

@@ -1,0 +1,75 @@
+"""Core-op microbenchmarks (reference: `python/ray/_private/ray_perf.py:95`
+— the harness behind `ray microbenchmark`)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List
+
+import numpy as np
+
+
+def _timeit(name: str, fn: Callable[[], int],
+            duration_s: float = 2.0) -> Dict:
+    # warmup
+    fn()
+    t0 = time.perf_counter()
+    count = 0
+    while time.perf_counter() - t0 < duration_s:
+        count += fn()
+    dt = time.perf_counter() - t0
+    return {"name": name, "throughput_per_s": round(count / dt, 1),
+            "count": count, "seconds": round(dt, 3)}
+
+
+def run_microbenchmarks(duration_s: float = 2.0) -> List[Dict]:
+    """Boot a runtime and measure core-op throughputs."""
+    import ray_tpu
+
+    own = not ray_tpu.is_initialized()
+    if own:
+        ray_tpu.init(num_nodes=1, resources={"CPU": 8})
+    results: List[Dict] = []
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    def tasks_batch():
+        ray_tpu.get([noop.remote() for _ in range(100)])
+        return 100
+    results.append(_timeit("tasks_per_second", tasks_batch, duration_s))
+
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return None
+
+    a = A.remote()
+
+    def actor_batch():
+        ray_tpu.get([a.m.remote() for _ in range(100)])
+        return 100
+    results.append(_timeit("actor_calls_per_second", actor_batch,
+                           duration_s))
+
+    small = np.zeros(8, np.float64)
+
+    def put_small():
+        refs = [ray_tpu.put(small) for _ in range(100)]
+        del refs
+        return 100
+    results.append(_timeit("puts_small_per_second", put_small, duration_s))
+
+    big = np.zeros(1024 * 1024, np.uint8)  # 1 MiB
+
+    def put_get_1mb():
+        for _ in range(10):
+            ray_tpu.get(ray_tpu.put(big))
+        return 10
+    results.append(_timeit("put_get_1MiB_per_second", put_get_1mb,
+                           duration_s))
+
+    if own:
+        ray_tpu.shutdown()
+    return results

@@ -178,6 +178,8 @@ class Runtime:
         self.stats = {"tasks_submitted": 0, "tasks_finished": 0,
                       "tasks_retried": 0, "objects_reconstructed": 0,
                       "actor_restarts": 0}
+        from ray_tpu._private.events import TaskEventBuffer
+        self.task_events = TaskEventBuffer()
 
         if resources_per_node is None:
             resources_per_node = self._detect_resources()
@@ -571,6 +573,9 @@ class Runtime:
         if spec.kind == TaskKind.ACTOR_CREATION:
             self._execute_actor_creation(spec, node)
             return
+        self.task_events.record(
+            task_id=spec.task_id.hex(), name=spec.name, event="RUNNING",
+            node_id=node.node_id.hex())
         try:
             args, kwargs = self._resolve_args(spec)
         except exc.TaskError as te:
@@ -624,10 +629,14 @@ class Runtime:
             self.on_node_task_lost(spec, node)
             return
         if error is not None:
+            self.task_events.record(task_id=spec.task_id.hex(),
+                                    name=spec.name, event="FAILED")
             if self._maybe_retry_app_error(spec, error):
                 return
             self._fail_task(spec, error)
             return
+        self.task_events.record(task_id=spec.task_id.hex(),
+                                name=spec.name, event="FINISHED")
         values: List[Any]
         n = spec.num_returns
         if n == 1 or not isinstance(n, int):

@@ -1,0 +1,8 @@
+"""Dashboard (reference: `dashboard/head.py:48` + modules): REST state
+endpoints + Prometheus metrics over a threaded stdlib HTTP server (the
+React frontend of the reference is out of scope; the API surface is the
+parity target)."""
+
+from ray_tpu.dashboard.server import start_dashboard
+
+__all__ = ["start_dashboard"]

@@ -1,0 +1,103 @@
+"""Dashboard HTTP server.
+
+Endpoints (reference: dashboard modules `node`, `state`, `metrics`,
+`job` — SURVEY.md §1 L3):
+  GET /api/nodes              cluster nodes + resources
+  GET /api/tasks              task table
+  GET /api/actors             actor table
+  GET /api/placement_groups   placement groups
+  GET /api/objects            object table
+  GET /api/cluster_status     resources + runtime stats summary
+  GET /api/timeline           chrome-trace JSON of task events
+  GET /metrics                Prometheus exposition
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+
+class _DashboardHandler(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def _json(self, payload, code: int = 200) -> None:
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _text(self, text: str, code: int = 200) -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        from ray_tpu._private import worker as _worker
+        from ray_tpu.util import state as state_api
+        from ray_tpu.util.metrics import prometheus_text
+
+        path = self.path.split("?")[0].rstrip("/")
+        try:
+            if path == "/metrics":
+                self._text(prometheus_text())
+            elif path == "/api/nodes":
+                self._json(state_api.list_nodes())
+            elif path == "/api/tasks":
+                self._json(state_api.list_tasks())
+            elif path == "/api/actors":
+                self._json(state_api.list_actors())
+            elif path == "/api/placement_groups":
+                self._json(state_api.list_placement_groups())
+            elif path == "/api/objects":
+                self._json(state_api.list_objects())
+            elif path == "/api/timeline":
+                self._json(state_api.timeline())
+            elif path == "/api/cluster_status":
+                rt = _worker.global_runtime()
+                import ray_tpu
+                self._json({
+                    "cluster_resources": ray_tpu.cluster_resources(),
+                    "available_resources": ray_tpu.available_resources(),
+                    "stats": dict(rt.stats),
+                    "task_summary": state_api.summarize_tasks(),
+                })
+            elif path in ("", "/", "/api"):
+                self._json({"endpoints": [
+                    "/api/nodes", "/api/tasks", "/api/actors",
+                    "/api/placement_groups", "/api/objects",
+                    "/api/cluster_status", "/api/timeline", "/metrics"]})
+            else:
+                self._json({"error": f"unknown path {path}"}, 404)
+        except Exception as e:
+            self._json({"error": repr(e)}, 500)
+
+
+_server: Optional[ThreadingHTTPServer] = None
+
+
+def start_dashboard(port: int = 8265, host: str = "127.0.0.1"
+                    ) -> Tuple[str, int]:
+    """Start (or return the running) dashboard; returns (host, port)."""
+    global _server
+    if _server is not None:
+        return _server.server_address
+    _server = ThreadingHTTPServer((host, port), _DashboardHandler)
+    threading.Thread(target=_server.serve_forever, daemon=True,
+                     name="dashboard").start()
+    return _server.server_address
+
+
+def stop_dashboard() -> None:
+    global _server
+    if _server is not None:
+        _server.shutdown()
+        _server = None

@@ -1,0 +1,7 @@
+"""Job submission (reference: `dashboard/modules/job/job_manager.py:60` —
+JobManager spawning a per-job supervisor actor that runs the entrypoint
+as a subprocess, with status + log retrieval, SDK + CLI)."""
+
+from ray_tpu.job.manager import (JobInfo, JobStatus, JobSubmissionClient)
+
+__all__ = ["JobSubmissionClient", "JobStatus", "JobInfo"]

@@ -1,0 +1,123 @@
+"""`ray-tpu` CLI (reference: `python/ray/scripts/scripts.py` — status,
+memory, timeline, microbenchmark; `ray job` CLI in
+`dashboard/modules/job/cli.py`)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _init_runtime(args):
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_nodes=args.num_nodes)
+    return ray_tpu
+
+
+def cmd_status(args) -> int:
+    ray_tpu = _init_runtime(args)
+    from ray_tpu.util import state as st
+    print(json.dumps({
+        "nodes": st.list_nodes(),
+        "cluster_resources": ray_tpu.cluster_resources(),
+        "available_resources": ray_tpu.available_resources(),
+    }, indent=2, default=str))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    _init_runtime(args)
+    from ray_tpu.util import state as st
+    print(json.dumps({"tasks": st.summarize_tasks(),
+                      "actors": len(st.list_actors()),
+                      "placement_groups": len(st.list_placement_groups())},
+                     indent=2))
+    return 0
+
+
+def cmd_memory(args) -> int:
+    ray_tpu = _init_runtime(args)
+    from ray_tpu._private import worker as _worker
+    rt = _worker.global_runtime()
+    rows = []
+    for node in rt.nodes():
+        rows.append({"node_id": node.node_id.hex()[:16],
+                     "used_bytes": node.store.used_bytes(),
+                     "num_objects": len(node.store.object_ids()),
+                     "stats": dict(node.store.stats)})
+    print(json.dumps(rows, indent=2))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    _init_runtime(args)
+    from ray_tpu.util import state as st
+    path = st.timeline(args.output)
+    print(f"wrote chrome trace to {path}")
+    return 0
+
+
+def cmd_microbenchmark(args) -> int:
+    from ray_tpu._private.perf import run_microbenchmarks
+    for row in run_microbenchmarks(duration_s=args.duration):
+        print(json.dumps(row))
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    _init_runtime(args)
+    from ray_tpu.dashboard import start_dashboard
+    host, port = start_dashboard(port=args.port)
+    print(f"dashboard at http://{host}:{port}")
+    try:
+        import time
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_job_submit(args) -> int:
+    _init_runtime(args)
+    from ray_tpu.job import JobSubmissionClient
+    client = JobSubmissionClient()
+    job_id = client.submit_job(entrypoint=args.entrypoint)
+    status = client.wait_until_finished(job_id, timeout=args.timeout)
+    print(client.get_job_logs(job_id), end="")
+    print(f"job {job_id}: {status}")
+    return 0 if status == "SUCCEEDED" else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ray-tpu", description="ray_tpu cluster CLI")
+    parser.add_argument("--num-nodes", type=int, default=1)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("status")
+    sub.add_parser("summary")
+    sub.add_parser("memory")
+    p = sub.add_parser("timeline")
+    p.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
+    p = sub.add_parser("microbenchmark")
+    p.add_argument("--duration", type=float, default=2.0)
+    p = sub.add_parser("dashboard")
+    p.add_argument("--port", type=int, default=8265)
+    p = sub.add_parser("job-submit")
+    p.add_argument("entrypoint")
+    p.add_argument("--timeout", type=float, default=300.0)
+
+    args = parser.parse_args(argv)
+    handler = {
+        "status": cmd_status, "summary": cmd_summary,
+        "memory": cmd_memory, "timeline": cmd_timeline,
+        "microbenchmark": cmd_microbenchmark, "dashboard": cmd_dashboard,
+        "job-submit": cmd_job_submit,
+    }[args.command]
+    return handler(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

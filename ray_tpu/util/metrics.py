@@ -1,0 +1,148 @@
+"""User + system metrics (reference: `python/ray/util/metrics.py`
+Counter/Gauge/Histogram over the C++ OpenCensus registry,
+`_private/metrics_agent.py` Prometheus exposition)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_REGISTRY: Dict[str, "Metric"] = {}
+_REG_LOCK = threading.Lock()
+
+
+def _labels_key(labels: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((labels or {}).items()))
+
+
+class Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+        with _REG_LOCK:
+            _REGISTRY[name] = self
+
+    def _set(self, key: Tuple, value: float) -> None:
+        with self._lock:
+            self._values[key] = value
+
+    def _add(self, key: Tuple, delta: float) -> None:
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + delta
+
+    def samples(self) -> List[Tuple[Tuple, float]]:
+        with self._lock:
+            return list(self._values.items())
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        self._add(_labels_key(tags), value)
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        self._set(_labels_key(tags), value)
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = (0.01, 0.1, 1, 10, 100),
+                 tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(boundaries)
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._totals: Dict[Tuple, int] = {}
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        key = _labels_key(tags)
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.boundaries) + 1))
+            i = 0
+            while i < len(self.boundaries) and value > self.boundaries[i]:
+                i += 1
+            counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+
+def registry() -> Dict[str, Metric]:
+    with _REG_LOCK:
+        return dict(_REGISTRY)
+
+
+def clear_registry() -> None:
+    with _REG_LOCK:
+        _REGISTRY.clear()
+
+
+def _fmt_labels(key: Tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def prometheus_text() -> str:
+    """Prometheus exposition format for every registered metric, plus the
+    runtime's system stats as gauges."""
+    lines: List[str] = []
+    for name, metric in sorted(registry().items()):
+        lines.append(f"# HELP {name} {metric.description}")
+        lines.append(f"# TYPE {name} {metric.kind}")
+        if isinstance(metric, Histogram):
+            with metric._lock:
+                for key, counts in metric._counts.items():
+                    cum = 0
+                    for bound, c in zip(metric.boundaries, counts):
+                        cum += c
+                        lk = dict(key)
+                        lk["le"] = str(bound)
+                        lines.append(
+                            f"{name}_bucket{_fmt_labels(tuple(sorted(lk.items())))} {cum}")
+                    lk = dict(key)
+                    lk["le"] = "+Inf"
+                    lines.append(
+                        f"{name}_bucket{_fmt_labels(tuple(sorted(lk.items())))} "
+                        f"{metric._totals[key]}")
+                    lines.append(
+                        f"{name}_sum{_fmt_labels(key)} {metric._sums[key]}")
+                    lines.append(
+                        f"{name}_count{_fmt_labels(key)} "
+                        f"{metric._totals[key]}")
+        else:
+            for key, value in metric.samples():
+                lines.append(f"{name}{_fmt_labels(key)} {value}")
+
+    # system stats
+    try:
+        from ray_tpu._private import worker as _worker
+        rt = _worker.global_runtime()
+        if rt is not None:
+            for k, v in rt.stats.items():
+                lines.append(f"# TYPE ray_tpu_{k} counter")
+                lines.append(f"ray_tpu_{k} {v}")
+            lines.append("# TYPE ray_tpu_nodes_alive gauge")
+            lines.append(
+                f"ray_tpu_nodes_alive "
+                f"{sum(1 for n in rt.nodes() if n.alive)}")
+    except Exception:
+        pass
+    return "\n".join(lines) + "\n"

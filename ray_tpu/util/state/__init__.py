@@ -1,0 +1,9 @@
+"""State API (reference: `python/ray/util/state/api.py` + `state_cli.py`
+— programmatic cluster introspection over GCS/dashboard)."""
+
+from ray_tpu.util.state.api import (list_actors, list_nodes, list_objects,
+                                    list_placement_groups, list_tasks,
+                                    summarize_tasks, timeline)
+
+__all__ = ["list_tasks", "list_actors", "list_objects", "list_nodes",
+           "list_placement_groups", "summarize_tasks", "timeline"]

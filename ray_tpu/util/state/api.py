@@ -1,0 +1,131 @@
+"""Programmatic cluster state introspection.
+
+Reference: `python/ray/util/state/api.py` (list_tasks/list_actors/
+list_objects/list_nodes/list_placement_groups/summarize) backed by
+GcsTaskManager / GCS tables; here backed directly by the runtime tables.
+"""
+
+from __future__ import annotations
+
+from collections import Counter as _Counter
+from typing import Any, Dict, List, Optional
+
+
+def _rt():
+    from ray_tpu._private import worker as _worker
+    rt = _worker.global_runtime()
+    if rt is None:
+        raise RuntimeError("ray_tpu is not initialized")
+    return rt
+
+
+def list_tasks(*, filters: Optional[List] = None,
+               limit: int = 1000) -> List[Dict[str, Any]]:
+    """In-flight tasks from the live table + terminal tasks from the task
+    event buffer (reference: GcsTaskManager keeps completed-task state;
+    the in-flight table alone forgets finished tasks)."""
+    rt = _rt()
+    rows: Dict[str, Dict[str, Any]] = {}
+    for ev in rt.task_events.events():
+        row = rows.setdefault(ev["task_id"], {
+            "task_id": ev["task_id"], "name": ev["name"],
+            "state": ev["event"], "node_id": ev["node_id"] or None,
+            "required_resources": {}})
+        row["state"] = ev["event"]
+        if ev["node_id"]:
+            row["node_id"] = ev["node_id"]
+    with rt._tasks_lock:
+        items = list(rt._tasks.items())
+    for task_id, t in items:
+        rows[task_id.hex()] = {
+            "task_id": task_id.hex(),
+            "name": t.spec.name,
+            "state": t.state.name if hasattr(t.state, "name") else
+            str(t.state),
+            "node_id": t.node_id.hex() if t.node_id else None,
+            "required_resources": dict(t.spec.resources or {}),
+        }
+    return _apply_filters(list(rows.values())[:limit], filters)
+
+
+def list_actors(*, filters: Optional[List] = None,
+                limit: int = 1000) -> List[Dict[str, Any]]:
+    rt = _rt()
+    out = []
+    for actor_id, info in list(rt.gcs.actors.items())[:limit]:
+        out.append({
+            "actor_id": actor_id.hex(),
+            "class_name": getattr(info, "class_name", ""),
+            "name": getattr(info, "name", None),
+            "state": getattr(info, "state", ""),
+            "node_id": (info.node_id.hex()
+                        if getattr(info, "node_id", None) else None),
+            "num_restarts": getattr(info, "num_restarts", 0),
+        })
+    return _apply_filters(out, filters)
+
+
+def list_objects(*, limit: int = 1000) -> List[Dict[str, Any]]:
+    rt = _rt()
+    out = []
+    with rt._loc_lock:
+        locations = {oid: set(nodes) for oid, nodes
+                     in rt._locations.items()}
+    for oid in list(rt.memory_store.object_ids())[:limit]:
+        out.append({"object_id": oid.hex(), "tier": "memory",
+                    "locations": []})
+    for oid, nodes in list(locations.items())[:limit]:
+        out.append({"object_id": oid.hex(), "tier": "node_store",
+                    "locations": [n.hex() for n in nodes]})
+    return out[:limit]
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    rt = _rt()
+    out = []
+    for node_id, info in rt.gcs.nodes.items():
+        out.append({
+            "node_id": node_id.hex(),
+            "alive": info.alive,
+            "resources": dict(info.resources),
+            "labels": dict(getattr(info, "labels", {}) or {}),
+        })
+    return out
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    rt = _rt()
+    out = []
+    for pg_id, pg in rt.gcs.placement_groups.items():
+        out.append({
+            "placement_group_id": pg_id.hex(),
+            "state": getattr(pg, "state", ""),
+            "strategy": getattr(pg, "strategy", ""),
+            "bundles": [dict(b.resources) for b in pg.bundles],
+        })
+    return out
+
+
+def summarize_tasks() -> Dict[str, int]:
+    counts = _Counter(t["state"] for t in list_tasks(limit=100_000))
+    return dict(counts)
+
+
+def timeline(path: Optional[str] = None) -> Any:
+    """Chrome-trace dump of task events (reference: `ray timeline`)."""
+    rt = _rt()
+    if path is not None:
+        return rt.task_events.dump_chrome_trace(path)
+    return rt.task_events.chrome_trace()
+
+
+def _apply_filters(rows: List[Dict], filters: Optional[List]
+                   ) -> List[Dict]:
+    if not filters:
+        return rows
+    for key, op, value in filters:
+        if op == "=":
+            rows = [r for r in rows if str(r.get(key)) == str(value)]
+        elif op == "!=":
+            rows = [r for r in rows if str(r.get(key)) != str(value)]
+    return rows

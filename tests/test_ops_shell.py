@@ -1,0 +1,158 @@
+"""Ops shell: state API, metrics, dashboard, jobs, autoscaler, CLI,
+timeline."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as rt_metrics
+from ray_tpu.util import state as state_api
+
+
+def test_state_api_lists(ray_start_cluster):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.options(name="state_test_actor").remote()
+    ray_tpu.get(a.ping.remote())
+    ray_tpu.get([f.remote() for _ in range(5)])
+
+    nodes = state_api.list_nodes()
+    assert len(nodes) == 4 and all(n["alive"] for n in nodes)
+    actors = state_api.list_actors()
+    assert any(x["name"] == "state_test_actor" for x in actors)
+    tasks = state_api.list_tasks()
+    assert len(tasks) >= 5
+    summary = state_api.summarize_tasks()
+    assert sum(summary.values()) == len(tasks)
+
+
+def test_timeline_chrome_trace(ray_start_regular, tmp_path):
+    @ray_tpu.remote
+    def work():
+        time.sleep(0.01)
+        return 1
+
+    ray_tpu.get([work.remote() for _ in range(3)])
+    trace = state_api.timeline()
+    named = [s for s in trace if "work" in s["name"]]
+    assert len(named) >= 3
+    assert all(s["ph"] == "X" and s["dur"] > 0 for s in named)
+    path = state_api.timeline(str(tmp_path / "trace.json"))
+    assert json.load(open(path))
+
+
+def test_metrics_prometheus_text(ray_start_regular):
+    rt_metrics.clear_registry()
+    c = rt_metrics.Counter("my_requests", "test counter", ("route",))
+    c.inc(3, tags={"route": "/a"})
+    g = rt_metrics.Gauge("my_depth", "test gauge")
+    g.set(7.5)
+    h = rt_metrics.Histogram("my_lat", "test hist", boundaries=(1, 10))
+    h.observe(0.5)
+    h.observe(5)
+    h.observe(50)
+    text = rt_metrics.prometheus_text()
+    assert 'my_requests{route="/a"} 3.0' in text
+    assert "my_depth 7.5" in text
+    assert "my_lat_count 3" in text
+    assert "ray_tpu_tasks_finished" in text
+
+
+def test_dashboard_endpoints(ray_start_regular):
+    from ray_tpu.dashboard.server import start_dashboard, stop_dashboard
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    host, port = start_dashboard(port=0)
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}{path}", timeout=10) as r:
+                return r.read().decode()
+        nodes = json.loads(get("/api/nodes"))
+        assert len(nodes) == 1
+        status = json.loads(get("/api/cluster_status"))
+        assert status["stats"]["tasks_finished"] >= 1
+        assert "ray_tpu_tasks_finished" in get("/metrics")
+        assert json.loads(get("/api/timeline"))
+    finally:
+        stop_dashboard()
+
+
+def test_job_submission(ray_start_regular):
+    from ray_tpu.job import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint="echo hello_from_job && echo line2")
+    status = client.wait_until_finished(job_id, timeout=30)
+    assert status == "SUCCEEDED"
+    logs = client.get_job_logs(job_id)
+    assert "hello_from_job" in logs and "line2" in logs
+
+    bad = client.submit_job(entrypoint="exit 3")
+    assert client.wait_until_finished(bad, timeout=30) == "FAILED"
+    assert client.get_job_info(bad).returncode == 3
+    assert len(client.list_jobs()) == 2
+
+
+def test_autoscaler_scales_up_and_down(ray_start_regular):
+    from ray_tpu._private import worker as _worker
+    from ray_tpu.autoscaler import FakeNodeProvider, StandardAutoscaler
+
+    rt = _worker.global_runtime()
+    provider = FakeNodeProvider(rt, {"CPU": 4})
+    scaler = StandardAutoscaler(rt, provider, min_nodes=1, max_nodes=4,
+                                idle_timeout_s=0.5)
+
+    # more parallel work than one 8-CPU node can run
+    @ray_tpu.remote(num_cpus=4)
+    def slow():
+        time.sleep(1.5)
+        return 1
+
+    refs = [slow.remote() for _ in range(6)]  # 24 CPUs of demand
+    time.sleep(0.2)
+    scaler.update()
+    assert scaler.stats["launched"] >= 1
+    scaler.update()
+    launched = scaler.stats["launched"]
+    assert launched >= 2
+    assert ray_tpu.get(refs, timeout=60) == [1] * 6
+    # idle: scale back down
+    deadline = time.time() + 15
+    while time.time() < deadline and provider.non_terminated_nodes():
+        scaler.update()
+        time.sleep(0.3)
+    assert not provider.non_terminated_nodes()
+
+
+def test_cli_status_and_summary(ray_start_regular, capsys):
+    from ray_tpu.scripts.cli import main
+
+    assert main(["status"]) == 0
+    out = capsys.readouterr().out
+    assert "cluster_resources" in out
+    assert main(["summary"]) == 0
+
+
+def test_microbenchmark_harness(ray_start_regular):
+    from ray_tpu._private.perf import run_microbenchmarks
+
+    results = run_microbenchmarks(duration_s=0.3)
+    names = {r["name"] for r in results}
+    assert "tasks_per_second" in names
+    assert all(r["throughput_per_s"] > 0 for r in results)
