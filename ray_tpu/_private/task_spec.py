@@ -167,6 +167,7 @@ class TaskSpec:
     backpressure_num_objects: int = -1
     enable_task_events: bool = True
     label_selector: Optional[Dict[str, Any]] = None
+    runtime_env: Optional[Dict[str, Any]] = None
 
     def dependencies(self) -> List[ObjectID]:
         """ObjectIDs this task's args depend on (top-level refs only)."""

@@ -586,8 +586,10 @@ class Runtime:
             actor_id=None, resources=spec.resources, task_name=spec.name,
             placement_group_id=spec.placement_group_id,
             pg_capture=spec.pg_capture)
+        from ray_tpu.runtime_env import apply_runtime_env
         try:
-            result = spec.func(*args, **kwargs)
+            with apply_runtime_env(spec.runtime_env):
+                result = spec.func(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
             self._finish_task(spec, node,
                               error=exc.TaskError(e, spec.name))
@@ -787,8 +789,10 @@ class Runtime:
             actor_id=actor_id, resources=spec.resources, task_name=spec.name,
             placement_group_id=spec.placement_group_id,
             pg_capture=spec.pg_capture)
+        from ray_tpu.runtime_env import apply_runtime_env
         try:
-            instance = spec.func(*args, **kwargs)
+            with apply_runtime_env(spec.runtime_env):
+                instance = spec.func(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
             self._actor_creation_failed(spec, exc.TaskError(e, spec.name),
                                         node)

@@ -34,6 +34,12 @@ class ActorMethod:
         return self._handle._submit_method(
             self._method_name, args, kwargs, self._options)
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node (reference: dag/class_node.py)."""
+        from ray_tpu.dag.node import ClassMethodNode
+        return ClassMethodNode(self._handle, self._method_name, args,
+                               kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"actor method {self._method_name} cannot be called directly; "
@@ -154,6 +160,7 @@ class ActorClass:
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             kind=TaskKind.ACTOR_CREATION,
+            runtime_env=options.get("runtime_env"),
             name=f"{self._cls.__name__}.__init__",
             func=self._cls,
             args=tuple(args),

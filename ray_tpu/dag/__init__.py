@@ -1,0 +1,20 @@
+"""ray_tpu.dag — static DAGs over tasks/actors (Compiled Graphs).
+
+Reference: `python/ray/dag/` (17.7k LoC): DAG nodes built with `.bind()`,
+executed dynamically or compiled (`dag_node.py:280
+experimental_compile`, `compiled_dag_node.py:809`) into a static schedule
+over pre-allocated channels (SURVEY.md §8.10).
+
+TPU-native split: ACCELERATOR dataflow (pipeline/tensor exchange) belongs
+in a single jitted SPMD program (`ray_tpu.parallel.pipeline` — ppermute
+rings ARE the channels). This module keeps the HOST-level capability:
+declarative task/actor DAGs, compiled to a topologically-ordered schedule
+that re-executes without per-call graph traversal.
+"""
+
+from ray_tpu.dag.node import (ClassMethodNode, DAGNode, FunctionNode,
+                              InputNode, MultiOutputNode)
+from ray_tpu.dag.compiled import CompiledDAG
+
+__all__ = ["InputNode", "DAGNode", "FunctionNode", "ClassMethodNode",
+           "MultiOutputNode", "CompiledDAG"]

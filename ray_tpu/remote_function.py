@@ -74,6 +74,15 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs, self._default_options)
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node (reference: dag/function_node.py)."""
+        from ray_tpu.dag.node import FunctionNode
+        return FunctionNode(self, args, kwargs)
+
+    @property
+    def _function_name(self) -> str:
+        return getattr(self._function, "__name__", "fn")
+
     def _remote(self, args, kwargs, options) -> Union[ObjectRef,
                                                       List[ObjectRef],
                                                       ObjectRefGenerator]:
@@ -96,6 +105,7 @@ class RemoteFunction:
             return_ids=[ObjectID.from_random() for _ in range(n_ids)],
             max_retries=options.get("max_retries", 3),
             retry_exceptions=options.get("retry_exceptions", False),
+            runtime_env=options.get("runtime_env"),
             scheduling_strategy=worker.capture_parent_pg_strategy(
                 options.get("scheduling_strategy", "DEFAULT")),
             job_id=rt.job_id,

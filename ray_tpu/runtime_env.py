@@ -1,0 +1,91 @@
+"""Runtime environments (reference: `python/ray/runtime_env/runtime_env.py`
++ `_private/runtime_env/` plugins — pip/uv/conda/working_dir/py_modules/
+container materialized by a per-node agent).
+
+In this single-image runtime the meaningful fields are ``env_vars``
+(applied around execution), ``working_dir``/``py_modules`` (paths put on
+sys.path), and validation of the full reference schema. Package
+materialization (pip/conda/container) requires per-process workers and
+network; those fields validate and no-op with a warning (the environment
+forbids installs — see repo guidelines).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import warnings
+from typing import Any, Dict, List, Optional
+
+_KNOWN_FIELDS = {
+    "env_vars", "working_dir", "py_modules", "pip", "uv", "conda",
+    "container", "image_uri", "excludes", "config",
+}
+
+_env_lock = threading.RLock()
+
+
+class RuntimeEnv(dict):
+    """Validated runtime-env dict (reference: RuntimeEnv class)."""
+
+    def __init__(self, **kwargs):
+        for key in kwargs:
+            if key not in _KNOWN_FIELDS:
+                raise ValueError(
+                    f"unknown runtime_env field {key!r} "
+                    f"(known: {sorted(_KNOWN_FIELDS)})")
+        env_vars = kwargs.get("env_vars")
+        if env_vars is not None:
+            if not isinstance(env_vars, dict) or not all(
+                    isinstance(k, str) and isinstance(v, str)
+                    for k, v in env_vars.items()):
+                raise TypeError("env_vars must be Dict[str, str]")
+        super().__init__(**kwargs)
+
+
+@contextlib.contextmanager
+def apply_runtime_env(runtime_env: Optional[Dict[str, Any]]):
+    """Apply env_vars/py_modules for the duration of a task execution.
+
+    Process-global env mutation is serialized under a lock; the reference
+    applies env at worker-process start (`worker_pool.h` runtime-env hash
+    keying) — virtual in-process workers approximate it per-task.
+    """
+    if not runtime_env:
+        yield
+        return
+    if any(runtime_env.get(k) for k in
+           ("pip", "uv", "conda", "container", "image_uri")):
+        warnings.warn(
+            "runtime_env package materialization (pip/uv/conda/container) "
+            "is a no-op in the single-image runtime", stacklevel=2)
+    env_vars: Dict[str, str] = runtime_env.get("env_vars") or {}
+    paths: List[str] = []
+    wd = runtime_env.get("working_dir")
+    if wd:
+        paths.append(os.path.abspath(wd))
+    for mod in runtime_env.get("py_modules") or []:
+        paths.append(os.path.abspath(mod))
+
+    with _env_lock:
+        saved = {k: os.environ.get(k) for k in env_vars}
+        os.environ.update(env_vars)
+        added = [p for p in paths if p not in sys.path]
+        for p in added:
+            sys.path.insert(0, p)
+    try:
+        yield
+    finally:
+        with _env_lock:
+            for k, old in saved.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+            for p in added:
+                try:
+                    sys.path.remove(p)
+                except ValueError:
+                    pass
