@@ -1,0 +1,13 @@
+"""Ray-Client-equivalent: remote driver over a socket.
+
+Reference: `python/ray/util/client/` (`ray://` mode — a thin client
+proxies API calls over gRPC to a server running inside the cluster,
+`server/server.py:96`). Here the wire is a length-prefixed cloudpickle
+protocol over TCP; the API proxy covers put/get/wait/remote
+functions/actors/kill/cluster_resources.
+"""
+
+from ray_tpu.util.client.server import ClientServer, serve_cluster
+from ray_tpu.util.client.client import ClusterClient, connect
+
+__all__ = ["ClientServer", "serve_cluster", "ClusterClient", "connect"]

@@ -1,0 +1,37 @@
+"""Length-prefixed cloudpickle framing shared by client + server."""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Any
+
+import cloudpickle
+
+_HDR = struct.Struct("!Q")
+MAX_FRAME = 1 << 34
+
+
+def send_msg(sock: socket.socket, obj: Any) -> None:
+    payload = cloudpickle.dumps(obj)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    hdr = _recv_exact(sock, _HDR.size)
+    (length,) = _HDR.unpack(hdr)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    return cloudpickle.loads(_recv_exact(sock, length))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
