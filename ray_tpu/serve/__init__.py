@@ -10,6 +10,7 @@ from ray_tpu.serve.api import (delete, get_app_handle,
                                get_deployment_handle, run, shutdown,
                                start_http_proxy, status)
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import (get_multiplexed_model_id, multiplexed)
 from ray_tpu.serve.deployment import (Application, AutoscalingConfig,
                                       Deployment, deployment)
 from ray_tpu.serve.router import DeploymentHandle, DeploymentResponse
@@ -19,4 +20,5 @@ __all__ = [
     "run", "shutdown", "status", "delete", "get_deployment_handle",
     "get_app_handle", "start_http_proxy",
     "batch", "DeploymentHandle", "DeploymentResponse",
+    "multiplexed", "get_multiplexed_model_id",
 ]

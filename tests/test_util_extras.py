@@ -1,0 +1,55 @@
+"""multiprocessing Pool shim, serve multiplexing, check_serialize."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import Pool
+
+
+def _sq(x):
+    return x * x
+
+
+def test_pool_map(ray_start_regular):
+    with Pool() as p:
+        assert p.map(_sq, range(6)) == [0, 1, 4, 9, 16, 25]
+
+
+def test_pool_starmap_apply_imap(ray_start_regular):
+    with Pool() as p:
+        assert p.starmap(lambda a, b: a + b, [(1, 2), (3, 4)]) == [3, 7]
+        assert p.apply(_sq, (7,)) == 49
+        r = p.apply_async(_sq, (8,))
+        assert r.get(timeout=10) == 64
+        assert list(p.imap(_sq, [1, 2, 3])) == [1, 4, 9]
+        assert sorted(p.imap_unordered(_sq, [1, 2, 3])) == [1, 4, 9]
+
+
+def test_serve_multiplexed(ray_start_regular):
+    from ray_tpu import serve
+
+    loads = []
+
+    @serve.deployment
+    class MuxModel:
+        def __init__(self):
+            self.get_model = serve.multiplexed(
+                max_num_models_per_replica=2)(self._load)
+
+        def _load(self, model_id: str):
+            loads.append(model_id)
+            return {"id": model_id}
+
+        def __call__(self, model_id: str):
+            model = self.get_model(model_id)
+            return model["id"]
+
+    try:
+        handle = serve.run(MuxModel.bind())
+        assert handle.remote("m1").result() == "m1"
+        assert handle.remote("m2").result() == "m2"
+        assert handle.remote("m1").result() == "m1"   # cached
+        n_loads_before = handle.remote("m3").result()  # evicts LRU (m2)
+        assert handle.remote("m2").result() == "m2"    # reloaded
+    finally:
+        serve.shutdown()
