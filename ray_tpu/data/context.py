@@ -1,0 +1,59 @@
+"""DataContext (reference: `python/ray/data/context.py` — thread-inherited
+execution configuration propagated into tasks) + execution stats
+(reference: `data/_internal/stats.py`)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class DataContext:
+    target_max_block_size: int = 128 * 1024 * 1024
+    max_in_flight_tasks_per_operator: int = 8
+    max_operator_output_queue: int = 16
+    default_batch_size: int = 256
+    enable_progress_bars: bool = False
+    eager_free: bool = True
+
+    _local = threading.local()
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        ctx = getattr(cls._local, "ctx", None)
+        if ctx is None:
+            ctx = cls()
+            cls._local.ctx = ctx
+        return ctx
+
+    @classmethod
+    def _set_current(cls, ctx: "DataContext") -> None:
+        cls._local.ctx = ctx
+
+
+class DatasetStats:
+    """Per-dataset execution statistics (operator timings, block counts)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.operators: Dict[str, Dict[str, float]] = {}
+
+    def record(self, op_name: str, *, blocks: int = 0, rows: int = 0,
+               seconds: float = 0.0) -> None:
+        with self._lock:
+            entry = self.operators.setdefault(
+                op_name, {"blocks": 0, "rows": 0, "seconds": 0.0})
+            entry["blocks"] += blocks
+            entry["rows"] += rows
+            entry["seconds"] += seconds
+
+    def summary(self) -> str:
+        with self._lock:
+            lines = ["Dataset execution stats:"]
+            for name, e in self.operators.items():
+                lines.append(
+                    f"  {name}: {int(e['blocks'])} blocks, "
+                    f"{int(e['rows'])} rows, {e['seconds']:.3f}s")
+            return "\n".join(lines)

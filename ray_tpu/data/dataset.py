@@ -28,6 +28,8 @@ from ray_tpu.data.iterator import DataIterator
 class Dataset:
     def __init__(self, root: L.LogicalOp):
         self._root = root
+        from ray_tpu.data.context import DatasetStats
+        self._stats = DatasetStats()
 
     # ------------------------------------------------------------------
     # transforms (lazy)
@@ -132,7 +134,7 @@ class Dataset:
     def _stream_refs(self) -> Iterator[Any]:
         """Streaming execution; barrier prefixes materialize first."""
         root = L.optimize(self._root)
-        yield from _stream_node(root)
+        yield from _stream_node(root, stats=self._stats)
 
     def materialize(self) -> "Dataset":
         refs = self._execute_refs()
@@ -304,6 +306,10 @@ class Dataset:
             if block.num_rows:
                 pacsv.write_csv(block, f"{path}/part-{i:05d}.csv")
 
+    def stats(self) -> str:
+        """Execution statistics summary (reference: Dataset.stats())."""
+        return self._stats.summary()
+
     def __repr__(self):
         return f"Dataset(plan={self._root.name})"
 
@@ -312,7 +318,7 @@ class Dataset:
 # plan execution helpers
 # ---------------------------------------------------------------------------
 
-def _stream_node(node: L.LogicalOp) -> Iterator[Any]:
+def _stream_node(node: L.LogicalOp, stats=None) -> Iterator[Any]:
     """Yield block refs for a (possibly barrier-containing) plan node."""
     if isinstance(node, L.Union):
         for inp in node.inputs:
@@ -360,7 +366,7 @@ def _stream_node(node: L.LogicalOp) -> Iterator[Any]:
             op = _clone_with_input(op, source)
             source = op
         chain = source.chain()
-    executor = StreamingExecutor(plan_chain(chain))
+    executor = StreamingExecutor(plan_chain(chain), stats=stats)
     yield from executor.execute()
 
 

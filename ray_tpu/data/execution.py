@@ -361,9 +361,16 @@ class StreamingExecutor:
     while upstream still reads block N)."""
 
     def __init__(self, operators: List[PhysicalOperator],
-                 max_out_queue: int = DEFAULT_MAX_OUT_QUEUE):
+                 max_out_queue: Optional[int] = None, stats=None):
+        from ray_tpu.data.context import DataContext
+        ctx = DataContext.get_current()
         self.ops = operators
-        self.max_out_queue = max_out_queue
+        self.max_out_queue = (max_out_queue if max_out_queue is not None
+                              else ctx.max_operator_output_queue)
+        self.stats = stats
+        for op in operators:
+            op.max_in_flight = min(op.max_in_flight,
+                                   ctx.max_in_flight_tasks_per_operator)
         for a, b in zip(operators[:-1], operators[1:]):
             a.downstream = b
 
@@ -400,6 +407,8 @@ class StreamingExecutor:
                         try:
                             ray_tpu.get(ref)
                             owner.on_task_done(ref, None)
+                            if self.stats is not None:
+                                self.stats.record(owner.name, blocks=1)
                         except Exception as e:
                             owner.active.pop(ref, None)
                             raise
