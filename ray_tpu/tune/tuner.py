@@ -131,6 +131,14 @@ class Tuner:
     def __init__(self, trainable: Callable, *,
                  param_space: Optional[Dict[str, Any]] = None,
                  tune_config: Optional[TuneConfig] = None):
+        # Train-on-Tune (reference: base_trainer.py:692 wraps a Trainer as
+        # a one-trial Tune trainable): a JaxTrainer becomes a trainable
+        # whose config overrides train_loop_config per trial.
+        from ray_tpu.train.trainer import JaxTrainer
+        if isinstance(trainable, JaxTrainer):
+            trainable = _trainer_as_trainable(trainable)
+            if param_space and "train_loop_config" in param_space:
+                param_space = dict(param_space["train_loop_config"])
         self.trainable = trainable
         self.param_space = param_space or {}
         self.cfg = tune_config or TuneConfig()
@@ -208,6 +216,22 @@ def report(metrics: Dict[str, Any],
     """`tune.report` — alias of the train session report."""
     from ray_tpu.train.session import report as _report
     _report(metrics, checkpoint)
+
+
+def _trainer_as_trainable(trainer) -> Callable:
+    import copy
+
+    def trainable(config: Dict[str, Any]):
+        t = copy.copy(trainer)
+        merged = dict(trainer.train_loop_config or {})
+        merged.update(config)
+        t.train_loop_config = merged
+        result = t.fit()
+        if result.error:
+            raise RuntimeError(result.error)
+        return dict(result.metrics)
+
+    return trainable
 
 
 def with_parameters(fn: Callable, **params) -> Callable:

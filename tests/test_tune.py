@@ -96,3 +96,27 @@ def test_trial_error_captured(ray_start_regular):
     results = Tuner(bad, param_space={},
                     tune_config=TuneConfig(num_samples=1)).fit()
     assert len(results.errors) == 1
+
+
+def test_tuner_over_jax_trainer(ray_start_regular, tmp_path):
+    """Train-on-Tune: HPO over a JaxTrainer's train_loop_config."""
+    from ray_tpu import train, tune as rtune
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def train_fn(config):
+        # pretend loss depends on lr quadratically
+        loss = (config["lr"] - 0.3) ** 2
+        train.report({"loss": loss})
+
+    trainer = JaxTrainer(
+        train_fn, train_loop_config={"lr": 0.1},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="hpo", storage_path=str(tmp_path)))
+    results = Tuner(
+        trainer,
+        param_space={"train_loop_config": {
+            "lr": rtune.grid_search([0.1, 0.3, 0.9])}},
+        tune_config=TuneConfig(metric="loss", mode="min")).fit()
+    best = results.get_best_result()
+    assert best.config["lr"] == 0.3
+    assert best.metrics["loss"] == 0.0
