@@ -1,0 +1,114 @@
+"""Prefill/decode disaggregation.
+
+Reference: `llm/_internal/serve/deployments/prefill_decode_disagg/` —
+prefill replicas (compute-bound) and decode replicas (HBM-bandwidth-
+bound) scale independently; the prompt's KV cache transfers between them
+(reference: NIXL/NCCL; here the object plane carries the arrays — on a
+pod this is an ICI/DCN device-to-device transfer).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import serve
+from ray_tpu.llm.engine import SamplingParams
+from ray_tpu.llm.serving import LLMConfig
+from ray_tpu.llm.tokenizer import ByteTokenizer, load_tokenizer
+
+
+def _build_model(config: LLMConfig):
+    import jax
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel
+    cfg = config.model_config or LlamaConfig.debug(
+        vocab_size=512, max_seq_len=config.max_seq)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(config.seed))
+    return model, params
+
+
+class PrefillServer:
+    """Compute-bound plane: prompt → (kv, first-token logits)."""
+
+    def __init__(self, config: LLMConfig):
+        from ray_tpu.llm.engine import ContinuousBatchingEngine
+        model, params = _build_model(config)
+        self.engine = ContinuousBatchingEngine(
+            model, params, max_slots=1, max_seq=config.max_seq)
+        self.tokenizer = (load_tokenizer(config.tokenizer)
+                          if config.tokenizer else ByteTokenizer())
+
+    def prefill(self, prompt) -> Dict[str, Any]:
+        ids = (prompt if isinstance(prompt, list)
+               else self.tokenizer.encode(prompt))
+        kv, last_logits, n = self.engine.prefill_only(ids)
+        return {"kv": kv, "last_logits": last_logits, "prompt_ids": ids}
+
+
+class DecodeServer:
+    """Bandwidth-bound plane: continues generation from transferred KV."""
+
+    def __init__(self, config: LLMConfig):
+        from ray_tpu.llm.engine import ContinuousBatchingEngine
+        model, params = _build_model(config)
+        self.engine = ContinuousBatchingEngine(
+            model, params, max_slots=config.max_slots,
+            max_seq=config.max_seq)
+        self.tokenizer = (load_tokenizer(config.tokenizer)
+                          if config.tokenizer else ByteTokenizer())
+        self._stop = threading.Event()
+        threading.Thread(target=self.engine.run_forever,
+                         args=(self._stop,), daemon=True).start()
+
+    def decode(self, prefill_out: Dict[str, Any],
+               max_tokens: int = 32, temperature: float = 0.0
+               ) -> Dict[str, Any]:
+        sampling = SamplingParams(max_tokens=max_tokens,
+                                  temperature=temperature)
+        req = None
+        deadline = time.time() + 300
+        while req is None and time.time() < deadline:
+            req = self.engine.submit_prefilled(
+                prefill_out["prompt_ids"], prefill_out["kv"],
+                prefill_out["last_logits"], sampling)
+            if req is None:
+                time.sleep(0.01)   # all slots busy: continuous batching
+        if req is None:
+            raise TimeoutError("no decode slot became free")
+        req.done.wait(timeout=300)
+        return {"token_ids": list(req.output),
+                "text": self.tokenizer.decode(req.output),
+                "finish_reason": req.finish_reason,
+                "ttft_s": req.ttft_s}
+
+
+class PDOrchestrator:
+    """Ingress: prefill handle → decode handle (the `1p1d`-style graph)."""
+
+    def __init__(self, prefill_handle, decode_handle):
+        self.prefill = prefill_handle
+        self.decode = decode_handle
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        pre = self.prefill.prefill.remote(request["prompt"]).result()
+        return self.decode.decode.remote(
+            pre, request.get("max_tokens", 32),
+            request.get("temperature", 0.0)).result()
+
+
+def build_pd_disagg_app(config: LLMConfig, *, num_prefill: int = 1,
+                        num_decode: int = 1) -> serve.Application:
+    """`build_pd_openai_app` equivalent (reference: serve config with
+    prefill_config/decode_config)."""
+    prefill_dep = serve.deployment(
+        PrefillServer, name=f"{config.model_id}-prefill",
+        num_replicas=num_prefill)
+    decode_dep = serve.deployment(
+        DecodeServer, name=f"{config.model_id}-decode",
+        num_replicas=num_decode)
+    orchestrator = serve.deployment(
+        PDOrchestrator, name=f"{config.model_id}-pd")
+    return orchestrator.bind(prefill_dep.bind(config),
+                             decode_dep.bind(config))

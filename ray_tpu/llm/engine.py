@@ -238,6 +238,44 @@ class ContinuousBatchingEngine:
             self.slots[slot] = None
             self.offsets[slot] = 0
 
+    # -- prefill/decode disaggregation handoff -----------------------------
+    def prefill_only(self, prompt_tokens: List[int]):
+        """Prefill WITHOUT occupying a decode slot: returns
+        (kv_small_numpy, last_logits_numpy, prompt_len) for transfer to a
+        decode engine (reference: ray.llm prefill/decode disaggregation,
+        `deployments/prefill_decode_disagg/`)."""
+        n = len(prompt_tokens)
+        bucket = self._bucket_for(n)
+        if bucket is None:
+            raise ValueError(f"prompt of {n} tokens exceeds buckets")
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :n] = prompt_tokens
+        last_logits, small = self._prefill(self.params, jnp.asarray(toks), n)
+        kv = {"k": np.asarray(small["k"]), "v": np.asarray(small["v"])}
+        self.stats["prefills"] += 1
+        return kv, np.asarray(last_logits), n
+
+    def submit_prefilled(self, prompt_tokens: List[int], kv: Dict,
+                         last_logits, sampling: Optional[SamplingParams]
+                         = None) -> Optional[Request]:
+        """Admit a request whose prefill happened elsewhere. Returns None
+        if no slot is free (caller retries)."""
+        req = Request(prompt_tokens, sampling or SamplingParams())
+        with self._lock:
+            free = [i for i, s in enumerate(self.slots) if s is None]
+            if not free:
+                return None
+            slot = free[0]
+            small = {"k": jnp.asarray(kv["k"]), "v": jnp.asarray(kv["v"])}
+            self.cache = self._insert(self.cache, small, slot)
+            tok = self._sample_one(jnp.asarray(last_logits), req)
+            req.first_token_at = time.perf_counter()
+            self.slots[slot] = req
+            self.offsets[slot] = len(prompt_tokens)
+            self.stats["requests"] += 1
+            self._emit(slot, int(tok))
+        return req
+
     # -- convenience -------------------------------------------------------
     def generate(self, prompts: List[List[int]],
                  sampling: Optional[SamplingParams] = None
