@@ -144,3 +144,28 @@ def test_dataset_shard_sequence_split(ray_start_regular, tmp_path):
         datasets={"train": list(range(10))}).fit()
     counts = sorted(e["metrics"]["n"] for e in result.metrics_history)
     assert counts == [5, 5]
+
+
+def test_worker_group_collectives(ray_start_cluster, tmp_path):
+    from ray_tpu.train import collective as col
+
+    def train_fn(config):
+        ctx = train.get_context()
+        rank = ctx.get_world_rank()
+        col.barrier()
+        got = col.broadcast_from_rank_zero(
+            {"seed": 42} if rank == 0 else None)
+        total = col.allreduce(rank + 1)           # 1 + 2 + 3 = 6
+        ranks = col.allgather(rank)
+        train.report({"bcast": got["seed"], "sum": total,
+                      "ranks": ranks})
+
+    result = JaxTrainer(
+        train_fn, scaling_config=ScalingConfig(num_workers=3),
+        run_config=RunConfig(name="coll",
+                             storage_path=str(tmp_path))).fit()
+    assert result.error is None
+    for e in result.metrics_history:
+        assert e["metrics"]["bcast"] == 42
+        assert e["metrics"]["sum"] == 6
+        assert e["metrics"]["ranks"] == [0, 1, 2]
