@@ -1,0 +1,45 @@
+"""BASELINE config 5: Llama continuous-batching serving (v5e-8 target;
+debug model for the demo).
+
+Reference equivalent: `ray.llm build_openai_app` wrapping vLLM. Here the
+continuous-batching engine is in-tree (ray_tpu.llm.engine): slot-major
+HBM KV cache, bucketed prefill, batched decode. Also demonstrates the
+HTTP proxy plane.
+
+Run: python examples/serve_llama.py
+"""
+
+import json
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.llm import LLMConfig, build_llm_app
+
+
+def main():
+    ray_tpu.init(num_nodes=1, ignore_reinit_error=True)
+    app = build_llm_app(LLMConfig(model_id="llama-demo", max_slots=4,
+                                  max_seq=256))
+    handle = serve.run(app)
+
+    # direct handle path
+    out = handle.remote({"prompt": "hello tpu", "max_tokens": 8}).result()
+    print("handle:", {k: out[k] for k in ("text", "finish_reason",
+                                          "ttft_s")})
+
+    # HTTP path
+    port = serve.start_http_proxy(port=0)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=json.dumps({"prompt": "hi", "max_tokens": 4}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        print("http:", json.loads(resp.read())["finish_reason"])
+
+    serve.shutdown()
+    return out
+
+
+if __name__ == "__main__":
+    main()

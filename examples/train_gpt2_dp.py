@@ -1,0 +1,47 @@
+"""BASELINE config 2: GPT-2 data-parallel training (4-way dp mesh).
+
+Reference equivalent: TorchTrainer + NCCL DDP over 4 GPU workers. Here the
+data parallelism is a mesh axis: one jitted train step whose gradients
+all-reduce over ICI (XLA-inserted), not a wrapper.
+
+Run (CPU demo): JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+  python examples/train_gpt2_dp.py --debug
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import GPT2Config, GPT2Model
+from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+from ray_tpu.train.spmd import make_train_step, shard_batch
+
+
+def main(debug: bool = True, steps: int = 5):
+    n = len(jax.devices())
+    mesh = build_mesh(MeshSpec.auto(n))           # all-dp mesh
+    cfg = GPT2Config.debug() if debug else GPT2Config.gpt2_125m()
+    model = GPT2Model(cfg, mesh=mesh)
+    ts = make_train_step(model, mesh=mesh)
+    params, opt = ts.init_fn(jax.random.key(0))
+
+    rng = np.random.default_rng(0)
+    B, S = max(4, n), min(128, cfg.max_seq_len)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S)), jnp.int32)
+    batch = shard_batch((toks, jnp.roll(toks, -1, 1)), ts)
+
+    for step in range(steps):
+        params, opt, m = ts.step_fn(params, opt, batch)
+        print(f"step {step}: loss={float(m['loss']):.4f}")
+    return float(m["loss"])
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--debug", action="store_true", default=True)
+    p.add_argument("--full", dest="debug", action="store_false")
+    args = p.parse_args()
+    main(debug=args.debug)

@@ -1,0 +1,66 @@
+"""BASELINE config 1: Fashion-MNIST-style MLP, 2 workers (DDP baseline).
+
+Reference equivalent: TorchTrainer + gloo on 2 CPU workers
+(`python/ray/train/examples/pytorch/torch_fashion_mnist_example.py`).
+Here: JaxTrainer worker group; the train step is a jitted program; data
+ingest via ray_tpu.data streaming_split. Synthetic data stands in for the
+dataset download (zero-egress environment).
+
+Run: python examples/train_mnist_mlp.py
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu import data as rdata, train
+from ray_tpu.models import MLPConfig, MLPModel
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+from ray_tpu.train.spmd import make_train_step
+
+
+def make_dataset(n=2048, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 784)).astype(np.float32)
+    w = rng.normal(size=(784, 10)).astype(np.float32)
+    y = (x @ w).argmax(1).astype(np.int32)       # learnable labels
+    return rdata.from_numpy(x, "x").zip(rdata.from_numpy(y, "y")) \
+        .repartition(8)
+
+
+def train_fn(config):
+    ctx = train.get_context()
+    model = MLPModel(MLPConfig())
+    ts = make_train_step(model, optimizer=optax.adam(config["lr"]))
+    params, opt = ts.init_fn(jax.random.key(0))
+    shard = train.get_dataset_shard("train")
+    for epoch in range(config["epochs"]):
+        last = None
+        for batch in shard.iter_batches(batch_size=config["batch_size"]):
+            xb = jnp.asarray(batch["x"])
+            yb = jnp.asarray(batch["y"])
+            params, opt, m = ts.step_fn(params, opt, (xb, yb))
+            last = m
+        train.report({"epoch": epoch, "loss": float(last["loss"]),
+                      "rank": ctx.get_world_rank()})
+
+
+def main():
+    ray_tpu.init(num_nodes=2, resources={"CPU": 4},
+                 ignore_reinit_error=True)
+    result = JaxTrainer(
+        train_fn,
+        train_loop_config={"lr": 1e-3, "epochs": 2, "batch_size": 128},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="mnist_mlp"),
+        datasets={"train": make_dataset()},
+    ).fit()
+    print("final:", result.metrics)
+    assert result.error is None
+    return result
+
+
+if __name__ == "__main__":
+    main()

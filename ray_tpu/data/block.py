@@ -32,12 +32,20 @@ def block_from_rows(rows: List[Row]) -> Block:
     return pa.table({k: pa.array(v) for k, v in cols.items()})
 
 
+def _column_from_numpy(v) -> "pa.Array":
+    arr = np.asarray(v)
+    if arr.ndim > 1 and arr.dtype != object:
+        # fixed-shape tensor column: preserves dtype/shape, zero-copy both
+        # ways (reference: ray.data ArrowTensorArray extension type)
+        return pa.FixedShapeTensorArray.from_numpy_ndarray(arr)
+    return pa.array(v)
+
+
 def block_from_batch(batch: Batch) -> Block:
     if isinstance(batch, pa.Table):
         return batch
     if isinstance(batch, dict):
-        return pa.table({k: pa.array(np.asarray(v).tolist())
-                         if np.asarray(v).ndim > 1 else pa.array(v)
+        return pa.table({k: _column_from_numpy(v)
                          for k, v in batch.items()})
     try:
         import pandas as pd
@@ -75,6 +83,11 @@ class BlockAccessor:
             out: Dict[str, np.ndarray] = {}
             for name in self.block.column_names:
                 col = self.block.column(name)
+                chunked = col.combine_chunks() if isinstance(
+                    col, pa.ChunkedArray) else col
+                if isinstance(chunked.type, pa.FixedShapeTensorType):
+                    out[name] = chunked.to_numpy_ndarray()
+                    continue
                 try:
                     out[name] = col.to_numpy(zero_copy_only=False)
                 except (pa.ArrowInvalid, pa.ArrowNotImplementedError):

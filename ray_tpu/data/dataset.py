@@ -243,30 +243,51 @@ class Dataset:
 
     def streaming_split(self, n: int, *, equal: bool = False,
                         locality_hints=None) -> List[DataIterator]:
-        """n iterators fed by ONE shared streaming execution
-        (reference: `dataset.py:1731` — Train ingest, SURVEY.md §8.13)."""
+        """n iterators fed by ONE shared streaming execution per epoch
+        (reference: `dataset.py:1731` — Train ingest, SURVEY.md §8.13).
+        Repeated iteration re-executes the plan: when a shard that already
+        consumed the current pass asks for a new iterator, a fresh shared
+        pass starts (epoch semantics for SPMD training loops)."""
         lock = threading.Lock()
-        stream = self._stream_refs()
-        queues: List[List] = [[] for _ in range(n)]
-        state = {"next": 0, "done": False}
+        dataset = self
+
+        class _Gen:
+            def __init__(self):
+                self.stream = dataset._stream_refs()
+                self.queues: List[List] = [[] for _ in range(n)]
+                self.next = 0
+                self.done = False
+                self.joined: set = set()
+
+        state = {"gen_id": 0, "gens": {0: _Gen()}}
+
+        def join(idx: int) -> "_Gen":
+            with lock:
+                gen = state["gens"][state["gen_id"]]
+                if idx in gen.joined:       # this shard starts a new epoch
+                    state["gen_id"] += 1
+                    gen = state["gens"][state["gen_id"]] = _Gen()
+                gen.joined.add(idx)
+                return gen
 
         def pull_for(idx: int) -> Iterator[Block]:
+            gen = join(idx)
             while True:
                 with lock:
-                    if queues[idx]:
-                        ref = queues[idx].pop(0)
-                    elif state["done"]:
+                    if gen.queues[idx]:
+                        ref = gen.queues[idx].pop(0)
+                    elif gen.done:
                         return
                     else:
                         try:
-                            ref = next(stream)
+                            ref = next(gen.stream)
                         except StopIteration:
-                            state["done"] = True
+                            gen.done = True
                             return
-                        owner = state["next"] % n
-                        state["next"] += 1
+                        owner = gen.next % n
+                        gen.next += 1
                         if owner != idx:
-                            queues[owner].append(ref)
+                            gen.queues[owner].append(ref)
                             continue
                 yield ray_tpu.get(ref)
 

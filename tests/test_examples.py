@@ -1,0 +1,39 @@
+"""The BASELINE target-config examples must run end-to-end."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, "/root/repo/examples")
+
+
+def test_mnist_mlp_example(ray_start_regular):
+    import train_mnist_mlp
+    result = train_mnist_mlp.main()
+    assert result.error is None
+    epochs = {e["metrics"]["epoch"] for e in result.metrics_history}
+    assert 1 in epochs  # both epochs ran
+
+
+def test_gpt2_dp_example():
+    import train_gpt2_dp
+    loss = train_gpt2_dp.main(debug=True, steps=3)
+    assert loss > 0
+
+
+def test_llama_fsdp_example():
+    import train_llama_fsdp
+    loss = train_llama_fsdp.main(debug=True, steps=2)
+    assert loss > 0
+
+
+def test_vit_streaming_example(ray_start_regular):
+    import data_vit_streaming
+    result = data_vit_streaming.main()
+    assert result.error is None
+
+
+def test_serve_llama_example(ray_start_regular):
+    import serve_llama
+    out = serve_llama.main()
+    assert out["usage"]["completion_tokens"] == 8
