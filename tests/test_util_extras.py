@@ -53,3 +53,31 @@ def test_serve_multiplexed(ray_start_regular):
         assert handle.remote("m2").result() == "m2"    # reloaded
     finally:
         serve.shutdown()
+
+
+def test_inspect_serializability():
+    from ray_tpu.util.check_serialize import inspect_serializability
+    import threading
+
+    ok, failures = inspect_serializability(lambda x: x + 1)
+    assert ok and not failures
+
+    lock = threading.Lock()
+
+    def bad():
+        return lock
+
+    ok, failures = inspect_serializability(bad, name="bad")
+    assert not ok
+    assert any("lock" in f.name for f in failures)
+
+
+def test_accelerator_detection_env(monkeypatch):
+    from ray_tpu._private import accelerators as acc
+
+    monkeypatch.setenv(acc.TPU_VISIBLE_CHIPS_ENV, "0,1,2,3")
+    monkeypatch.setenv(acc.TPU_ACCELERATOR_TYPE_ENV, "v5p-8")
+    assert acc.detect_tpu_chips() == 4
+    res = acc.accelerator_resources()
+    assert res["TPU"] == 4.0
+    assert "accelerator_type:v5p-8" in res
