@@ -114,10 +114,14 @@ class BlockAccessor:
 
 
 def concat_blocks(blocks: Iterable[Block]) -> Block:
-    blocks = [b for b in blocks if b.num_rows > 0]
-    if not blocks:
+    blocks = list(blocks)
+    nonempty = [b for b in blocks if b.num_rows > 0]
+    if not nonempty:
+        for b in blocks:           # all empty: keep a schema if any block
+            if b.column_names:     # has one (joins/aggregates need it)
+                return b.slice(0, 0)
         return pa.table({})
-    return pa.concat_tables(blocks, promote_options="default")
+    return pa.concat_tables(nonempty, promote_options="default")
 
 
 def split_block(block: Block, num_splits: int) -> List[Block]:

@@ -21,7 +21,8 @@ from ray_tpu.data.aggregate import (AggregateFn, Count, Max, Mean, Min, Std,
 from ray_tpu.data.block import (Block, BlockAccessor, block_from_batch,
                                 block_from_rows, concat_blocks)
 from ray_tpu.data.execution import (StreamingExecutor, plan_chain,
-                                    run_aggregate, run_all_to_all)
+                                    run_aggregate, run_all_to_all,
+                                    run_join)
 from ray_tpu.data.iterator import DataIterator
 
 
@@ -110,6 +111,14 @@ class Dataset:
 
     def zip(self, other: "Dataset") -> "Dataset":
         return self._derive(L.Zip("zip", [self._root, other._root]))
+
+    def join(self, other: "Dataset", *, on: str, how: str = "inner",
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Distributed hash join (reference: Dataset.join /
+        `execution/operators/join.py`)."""
+        return self._derive(L.Join("join", [self._root, other._root],
+                                   key=on, how=how,
+                                   num_partitions=num_partitions))
 
     def random_sample(self, fraction: float,
                       seed: Optional[int] = None) -> "Dataset":
@@ -361,6 +370,12 @@ def _stream_node(node: L.LogicalOp, stats=None) -> Iterator[Any]:
             lt = lt.append_column(col_name, rt.column(name))
         yield ray_tpu.put(lt)
         return
+    if isinstance(node, L.Join):
+        left = list(_stream_node(L.optimize(node.inputs[0])))
+        right = list(_stream_node(L.optimize(node.inputs[1])))
+        yield from run_join(node.key, node.how, left, right,
+                            node.num_partitions)
+        return
     if isinstance(node, L.AllToAll):
         upstream = list(_stream_node(L.optimize(node.inputs[0])))
         yield from run_all_to_all(node, upstream)
@@ -374,7 +389,8 @@ def _stream_node(node: L.LogicalOp, stats=None) -> Iterator[Any]:
     chain = node.chain()
     barrier_idx = None
     for i, op in enumerate(chain):
-        if isinstance(op, (L.AllToAll, L.Aggregate, L.Union, L.Zip)):
+        if isinstance(op, (L.AllToAll, L.Aggregate, L.Union, L.Zip,
+                           L.Join)):
             barrier_idx = i
     if barrier_idx is not None:
         refs = list(_stream_node(chain[barrier_idx]))

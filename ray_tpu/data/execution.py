@@ -328,6 +328,44 @@ def _agg_partition_task(key, aggs, map_groups_fn, batch_format,
     return block_from_rows(rows)
 
 
+def _join_partition_task(key: str, how: str, n_left: int,
+                         *blocks: Block) -> Block:
+    """Join one hash partition: first n_left blocks are the left side."""
+    left = concat_blocks(blocks[:n_left])
+    right = concat_blocks(blocks[n_left:])
+    if left.num_rows == 0 and right.num_rows == 0:
+        return left
+    if left.num_rows == 0:
+        left = left.cast(left.schema)
+    return left.join(right, keys=key, join_type=how,
+                     right_suffix="_r")
+
+
+def run_join(key: str, how: str, left_refs: List[Any],
+             right_refs: List[Any],
+             num_partitions: Optional[int] = None) -> List[Any]:
+    """Hash join (reference: `data/_internal/execution/operators/join.py`
+    — hash-partition both sides to aggregator partitions, join each)."""
+    nparts = num_partitions or max(1, min(
+        8, max(len(left_refs), len(right_refs))))
+    hp = ray_tpu.remote(_hash_partition_task)
+    jn = ray_tpu.remote(_join_partition_task)
+
+    def scatter(refs):
+        parts = [hp.options(num_returns=nparts).remote(r, key, nparts)
+                 for r in refs]
+        return [p if isinstance(p, list) else [p] for p in parts]
+
+    lparts = scatter(left_refs)
+    rparts = scatter(right_refs)
+    out = []
+    for j in range(nparts):
+        lcol = [lparts[i][j] for i in range(len(lparts))]
+        rcol = [rparts[i][j] for i in range(len(rparts))]
+        out.append(jn.remote(key, how, len(lcol), *lcol, *rcol))
+    return out
+
+
 def run_aggregate(op: L.Aggregate, block_refs: List[Any],
                   num_partitions: Optional[int] = None) -> List[Any]:
     """Hash-shuffle aggregation (reference: SURVEY.md §8.7 —

@@ -79,6 +79,13 @@ class Zip(LogicalOp):
 
 
 @dataclasses.dataclass
+class Join(LogicalOp):
+    key: Optional[str] = None
+    how: str = "inner"
+    num_partitions: Optional[int] = None
+
+
+@dataclasses.dataclass
 class Aggregate(LogicalOp):
     key: Optional[str] = None
     aggs: List[Any] = dataclasses.field(default_factory=list)

@@ -208,3 +208,15 @@ def test_dataset_feeds_trainer(ray4, tmp_path):
     assert result.error is None
     assert sum(e["metrics"]["total"]
                for e in result.metrics_history) == sum(range(40))
+
+
+def test_hash_join(ray4):
+    left = rdata.from_items(
+        [{"k": i % 5, "lv": i} for i in range(20)], parallelism=4)
+    right = rdata.from_items(
+        [{"k": k, "rv": k * 100} for k in range(3)], parallelism=2)
+    joined = left.join(right, on="k").take_all()
+    assert all(r["rv"] == r["k"] * 100 for r in joined)
+    assert len(joined) == 12  # k in {0,1,2}: 4 left rows each x 1 right
+    outer = left.join(right, on="k", how="left outer").take_all()
+    assert len(outer) == 20
