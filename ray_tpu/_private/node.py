@@ -19,6 +19,7 @@ from __future__ import annotations
 import heapq
 import queue
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ray_tpu._private.gcs import NodeInfo
@@ -226,6 +227,11 @@ class Node:
         self._running: set = set()
         self._running_lock = threading.Lock()
         self._sema = threading.Semaphore(max_worker_threads)
+        # Event-loop instrumentation (reference: asio
+        # instrumented_io_context / event_stats.h — per-handler counts and
+        # queue lag surfaced in debug_state dumps).
+        self.loop_stats = {"dispatch_iterations": 0, "tasks_launched": 0,
+                           "max_queue_lag_ms": 0.0, "launch_ms_total": 0.0}
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
             name=f"dispatch-{node_id.hex()[:8]}")
@@ -238,6 +244,7 @@ class Node:
 
     # -- normal task path --------------------------------------------------
     def enqueue(self, spec: TaskSpec) -> None:
+        spec.enqueued_at = time.perf_counter()
         with self._pending_lock:
             for k, v in spec.resources.items():
                 self._pending_demand[k] = self._pending_demand.get(k, 0.0) + v
@@ -280,9 +287,18 @@ class Node:
                 continue
             progressed = False
             remaining: List[TaskSpec] = []
+            self.loop_stats["dispatch_iterations"] += 1
             for spec in self._backlog:
                 if self.ledger.try_acquire(spec.resources):
+                    t0 = time.perf_counter()
+                    if spec.enqueued_at:
+                        lag_ms = (t0 - spec.enqueued_at) * 1000
+                        if lag_ms > self.loop_stats["max_queue_lag_ms"]:
+                            self.loop_stats["max_queue_lag_ms"] = lag_ms
                     self._launch(spec)
+                    self.loop_stats["tasks_launched"] += 1
+                    self.loop_stats["launch_ms_total"] += (
+                        time.perf_counter() - t0) * 1000
                     progressed = True
                 else:
                     remaining.append(spec)

@@ -166,6 +166,7 @@ class TaskSpec:
     # generator backpressure
     backpressure_num_objects: int = -1
     enable_task_events: bool = True
+    enqueued_at: float = 0.0
     label_selector: Optional[Dict[str, Any]] = None
     runtime_env: Optional[Dict[str, Any]] = None
 

@@ -1073,6 +1073,26 @@ class Runtime:
                 target.spec.name))
 
     # ------------------------------------------------------------------
+    # debug state (reference: raylet debug_state_*.txt dumps with asio
+    # handler stats — common/asio/instrumented_io_context.h)
+    # ------------------------------------------------------------------
+    def debug_state(self) -> str:
+        lines = [f"session: {self.session_dir}",
+                 f"stats: {self.stats}",
+                 f"tracked refs: {self.refcounter.num_tracked()}",
+                 f"lineage entries: {self.lineage.num_entries()}"]
+        for node in self.nodes():
+            with node._running_lock:
+                running = len(node._running)
+            lines.append(
+                f"node {node.node_id.hex()[:8]}: alive={node.alive} "
+                f"running={running} backlog={len(node._backlog)} "
+                f"actors={len(node.actors)} "
+                f"store_used={node.store.used_bytes()} "
+                f"loop={node.loop_stats}")
+        return "\n".join(lines)
+
+    # ------------------------------------------------------------------
     # shutdown
     # ------------------------------------------------------------------
     def shutdown(self) -> None:

@@ -156,3 +156,19 @@ def test_microbenchmark_harness(ray_start_regular):
     names = {r["name"] for r in results}
     assert "tasks_per_second" in names
     assert all(r["throughput_per_s"] > 0 for r in results)
+
+
+def test_debug_state_and_loop_instrumentation(ray_start_regular):
+    from ray_tpu._private import worker as _worker
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get([f.remote() for _ in range(5)])
+    rt = _worker.global_runtime()
+    state = rt.debug_state()
+    assert "loop=" in state and "tasks_launched" in state
+    node = rt.nodes()[0]
+    assert node.loop_stats["tasks_launched"] >= 5
+    assert node.loop_stats["max_queue_lag_ms"] >= 0
