@@ -95,14 +95,21 @@ class ActorExecutor:
 
     def __init__(self, actor_id: ActorID, max_concurrency: int,
                  run_task: Callable[[TaskSpec, Any], None],
-                 run_task_async: Optional[Callable] = None):
+                 run_task_async: Optional[Callable] = None,
+                 concurrency_groups: Optional[Dict[str, int]] = None):
         self.actor_id = actor_id
         self.max_concurrency = max(1, max_concurrency)
         self._run_task = run_task
         self._run_task_async = run_task_async
         self.instance: Any = None
         self.is_async = False
-        self._heap: List = []  # (seqno, spec)
+        # Concurrency groups (reference: concurrency_group_manager.h:37):
+        # each named group gets its own queue + thread pool; methods route
+        # by spec.concurrency_group, "" = the default group.
+        self._groups: Dict[str, Dict[str, Any]] = {}
+        for name, limit in {"": self.max_concurrency,
+                            **(concurrency_groups or {})}.items():
+            self._groups[name] = {"heap": [], "limit": max(1, int(limit))}
         self._cond = threading.Condition()
         self._dead = False
         self.death_cause: Optional[str] = None
@@ -119,19 +126,27 @@ class ActorExecutor:
             t.start()
             self._threads.append(t)
         else:
-            for i in range(self.max_concurrency):
-                t = threading.Thread(target=self._sync_main, daemon=True,
-                                     name=f"actor-{self.actor_id.hex()[:8]}-{i}")
-                t.start()
-                self._threads.append(t)
+            for gname, group in self._groups.items():
+                for i in range(group["limit"]):
+                    t = threading.Thread(
+                        target=self._sync_main, args=(gname,), daemon=True,
+                        name=(f"actor-{self.actor_id.hex()[:8]}"
+                              f"-{gname or 'default'}-{i}"))
+                    t.start()
+                    self._threads.append(t)
+
+    def _group_of(self, spec: TaskSpec) -> str:
+        name = getattr(spec, "concurrency_group", "") or ""
+        return name if name in self._groups else ""
 
     def submit(self, spec: TaskSpec) -> bool:
         with self._cond:
             if self._dead:
                 return False
-            heapq.heappush(self._heap, (spec.seqno, spec))
+            heapq.heappush(self._groups[self._group_of(spec)]["heap"],
+                           (spec.seqno, spec))
             self.num_pending += 1
-            self._cond.notify()
+            self._cond.notify_all()
         return True
 
     def kill(self, cause: str) -> List[TaskSpec]:
@@ -141,8 +156,10 @@ class ActorExecutor:
                 return []
             self._dead = True
             self.death_cause = cause
-            pending = [spec for _, spec in self._heap]
-            self._heap.clear()
+            pending = [spec for g in self._groups.values()
+                       for _, spec in g["heap"]]
+            for g in self._groups.values():
+                g["heap"].clear()
             self.num_pending = 0
             self._cond.notify_all()
         if self._loop is not None:
@@ -152,19 +169,33 @@ class ActorExecutor:
                 pass
         return pending
 
-    def _next(self) -> Optional[TaskSpec]:
+    def _next(self, group: str = "") -> Optional[TaskSpec]:
+        heap = self._groups[group]["heap"]
         with self._cond:
-            while not self._heap and not self._dead:
+            while not heap and not self._dead:
                 self._cond.wait()
             if self._dead:
                 return None
-            _, spec = heapq.heappop(self._heap)
+            _, spec = heapq.heappop(heap)
             self.num_pending -= 1
             return spec
 
-    def _sync_main(self) -> None:
+    def _next_any(self) -> Optional[TaskSpec]:
+        """Async actors: one pump across all groups (semaphores bound
+        per-group concurrency there)."""
+        with self._cond:
+            while not self._dead:
+                for g in self._groups.values():
+                    if g["heap"]:
+                        _, spec = heapq.heappop(g["heap"])
+                        self.num_pending -= 1
+                        return spec
+                self._cond.wait()
+            return None
+
+    def _sync_main(self, group: str = "") -> None:
         while True:
-            spec = self._next()
+            spec = self._next(group)
             if spec is None:
                 return
             self._run_task(spec, self.instance)
@@ -175,15 +206,16 @@ class ActorExecutor:
         loop = asyncio.new_event_loop()
         self._loop = loop
         asyncio.set_event_loop(loop)
-        sem = asyncio.Semaphore(self.max_concurrency)
+        sems = {name: asyncio.Semaphore(g["limit"])
+                for name, g in self._groups.items()}
 
         async def handle(spec):
-            async with sem:
+            async with sems[self._group_of(spec)]:
                 await self._run_task_async(spec, self.instance)
 
         async def pump():
             while True:
-                spec = await loop.run_in_executor(None, self._next)
+                spec = await loop.run_in_executor(None, self._next_any)
                 if spec is None:
                     loop.stop()
                     return
@@ -295,8 +327,10 @@ class Node:
                         lag_ms = (t0 - spec.enqueued_at) * 1000
                         if lag_ms > self.loop_stats["max_queue_lag_ms"]:
                             self.loop_stats["max_queue_lag_ms"] = lag_ms
-                    self._launch(spec)
+                    # count BEFORE launch: the task thread may finish (and
+                    # a get() observe it) before control returns here
                     self.loop_stats["tasks_launched"] += 1
+                    self._launch(spec)
                     self.loop_stats["launch_ms_total"] += (
                         time.perf_counter() - t0) * 1000
                     progressed = True

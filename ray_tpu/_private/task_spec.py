@@ -149,6 +149,7 @@ class TaskSpec:
     max_task_retries: int = 0
     max_concurrency: int = 1
     max_pending_calls: int = -1
+    concurrency_groups: Optional[Dict[str, int]] = None
     lifetime: Optional[str] = None
     actor_name: Optional[str] = None
     namespace: Optional[str] = None

@@ -818,7 +818,8 @@ class Runtime:
             actor_id, spec.max_concurrency,
             run_task=lambda s, inst: self._execute_actor_task(s, inst, node),
             run_task_async=lambda s, inst: self._execute_actor_task_async(
-                s, inst, node))
+                s, inst, node),
+            concurrency_groups=spec.concurrency_groups)
         executor.start(instance, is_async)
         node.host_actor(executor)
         with self._actor_lock:

@@ -113,6 +113,7 @@ class ActorHandle:
             actor_id=self._actor_id,
             method_name=method_name,
             seqno=next_seqno(),
+            concurrency_group=options.get("concurrency_group", ""),
         )
         refs = rt.submit_task(spec)
         if num_returns == "streaming":
@@ -176,6 +177,7 @@ class ActorClass:
             max_task_retries=options.get("max_task_retries", 0),
             max_concurrency=options.get("max_concurrency", 1),
             max_pending_calls=options.get("max_pending_calls", -1),
+            concurrency_groups=options.get("concurrency_groups"),
             lifetime=options.get("lifetime"),
             actor_name=name,
             namespace=namespace,

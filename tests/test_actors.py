@@ -178,3 +178,47 @@ def test_detached_actor_namespace(ray_start_regular):
                     namespace="other").remote(3)
     h = ray_tpu.get_actor("d1", namespace="other")
     assert ray_tpu.get(h.read.remote()) == 3
+
+
+def test_concurrency_groups(ray_start_regular):
+    """Named groups get isolated thread pools with their own limits
+    (reference: concurrency_group_manager.h, SURVEY.md §8.4)."""
+    import threading
+    import time
+
+    @ray_tpu.remote(concurrency_groups={"io": 2, "compute": 1})
+    class Worker:
+        def __init__(self):
+            self.events = []
+            self.lock = threading.Lock()
+
+        def io_task(self, i):
+            with self.lock:
+                self.events.append(("io_start", i))
+            time.sleep(0.2)
+            with self.lock:
+                self.events.append(("io_end", i))
+            return i
+
+        def compute_task(self, i):
+            time.sleep(0.05)
+            return i
+
+        def get_events(self):
+            with self.lock:
+                return list(self.events)
+
+    w = Worker.remote()
+    t0 = time.time()
+    io_refs = [w.io_task.options(concurrency_group="io").remote(i)
+               for i in range(2)]
+    comp_refs = [w.compute_task.options(
+        concurrency_group="compute").remote(i) for i in range(2)]
+    assert sorted(ray_tpu.get(io_refs)) == [0, 1]
+    io_time = time.time() - t0
+    # 2 io tasks of 0.2s overlapped in the io group (limit 2)
+    assert io_time < 0.39
+    assert sorted(ray_tpu.get(comp_refs)) == [0, 1]
+    events = ray_tpu.get(w.get_events.remote())
+    starts = [e for e in events if e[0] == "io_start"]
+    assert len(starts) == 2
