@@ -134,9 +134,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     @pl.when(should_run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)          # [bq, D]
-        k = k_ref[0].astype(jnp.float32)          # [bk, D]
-        v = v_ref[0].astype(jnp.float32)
+        # feed the MXU native dtypes (bf16 in, f32 accumulate) — no
+        # explicit f32 casts of the operands
+        q = q_ref[0]                               # [bq, D]
+        k = k_ref[0]                               # [bk, D]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale     # [bq, bk]
@@ -156,7 +158,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         p = jnp.exp(s - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)

@@ -40,8 +40,14 @@ def _get_controller(create: bool = True):
 
 def run(app: Application, *, name: str = "default",
         route_prefix: Optional[str] = None,
-        blocking: bool = False) -> DeploymentHandle:
-    """Deploy an application; returns the ingress handle."""
+        blocking: bool = False,
+        local_testing_mode: bool = False):
+    """Deploy an application; returns the ingress handle. With
+    ``local_testing_mode`` the graph runs fully in-process (reference:
+    serve/_private/local_testing_mode.py)."""
+    if local_testing_mode:
+        from ray_tpu.serve.local_testing import run_local
+        return run_local(app)
     controller = _get_controller()
     ingress = ray_tpu.get(controller.deploy_application.remote(app))
     _apps[name] = ingress

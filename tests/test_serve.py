@@ -198,3 +198,26 @@ def test_serve_status_and_delete(serve_cluster):
     assert st["f"]["num_replicas"] == 2
     serve.delete("default")
     assert "f" not in serve.status()
+
+
+def test_local_testing_mode():
+    """No cluster needed: the app graph runs in-process."""
+    @serve.deployment
+    class Pre:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment(user_config={"scale": 10})
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre
+            self.scale = 1
+
+        def reconfigure(self, cfg):
+            self.scale = cfg["scale"]
+
+        def __call__(self, x):
+            return self.pre.remote(x).result() * self.scale
+
+    handle = serve.run(Model.bind(Pre.bind()), local_testing_mode=True)
+    assert handle.remote(4).result() == 50
