@@ -183,6 +183,26 @@ class GCS:
                     out.append({"name": name, "namespace": ns})
             return out
 
+    # -- persistence (reference: Redis-backed GCS fault tolerance —
+    # gcs_table_storage.h / gcs_init_data.h: on restart the GCS reloads
+    # all tables; here the KV + job tables snapshot to a file) --------------
+    def snapshot(self, path: str) -> str:
+        import pickle
+        with self._lock:
+            payload = {"kv": {ns: dict(t) for ns, t in self._kv.items()},
+                       "jobs": dict(self.jobs)}
+        with open(path, "wb") as f:
+            pickle.dump(payload, f)
+        return path
+
+    def restore(self, path: str) -> None:
+        import pickle
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        with self._lock:
+            self._kv = {ns: dict(t) for ns, t in payload["kv"].items()}
+            self.jobs.update(payload.get("jobs", {}))
+
     # -- internal KV (reference: gcs_kv_manager; used for function table,
     # collective rendezvous, runtime-env URIs) ------------------------------
     def kv_put(self, key: bytes, value: bytes, overwrite: bool = True,

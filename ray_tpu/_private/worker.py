@@ -576,6 +576,12 @@ class Runtime:
         self.task_events.record(
             task_id=spec.task_id.hex(), name=spec.name, event="RUNNING",
             node_id=node.node_id.hex())
+        from ray_tpu.util import tracing
+        with tracing.span(f"task::{spec.name}",
+                          task_id=spec.task_id.hex()[:16]):
+            self._execute_on_node_traced(spec, node)
+
+    def _execute_on_node_traced(self, spec: TaskSpec, node: Node) -> None:
         try:
             args, kwargs = self._resolve_args(spec)
         except exc.TaskError as te:

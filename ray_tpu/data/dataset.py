@@ -168,6 +168,9 @@ class Dataset:
     def to_jax(self, **kwargs) -> Iterator[Any]:
         return DataIterator(self.iter_blocks).to_jax(**kwargs)
 
+    def iter_torch_batches(self, **kwargs) -> Iterator[Any]:
+        return DataIterator(self.iter_blocks).iter_torch_batches(**kwargs)
+
     def take(self, n: int = 20) -> List[Dict]:
         out: List[Dict] = []
         for ref in self.limit(n)._stream_refs():

@@ -117,6 +117,21 @@ class DataIterator:
             local_shuffle_buffer_size, local_shuffle_seed)
         return _prefetched(batches, prefetch_batches)
 
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes=None, device: str = "cpu",
+                           **kwargs) -> Iterator[Any]:
+        """Batches as torch tensors (reference: iter_torch_batches)."""
+        import torch
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy", **kwargs):
+            out = {}
+            for k, v in batch.items():
+                t = torch.as_tensor(v)
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                out[k] = t.to(device) if device != "cpu" else t
+            yield out
+
     def to_jax(self, *, batch_size: int, sharding=None,
                prefetch: int = 2, drop_last: bool = True,
                dtypes: Optional[Dict[str, Any]] = None) -> Iterator[Any]:

@@ -1,0 +1,81 @@
+"""Multi-process-safe progress bars (reference:
+`python/ray/experimental/tqdm_ray.py` — tqdm-shaped API whose updates
+flow to the driver instead of fighting over the terminal)."""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Any, Dict, Iterable, Optional
+
+_registry: Dict[int, "tqdm"] = {}
+_lock = threading.Lock()
+_next_id = [0]
+
+
+class tqdm:
+    """Drop-in subset: total/desc/update/close, iteration wrapping."""
+
+    def __init__(self, iterable: Optional[Iterable] = None, *,
+                 total: Optional[int] = None, desc: str = "",
+                 position: Optional[int] = None, flush_period_s: float = 0.5):
+        self.iterable = iterable
+        self.total = total if total is not None else (
+            len(iterable) if hasattr(iterable, "__len__") else None)
+        self.desc = desc
+        self.n = 0
+        self._last_flush = 0.0
+        self.flush_period_s = flush_period_s
+        self._closed = False
+        with _lock:
+            self.bar_id = _next_id[0]
+            _next_id[0] += 1
+            _registry[self.bar_id] = self
+
+    def update(self, n: int = 1) -> None:
+        self.n += n
+        now = time.time()
+        if now - self._last_flush >= self.flush_period_s:
+            self._last_flush = now
+            self._render()
+
+    def set_description(self, desc: str) -> None:
+        self.desc = desc
+
+    def _render(self) -> None:
+        total = f"/{self.total}" if self.total else ""
+        sys.stderr.write(f"\r[{self.desc or 'progress'}] "
+                         f"{self.n}{total}")
+        sys.stderr.flush()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._render()
+        sys.stderr.write("\n")
+        with _lock:
+            _registry.pop(self.bar_id, None)
+
+    def __iter__(self):
+        if self.iterable is None:
+            raise TypeError("tqdm not given an iterable")
+        try:
+            for item in self.iterable:
+                yield item
+                self.update(1)
+        finally:
+            self.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def safe_print(*args, **kwargs) -> None:
+    """Print without corrupting progress lines."""
+    sys.stderr.write("\n")
+    print(*args, **kwargs)

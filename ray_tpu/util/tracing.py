@@ -1,0 +1,75 @@
+"""Opt-in tracing (reference: `python/ray/util/tracing/tracing_helper.py`
+— OpenTelemetry spans around task/actor invocation+execution, lazily
+enabled). Spans here go to an in-memory exporter with the OTel span shape
+(name, start/end ns, attributes, parent), convertible to chrome trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_enabled = False
+_spans: List[Dict[str, Any]] = []
+_lock = threading.Lock()
+_current = threading.local()
+_ids = itertools.count(1)
+
+
+def enable_tracing() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable_tracing() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def get_spans() -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_spans)
+
+
+def clear_spans() -> None:
+    with _lock:
+        _spans.clear()
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes):
+    """Record one span (no-op when tracing is disabled)."""
+    if not _enabled:
+        yield None
+        return
+    sid = next(_ids)
+    parent = getattr(_current, "span_id", None)
+    _current.span_id = sid
+    start = time.time_ns()
+    try:
+        yield sid
+    finally:
+        _current.span_id = parent
+        with _lock:
+            _spans.append({
+                "name": name, "span_id": sid, "parent_id": parent,
+                "start_ns": start, "end_ns": time.time_ns(),
+                "attributes": attributes})
+
+
+def chrome_trace() -> List[Dict[str, Any]]:
+    out = []
+    for s in get_spans():
+        out.append({"name": s["name"], "ph": "X", "cat": "trace",
+                    "ts": s["start_ns"] / 1000,
+                    "dur": max((s["end_ns"] - s["start_ns"]) / 1000, 1),
+                    "pid": "trace", "tid": str(s["parent_id"] or 0),
+                    "args": s["attributes"]})
+    return out

@@ -172,3 +172,57 @@ def test_debug_state_and_loop_instrumentation(ray_start_regular):
     node = rt.nodes()[0]
     assert node.loop_stats["tasks_launched"] >= 5
     assert node.loop_stats["max_queue_lag_ms"] >= 0
+
+
+def test_tracing_spans(ray_start_regular):
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def traced_work():
+        return 1
+
+    tracing.enable_tracing()
+    try:
+        ray_tpu.get([traced_work.remote() for _ in range(3)])
+        spans = tracing.get_spans()
+        named = [s for s in spans if "traced_work" in s["name"]]
+        assert len(named) >= 3
+        assert all(s["end_ns"] > s["start_ns"] for s in named)
+        assert tracing.chrome_trace()
+    finally:
+        tracing.disable_tracing()
+        tracing.clear_spans()
+
+
+def test_gcs_kv_snapshot_restore(ray_start_regular, tmp_path):
+    from ray_tpu._private import worker as _worker
+
+    rt = _worker.global_runtime()
+    rt.gcs.kv_put(b"cfg", b"value1")
+    path = rt.gcs.snapshot(str(tmp_path / "gcs.snap"))
+
+    rt.gcs.kv_del(b"cfg")
+    assert rt.gcs.kv_get(b"cfg") is None
+    rt.gcs.restore(path)
+    assert rt.gcs.kv_get(b"cfg") == b"value1"
+
+
+def test_tqdm_ray(capsys):
+    from ray_tpu.experimental.tqdm_ray import tqdm
+
+    out = []
+    for x in tqdm(range(5), desc="test", flush_period_s=0):
+        out.append(x)
+    assert out == [0, 1, 2, 3, 4]
+
+
+def test_iter_torch_batches(ray_start_regular):
+    from ray_tpu import data as rdata
+    import torch
+
+    ds = rdata.range(20, parallelism=2)
+    batches = list(ds.iter_torch_batches(batch_size=10))
+    assert len(batches) == 2
+    assert isinstance(batches[0]["id"], torch.Tensor)
+    vals = sorted(int(x) for b in batches for x in b["id"])
+    assert vals == list(range(20))
