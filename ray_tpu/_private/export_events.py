@@ -1,0 +1,62 @@
+"""Structured export events (reference: `src/ray/util/event.cc` +
+`protobuf/export_*.proto` + `_private/event/export_event_logger.py` —
+task/actor/node/job/train state changes written as JSONL for external
+pipelines; shipped by the aggregator agent in the reference)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+EXPORT_TYPES = ("TASK", "ACTOR", "NODE", "JOB", "TRAIN_RUN",
+                "PLACEMENT_GROUP")
+
+
+class ExportEventLogger:
+    """One JSONL file per event type under ``<session>/export_events/``."""
+
+    def __init__(self, session_dir: str):
+        self.dir = os.path.join(session_dir, "export_events")
+        os.makedirs(self.dir, exist_ok=True)
+        self._locks: Dict[str, threading.Lock] = {
+            t: threading.Lock() for t in EXPORT_TYPES}
+
+    def emit(self, event_type: str, payload: Dict[str, Any]) -> None:
+        if event_type not in self._locks:
+            raise ValueError(f"unknown export event type {event_type!r}")
+        record = {"event_type": event_type, "timestamp": time.time(),
+                  **payload}
+        path = os.path.join(self.dir, f"event_{event_type}.jsonl")
+        with self._locks[event_type]:
+            with open(path, "a") as f:
+                f.write(json.dumps(record, default=str) + "\n")
+
+    def read(self, event_type: str):
+        path = os.path.join(self.dir, f"event_{event_type}.jsonl")
+        if not os.path.exists(path):
+            return []
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+
+_logger: Optional[ExportEventLogger] = None
+
+
+def get_export_logger() -> Optional[ExportEventLogger]:
+    """Lazily bind to the running session (None before init)."""
+    global _logger
+    if _logger is None:
+        from ray_tpu._private import worker as _worker
+        rt = _worker.global_runtime()
+        if rt is None:
+            return None
+        _logger = ExportEventLogger(rt.session_dir)
+    return _logger
+
+
+def reset_export_logger() -> None:
+    global _logger
+    _logger = None

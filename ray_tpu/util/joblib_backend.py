@@ -1,0 +1,47 @@
+"""joblib backend (reference: `python/ray/util/joblib/` —
+`register_ray()` lets sklearn-style `Parallel(backend="ray")` fan out
+over the cluster)."""
+
+from __future__ import annotations
+
+from joblib._parallel_backends import ThreadingBackend
+from joblib.parallel import register_parallel_backend
+
+import ray_tpu
+
+
+class RayTpuBackend(ThreadingBackend):
+    """Each joblib batch executes as a cluster task."""
+
+    supports_timeout = True
+
+    def configure(self, n_jobs=1, parallel=None, **kwargs):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._fn = ray_tpu.remote(_run_batch)
+        return super().configure(n_jobs=n_jobs, parallel=parallel,
+                                 **kwargs)
+
+    def apply_async(self, func, callback=None):
+        ref = self._fn.remote(func)
+
+        class _Future:
+            def get(self, timeout=None):
+                result = ray_tpu.get(ref, timeout=timeout)
+                if callback:
+                    callback(result)
+                return result
+        return _Future()
+
+    def effective_n_jobs(self, n_jobs):
+        if n_jobs == -1 or n_jobs is None:
+            return max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+        return super().effective_n_jobs(n_jobs)
+
+
+def _run_batch(batch):
+    return batch()
+
+
+def register_ray() -> None:
+    register_parallel_backend("ray_tpu", RayTpuBackend)

@@ -81,3 +81,28 @@ def test_accelerator_detection_env(monkeypatch):
     res = acc.accelerator_resources()
     assert res["TPU"] == 4.0
     assert "accelerator_type:v5p-8" in res
+
+
+def test_joblib_backend(ray_start_regular):
+    from joblib import Parallel, delayed
+    from ray_tpu.util.joblib_backend import register_ray
+
+    register_ray()
+    from joblib import parallel_backend
+    with parallel_backend("ray_tpu"):
+        out = Parallel(n_jobs=4)(delayed(lambda x: x * x)(i)
+                                 for i in range(8))
+    assert out == [i * i for i in range(8)]
+
+
+def test_export_events(ray_start_regular):
+    from ray_tpu._private.export_events import (get_export_logger,
+                                                reset_export_logger)
+
+    reset_export_logger()
+    logger = get_export_logger()
+    logger.emit("JOB", {"job_id": "j1", "state": "RUNNING"})
+    logger.emit("JOB", {"job_id": "j1", "state": "FINISHED"})
+    events = logger.read("JOB")
+    assert [e["state"] for e in events] == ["RUNNING", "FINISHED"]
+    assert all(e["event_type"] == "JOB" for e in events)
