@@ -4,13 +4,33 @@ Prints ONE JSON line: tokens/sec/chip on a Llama-family model sized to the
 available memory, plus model FLOPs utilization (MFU) as ``vs_baseline``
 (the reference repo publishes no tok/s numbers — BASELINE.md — so the
 hardware roofline is the honest denominator).
+
+Robustness contract (VERDICT r1 #1b): the TPU backend may fail or *hang*
+on init, so the WHOLE benchmark runs in a child subprocess under a
+timeout; the parent retries flaky backend failures with backoff and, on
+persistent failure, re-runs the child on CPU so one JSON line (with an
+explicit ``"error"`` field) is always emitted, exit code 0.
+
+Modes:
+  BENCH_SERVE=1    — serving benchmark (p50 TTFT + output tok/s) instead
+                     of the training benchmark.
+Knobs:
+  BENCH_ATTEMPTS   — accelerator attempts before CPU fallback (default 2)
+  BENCH_TIMEOUT    — per-attempt timeout, seconds (default 1200)
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
+
+# Error signatures that are plausibly transient backend-init failures and
+# worth retrying; anything else (e.g. ImportError) is deterministic.
+_RETRYABLE = ("UNAVAILABLE", "Unavailable", "backend", "DEADLINE_EXCEEDED",
+              "INTERNAL", "tunnel")
 
 
 def _roofline_flops(device) -> float:
@@ -27,10 +47,14 @@ def _roofline_flops(device) -> float:
     for key, val in table.items():
         if key in kind:
             return val
+    env_gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    for key, val in table.items():
+        if key in env_gen:
+            return val
     return 275e12  # conservative default
 
 
-def main() -> None:
+def _run_train(error: str | None) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -39,7 +63,7 @@ def main() -> None:
     from ray_tpu.train.spmd import make_train_step
 
     dev = jax.devices()[0]
-    on_tpu = dev.platform == "tpu"
+    on_tpu = dev.platform != "cpu"
 
     if on_tpu:
         cfg = LlamaConfig.bench_400m()
@@ -77,7 +101,7 @@ def main() -> None:
     mfu = (tokens_per_sec * 6 * n_params / _roofline_flops(dev)
            if on_tpu else 0.0)
 
-    print(json.dumps({
+    out = {
         "metric": "llama_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
@@ -90,7 +114,99 @@ def main() -> None:
             "step_ms": round(dt / steps * 1000, 2),
             "loss": float(metrics["loss"]),
         },
+    }
+    if error:
+        out["error"] = error
+    return out
+
+
+def _child() -> int:
+    """Run the actual benchmark and print its JSON line."""
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # Env vars alone lose to sitecustomize re-pinning JAX_PLATFORMS;
+        # the config-level override must happen inside this process.
+        from ray_tpu._private.platform import force_cpu_platform
+        force_cpu_platform()
+    serve_mode = os.environ.get("BENCH_SERVE") == "1"
+    error = os.environ.get("BENCH_ERROR") or None
+    if serve_mode:
+        from ray_tpu.llm.bench import run_serving_bench
+        result = run_serving_bench(error=error)
+    else:
+        result = _run_train(error)
+    print(json.dumps(result))
+    return 0
+
+
+def main() -> int:
+    if "--child" in sys.argv:
+        return _child()
+
+    serve_mode = os.environ.get("BENCH_SERVE") == "1"
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "2"))
+    timeout = int(os.environ.get("BENCH_TIMEOUT", "1200"))
+    cmd = [sys.executable, os.path.abspath(__file__), "--child"]
+
+    def try_once(env, t) -> tuple[str | None, str, bool]:
+        """Returns (json_line, error, retryable). The child runs in its
+        own session so a hung TPU init (possibly with helper grandchildren
+        holding the stdout pipe) can be killed as a whole process group —
+        plain subprocess.run would block forever in communicate()."""
+        import signal
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, start_new_session=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        try:
+            stdout, stderr = proc.communicate(timeout=t)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            try:
+                proc.communicate(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+            return None, f"benchmark timed out after {t}s", True
+        lines = [ln for ln in stdout.splitlines() if ln.strip()]
+        if proc.returncode == 0 and lines:
+            try:
+                json.loads(lines[-1])
+                return lines[-1], "", False
+            except ValueError:
+                pass
+        err = (stderr or stdout or "").strip()[-400:]
+        return None, err, any(sig in err for sig in _RETRYABLE)
+
+    err = ""
+    for attempt in range(attempts):
+        line, err, retryable = try_once(os.environ.copy(), timeout)
+        if line is not None:
+            print(line)
+            return 0
+        if not retryable:
+            break
+        if attempt + 1 < attempts:
+            time.sleep(15 * (attempt + 1))
+
+    # Persistent accelerator failure: emit the line from a CPU child.
+    env = os.environ.copy()
+    env["BENCH_FORCE_CPU"] = "1"
+    env["BENCH_ERROR"] = f"tpu backend unavailable: {err}"[:500]
+    line, cpu_err, _ = try_once(env, max(600, timeout))
+    if line is not None:
+        print(line)
+        return 0
+    print(json.dumps({
+        "metric": ("llm_serve_output_tokens_per_sec" if serve_mode
+                   else "llama_train_tokens_per_sec_per_chip"),
+        "value": 0.0,
+        "unit": "tokens/s" if serve_mode else "tokens/s/chip",
+        "vs_baseline": 0.0,
+        "error": f"tpu: {err} | cpu fallback: {cpu_err}"[:700],
     }))
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,43 @@
+"""Platform pinning for CPU-simulated device meshes.
+
+The environment may pin JAX onto a TPU plugin (e.g. ``JAX_PLATFORMS=axon``
+via sitecustomize); tests and multi-chip dryruns must instead run on N
+virtual CPU devices (the reference's in-one-machine cluster fixture idea,
+``python/ray/tests/conftest.py:535-588``, applied to SPMD). Both the env
+var and the jax config update are required: the env var alone can be
+re-pinned by sitecustomize, the config alone loses to an exported
+``JAX_PLATFORMS``. Safe to call after ``import jax`` as long as no backend
+has been initialized yet.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_cpu_platform(n_devices: int | None = None) -> None:
+    """Pin jax to the host CPU platform, optionally with ``n_devices``
+    virtual devices. Must run before the first backend touch
+    (``jax.devices()`` etc.); raises if the backend is already up on a
+    different platform."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        repl = f"{_COUNT_FLAG}={n_devices}"
+        if _COUNT_FLAG in flags:
+            flags = re.sub(rf"{_COUNT_FLAG}=\d+", repl, flags)
+        else:
+            flags = (flags + " " + repl).strip()
+        os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if n_devices:
+        try:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+        except Exception:
+            pass  # older jax: XLA_FLAGS above already sets the count

@@ -1,0 +1,96 @@
+"""Serving benchmark harness: p50 TTFT + output tokens/sec.
+
+Reference capability: the reference measures LLM serving with
+``release/llm_tests/serve/benchmark/load_test.py:802-809`` (TTFT
+percentiles + output token throughput). This is the in-tree TPU-native
+equivalent, driven by ``BENCH_SERVE=1 python bench.py``: a burst of
+synthetic requests through the continuous-batching engine, measuring
+time-to-first-token per request and aggregate decode throughput.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+def _percentile(vals, q: float) -> float:
+    """q in [0, 100]."""
+    import numpy as np
+    if not vals:
+        return 0.0
+    return float(np.percentile(vals, q, method="nearest"))
+
+
+def run_serving_bench(error: Optional[str] = None) -> dict:
+    import jax
+    import numpy as np
+
+    from ray_tpu.llm.engine import ContinuousBatchingEngine, SamplingParams
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+
+    if on_tpu:
+        cfg = LlamaConfig.bench_400m(max_seq_len=1024)
+        n_requests, max_tokens, max_slots = 48, 128, 16
+        prompt_lo, prompt_hi = 32, 256
+    else:  # CPU smoke path
+        cfg = LlamaConfig.debug(vocab_size=512, max_seq_len=128)
+        n_requests, max_tokens, max_slots = 6, 8, 4
+        prompt_lo, prompt_hi = 8, 24
+
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.key(0))
+    engine = ContinuousBatchingEngine(
+        model, params, max_slots=max_slots, max_seq=cfg.max_seq_len)
+
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(1, cfg.vocab_size,
+                                 int(rng.integers(prompt_lo, prompt_hi))))
+               for _ in range(n_requests)]
+
+    # Warmup: jit-specialize EVERY prefill bucket a benchmark prompt can
+    # hit (lengths are drawn from [prompt_lo, prompt_hi)), plus decode —
+    # otherwise the first request per bucket pays an XLA compile inside
+    # the timed region and TTFT measures compilation.
+    limit = engine._bucket_for(prompt_hi - 1)
+    assert limit is not None, "prompt_hi exceeds every prefill bucket"
+    warm_buckets = [b for b in engine.buckets if b <= limit]
+    engine.generate([[1] * b for b in warm_buckets],
+                    SamplingParams(max_tokens=4))
+
+    t0 = time.perf_counter()
+    reqs = [engine.submit(p, SamplingParams(max_tokens=max_tokens))
+            for p in prompts]
+    while engine.has_work():
+        engine.step()
+    wall = time.perf_counter() - t0
+
+    ttfts = sorted(r.ttft_s for r in reqs if r.ttft_s is not None)
+    output_tokens = sum(len(r.output) for r in reqs)
+    tok_s = output_tokens / wall if wall > 0 else 0.0
+    out = {
+        "metric": "llm_serve_output_tokens_per_sec",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        # No published reference serving numbers (BASELINE.md) — report
+        # p50 TTFT (seconds) as the comparable headline alongside tok/s.
+        "vs_baseline": round(_percentile(ttfts, 50), 4),
+        "detail": {
+            "ttft_p50_ms": round(_percentile(ttfts, 50) * 1e3, 2),
+            "ttft_p90_ms": round(_percentile(ttfts, 90) * 1e3, 2),
+            "ttft_p99_ms": round(_percentile(ttfts, 99) * 1e3, 2),
+            "requests": n_requests,
+            "output_tokens": output_tokens,
+            "wall_s": round(wall, 3),
+            "max_slots": max_slots,
+            "max_tokens_per_req": max_tokens,
+            "config": "llama_400m" if on_tpu else "debug",
+            "device": getattr(dev, "device_kind", dev.platform),
+        },
+    }
+    if error:
+        out["error"] = error
+    return out

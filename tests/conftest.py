@@ -6,19 +6,9 @@ applied to SPMD: XLA_FLAGS=--xla_force_host_platform_device_count=8).
 Must run before the first jax import in the test process.
 """
 
-import os
+from ray_tpu._private.platform import force_cpu_platform
 
-# The environment may pin JAX_PLATFORMS to a TPU plugin (e.g. "axon") via
-# sitecustomize, so a config-level override is required, not just the env.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu_platform(8)
 
 import pytest  # noqa: E402
 
