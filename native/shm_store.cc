@@ -45,6 +45,8 @@ struct ObjectEntry {
   uint64_t offset = 0;
   uint64_t size = 0;
   bool sealed = false;
+  bool deleted = false;  // delete requested while refs outstanding
+  bool pinned = false;   // creator ref retained; delete() consumes it
   int64_t refcount = 0;
   uint64_t lru_tick = 0;  // last release time; eviction order
 };
@@ -106,7 +108,7 @@ struct Store {
       uint64_t best_tick = UINT64_MAX;
       for (auto& kv : objects) {
         if (kv.second.sealed && kv.second.refcount == 0 &&
-            kv.second.lru_tick < best_tick) {
+            !kv.second.deleted && kv.second.lru_tick < best_tick) {
           best_tick = kv.second.lru_tick;
           victim = &kv.first;
         }
@@ -161,6 +163,15 @@ void rtpu_store_close(Store* s, int unlink) {
   close(s->shm_fd);
   if (unlink) shm_unlink(s->shm_name.c_str());
   delete s;
+}
+
+// Unlink the segment name WITHOUT unmapping: used at shutdown while
+// zero-copy views into the arena are still alive in user code. The
+// mapping (and Store) are deliberately leaked until process exit so
+// those views stay valid; the name is removed so /dev/shm doesn't leak.
+void rtpu_store_unlink(Store* s) {
+  if (s == nullptr) return;
+  shm_unlink(s->shm_name.c_str());
 }
 
 void* rtpu_store_base(Store* s) { return s->base; }
@@ -223,11 +234,26 @@ int rtpu_seal(Store* s, const char* id) {
   return RTPU_OK;
 }
 
+// Mark the object pinned: the creator keeps its create-time ref (does not
+// release after seal) and rtpu_delete consumes it. Pinned objects are
+// immune to LRU eviction (their refcount stays >= 1).
+int rtpu_pin(Store* s, const char* id) {
+  pthread_mutex_lock(&s->mu);
+  auto it = s->objects.find(id);
+  if (it == s->objects.end()) {
+    pthread_mutex_unlock(&s->mu);
+    return RTPU_ERR_NOT_FOUND;
+  }
+  it->second.pinned = true;
+  pthread_mutex_unlock(&s->mu);
+  return RTPU_OK;
+}
+
 // Get a sealed object: increfs and returns offset+size.
 int rtpu_get(Store* s, const char* id, uint64_t* offset, uint64_t* size) {
   pthread_mutex_lock(&s->mu);
   auto it = s->objects.find(id);
-  if (it == s->objects.end()) {
+  if (it == s->objects.end() || it->second.deleted) {
     pthread_mutex_unlock(&s->mu);
     return RTPU_ERR_NOT_FOUND;
   }
@@ -251,6 +277,11 @@ int rtpu_release(Store* s, const char* id) {
   }
   if (it->second.refcount > 0) it->second.refcount--;
   it->second.lru_tick = ++s->tick;
+  if (it->second.deleted && it->second.refcount == 0) {
+    // Deferred delete: last outstanding reader is gone, free now.
+    s->deallocate(it->second.offset, it->second.size);
+    s->objects.erase(it);
+  }
   pthread_mutex_unlock(&s->mu);
   return RTPU_OK;
 }
@@ -258,12 +289,17 @@ int rtpu_release(Store* s, const char* id) {
 int rtpu_contains(Store* s, const char* id) {
   pthread_mutex_lock(&s->mu);
   auto it = s->objects.find(id);
-  int out = (it != s->objects.end() && it->second.sealed) ? 1 : 0;
+  int out = (it != s->objects.end() && it->second.sealed &&
+             !it->second.deleted) ? 1 : 0;
   pthread_mutex_unlock(&s->mu);
   return out;
 }
 
-// Force-delete regardless of refcount (owner decided the object is dead).
+// Delete: the owner decided the object is dead. If readers still hold
+// refs the buffer is only MARKED deleted and the deallocation happens at
+// the last release (plasma semantics: clients' zero-copy buffers stay
+// valid for their lifetime; the object just becomes unreachable for new
+// gets).
 int rtpu_delete(Store* s, const char* id) {
   pthread_mutex_lock(&s->mu);
   auto it = s->objects.find(id);
@@ -271,8 +307,18 @@ int rtpu_delete(Store* s, const char* id) {
     pthread_mutex_unlock(&s->mu);
     return RTPU_ERR_NOT_FOUND;
   }
-  s->deallocate(it->second.offset, it->second.size);
-  s->objects.erase(it);
+  if (it->second.pinned) {
+    // Consume the creator's retained ref — otherwise pinned objects
+    // (the normal host-store path) would leak as permanent zombies.
+    it->second.pinned = false;
+    if (it->second.refcount > 0) it->second.refcount--;
+  }
+  if (it->second.refcount > 0) {
+    it->second.deleted = true;
+  } else {
+    s->deallocate(it->second.offset, it->second.size);
+    s->objects.erase(it);
+  }
   pthread_mutex_unlock(&s->mu);
   return RTPU_OK;
 }

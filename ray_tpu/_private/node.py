@@ -343,6 +343,10 @@ class Node:
     def _launch(self, spec: TaskSpec) -> None:
         self._drop_pending(spec)
         self._sema.acquire()
+        # Pairs this acquire with exactly one release: the worker may
+        # release early (before completing futures — see
+        # worker._release_task_resources) or the `finally` below does.
+        spec._resources_released = False
         with self._running_lock:
             self._running.add(spec.task_id)
 
@@ -352,9 +356,11 @@ class Node:
             finally:
                 with self._running_lock:
                     self._running.discard(spec.task_id)
-                if spec.kind != TaskKind.ACTOR_CREATION:
+                if (spec.kind != TaskKind.ACTOR_CREATION
+                        and not getattr(spec, "_resources_released", True)):
                     # Actors hold their resources for their whole lifetime;
                     # the runtime releases them on actor death.
+                    spec._resources_released = True
                     self.ledger.release(spec.resources)
                 self._sema.release()
 

@@ -22,6 +22,7 @@ import os
 import pickle
 import sys
 import threading
+import weakref
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
@@ -122,6 +123,10 @@ class LocalObjectStore:
         self._used = 0
         self.stats = {"puts": 0, "gets": 0, "spills": 0, "restores": 0,
                       "evictions": 0, "native_puts": 0}
+        # Outstanding zero-copy views into the native arena, per object.
+        # The C++ store defers deallocation while refs are held; this
+        # count decides whether close() may munmap (see close()).
+        self._native_views: Dict[bytes, int] = {}
         # Native C++ shm tier (plasma equivalent): holds large numpy
         # payloads as zero-copy mmap views. Optional — absent without g++.
         self._native = None
@@ -195,12 +200,32 @@ class LocalObjectStore:
             if entry.native_meta is not None:
                 import numpy as np
                 dtype, shape = entry.native_meta
-                view = self._native.get_view(object_id.binary())
-                self._native.release(object_id.binary())
+                key = object_id.binary()
+                # Zero-copy view; the native ref is HELD for the lifetime
+                # of the returned array (released by a finalizer), so a
+                # later delete() defers deallocation instead of freeing
+                # memory user code still reads (plasma client semantics).
+                view = self._native.get_view(key)  # increfs
                 arr = np.frombuffer(view, dtype=dtype).reshape(shape)
                 arr.flags.writeable = False
+                self._native_views[key] = self._native_views.get(key, 0) + 1
+                weakref.finalize(arr, self._release_native_view, key)
                 return arr
             return entry.value
+
+    def _release_native_view(self, key: bytes) -> None:
+        """Finalizer for zero-copy native-tier arrays."""
+        with self._lock:
+            n = self._native_views.get(key, 0) - 1
+            if n <= 0:
+                self._native_views.pop(key, None)
+            else:
+                self._native_views[key] = n
+            if self._native is not None:
+                try:
+                    self._native.release(key)
+                except Exception:
+                    pass
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
@@ -250,11 +275,18 @@ class LocalObjectStore:
                 self.delete(oid)
 
     def close(self) -> None:
-        """Release the native shm arena (unlinks /dev/shm segment)."""
+        """Release the native shm arena (unlinks /dev/shm segment).
+
+        If zero-copy views are still held by user code, only the segment
+        NAME is removed — the mapping is left alive so those arrays stay
+        valid (munmap would SIGSEGV them)."""
         self.clear()
         if self._native is not None:
             try:
-                self._native.close(unlink=True)
+                if self._native_views:
+                    self._native.unlink_only()
+                else:
+                    self._native.close(unlink=True)
             except Exception:
                 pass
             self._native = None
@@ -265,10 +297,14 @@ class LocalObjectStore:
         if self._used + size <= self.capacity_bytes:
             return
         # Pass 1: spill least-recently-used spillable entries to disk.
+        # Native-tier entries don't count toward _used (the C++ arena
+        # accounts for them) and pinned entries are in active use — both
+        # are skipped.
         for oid, entry in list(self._entries.items()):
             if self._used + size <= self.capacity_bytes:
                 break
-            if (entry.device_tier or entry.spilled_path is not None):
+            if (entry.device_tier or entry.spilled_path is not None
+                    or entry.native_meta is not None or entry.pinned > 0):
                 continue
             if self._spill_dir is not None:
                 self._spill(oid, entry)

@@ -645,6 +645,12 @@ class Runtime:
             return
         self.task_events.record(task_id=spec.task_id.hex(),
                                 name=spec.name, event="FINISHED")
+        # Release the task's resources BEFORE completing the futures: a
+        # driver unblocked by get() must observe the node's ledger already
+        # freed, or back-to-back submit-after-get races see the node as
+        # busy and locality-biased scheduling scatters (the node's
+        # dispatch `finally` skips the release via the spec flag).
+        self._release_task_resources(spec, node)
         values: List[Any]
         n = spec.num_returns
         if n == 1 or not isinstance(n, int):
@@ -725,6 +731,16 @@ class Runtime:
             self._submit_with_deps(respec, inflight, deps)
 
     # -- streaming generators ----------------------------------------------
+    def _release_task_resources(self, spec: TaskSpec,
+                                node: Optional[Node]) -> None:
+        """Idempotent early release (runs on the worker thread, strictly
+        before the node dispatch loop's own `finally` release)."""
+        from ray_tpu._private.task_spec import TaskKind
+        if (node is not None and spec.kind != TaskKind.ACTOR_CREATION
+                and not getattr(spec, "_resources_released", False)):
+            spec._resources_released = True
+            node.ledger.release(spec.resources)
+
     def _drain_generator(self, spec: TaskSpec, node: Node, gen) -> None:
         state = self._generators.setdefault(
             spec.task_id, GeneratorState(spec.backpressure_num_objects))

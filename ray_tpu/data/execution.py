@@ -227,9 +227,35 @@ def _range_partition_task(block: Block, key: str, bounds: List,
     return out if len(out) > 1 else out[0]
 
 
+def _stable_hash(x) -> int:
+    """Deterministic across interpreters/hosts (builtin hash() is salted
+    by PYTHONHASHSEED for str/bytes — two join sides running in different
+    worker processes would partition differently and drop matches).
+
+    Preserves builtin hash()'s equality invariant for keys that compare
+    equal across numeric types: True == 1 == 1.0 and -0.0 == 0.0 must all
+    land in the same partition."""
+    import math
+    import zlib
+    if isinstance(x, bytes):
+        b = x
+    elif isinstance(x, str):
+        b = x.encode()
+    elif isinstance(x, (bool, np.bool_, int, np.integer)) or (
+            isinstance(x, (float, np.floating)) and math.isfinite(x)
+            and float(x).is_integer() and abs(x) < 2**63):
+        # one canonical encoding for all integral numerics (incl. -0.0)
+        b = int(x).to_bytes(16, "little", signed=True)
+    elif isinstance(x, (float, np.floating)):
+        b = np.float64(x).tobytes()
+    else:
+        b = repr(x).encode()
+    return zlib.crc32(b)
+
+
 def _hash_partition_task(block: Block, key: str, n: int) -> List[Block]:
     col = block.column(key).to_numpy(zero_copy_only=False)
-    h = np.asarray([hash(x) % n for x in col], np.int64)
+    h = np.asarray([_stable_hash(x) % n for x in col], np.int64)
     out = [block.take(np.nonzero(h == p)[0]) for p in range(n)]
     return out if n > 1 else out[0]
 

@@ -55,6 +55,7 @@ def _load():
         lib.rtpu_store_open.restype = ctypes.c_void_p
         lib.rtpu_store_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
         lib.rtpu_store_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.rtpu_store_unlink.argtypes = [ctypes.c_void_p]
         lib.rtpu_store_base.restype = ctypes.c_void_p
         lib.rtpu_store_base.argtypes = [ctypes.c_void_p]
         lib.rtpu_store_capacity.restype = ctypes.c_uint64
@@ -68,6 +69,7 @@ def _load():
         lib.rtpu_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                     ctypes.c_uint64, u64p]
         lib.rtpu_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.rtpu_get.restype = ctypes.c_int
         lib.rtpu_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u64p,
                                  u64p]
@@ -125,7 +127,11 @@ class ShmObjectStore:
                             offset=off.value)
         dst[:] = np.frombuffer(payload, np.uint8)
         self._lib.rtpu_seal(self._handle, object_id)
-        if not pin:
+        if pin:
+            # Keep the creator ref and tell the store so that delete()
+            # consumes it (instead of deferring deallocation forever).
+            self._lib.rtpu_pin(self._handle, object_id)
+        else:
             self._lib.rtpu_release(self._handle, object_id)
 
     def get_view(self, object_id: bytes) -> np.ndarray:
@@ -167,3 +173,11 @@ class ShmObjectStore:
         if not self._closed:
             self._closed = True
             self._lib.rtpu_store_close(self._handle, 1 if unlink else 0)
+
+    def unlink_only(self) -> None:
+        """Remove the /dev/shm name but keep the mapping alive: used at
+        shutdown while zero-copy views into the arena are still held by
+        user code (munmap would turn them into SIGSEGVs)."""
+        if not self._closed:
+            self._closed = True
+            self._lib.rtpu_store_unlink(self._handle)

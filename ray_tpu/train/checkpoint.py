@@ -54,16 +54,24 @@ class Checkpoint:
         leaves, treedef = jax.tree.flatten(tree)
         arrays = {}
         scalars: Dict[str, Any] = {}
+        dtypes: Dict[str, str] = {}
         for i, leaf in enumerate(leaves):
             if hasattr(leaf, "shape"):
                 # jax.device_get gathers sharded arrays to host once.
-                arrays[f"a{i}"] = np.asarray(jax.device_get(leaf))
+                arr = np.asarray(jax.device_get(leaf))
+                # np.savez silently stores ml_dtypes leaves (bfloat16/fp8,
+                # the common TPU dtypes) as raw void — record the dtype
+                # name + shape and save raw bytes, re-viewing on load.
+                if arr.dtype.type.__module__ != "numpy":
+                    dtypes[f"a{i}"] = (arr.dtype.name, arr.shape)
+                    arr = np.frombuffer(arr.tobytes(), np.uint8)
+                arrays[f"a{i}"] = arr
             else:
                 scalars[f"a{i}"] = leaf
         np.savez(os.path.join(path, "leaves.npz"), **arrays)
         with open(os.path.join(path, "treedef.pkl"), "wb") as f:
             pickle.dump({"treedef": treedef, "scalars": scalars,
-                         "n_leaves": len(leaves)}, f)
+                         "dtypes": dtypes, "n_leaves": len(leaves)}, f)
         return Checkpoint(path)
 
     def to_pytree(self, shardings: Any = None) -> Any:
@@ -74,11 +82,18 @@ class Checkpoint:
         with open(os.path.join(self.path, "treedef.pkl"), "rb") as f:
             meta = pickle.load(f)
         data = np.load(os.path.join(self.path, "leaves.npz"))
+        dtypes = meta.get("dtypes", {})
         leaves: List[Any] = []
         for i in range(meta["n_leaves"]):
             key = f"a{i}"
-            leaves.append(meta["scalars"][key] if key in meta["scalars"]
-                          else data[key])
+            if key in meta["scalars"]:
+                leaves.append(meta["scalars"][key])
+            elif key in dtypes:
+                name, shape = dtypes[key]
+                leaves.append(np.frombuffer(
+                    data[key].tobytes(), np.dtype(name)).reshape(shape))
+            else:
+                leaves.append(data[key])
         tree = jax.tree.unflatten(meta["treedef"], leaves)
         if shardings is not None:
             tree = jax.tree.map(
@@ -123,12 +138,14 @@ class CheckpointManager:
         return float(self._counter)  # FIFO: newest kept
 
     def _evict(self) -> None:
+        # Entries stay in registration order (latest_checkpoint() relies
+        # on it); the victim is selected with min(), not by sorting.
         if self.num_to_keep is None:
             return
         while len(self._entries) > self.num_to_keep:
-            self._entries.sort(key=lambda e: e[0])
-            score, path, _ = self._entries.pop(0)
-            shutil.rmtree(path, ignore_errors=True)
+            victim = min(self._entries, key=lambda e: e[0])
+            self._entries.remove(victim)
+            shutil.rmtree(victim[1], ignore_errors=True)
 
     def best_checkpoint(self) -> Optional[Checkpoint]:
         if not self._entries:

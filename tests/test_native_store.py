@@ -86,3 +86,58 @@ def test_free_list_coalescing(store):
     store.delete(b"b")
     store.put(b"big", b"\x04" * (2 * third))  # needs coalesced a+b
     assert store.contains(b"big")
+
+
+def test_deferred_delete_while_view_held(store):
+    """delete() with outstanding get refs must NOT free the range (the
+    reference's plasma keeps client buffers valid for their lifetime);
+    deallocation happens at the last release (ADVICE r1 high)."""
+    data = np.arange(4096, dtype=np.float64)
+    store.put(b"live", data.tobytes())
+    view = store.get_view(b"live")           # incref
+    store.delete(b"live")                    # deferred: ref outstanding
+    # Unreachable for new gets...
+    with pytest.raises(KeyError):
+        store.get_view(b"live")
+    # ...but the held view must still read intact data, even after the
+    # allocator is pressured to reuse space.
+    filler = np.zeros(8192, np.uint8)
+    for i in range(20):
+        try:
+            store.put(b"f%d" % i, filler.tobytes())
+        except ShmStoreFull:
+            break
+    np.testing.assert_array_equal(np.frombuffer(view, np.float64), data)
+    before = store.used_bytes()
+    store.release(b"live")                   # last ref: frees now
+    assert store.used_bytes() == before - data.nbytes
+
+
+def test_deferred_deleted_object_not_evictable(store):
+    data = np.ones(1024, np.float64)
+    store.put(b"zombie", data.tobytes())
+    view = store.get_view(b"zombie")
+    store.delete(b"zombie")
+    # LRU eviction must skip the zombie (readers hold it).
+    freed = store.evict(1 << 30)
+    np.testing.assert_array_equal(np.frombuffer(view, np.float64), data)
+    store.release(b"zombie")
+
+
+def test_pinned_delete_frees_space(store):
+    """delete() of a pinned object (the production LocalObjectStore path)
+    must consume the creator's pin ref and free the range — not leak a
+    permanent zombie."""
+    data = np.arange(2048, dtype=np.float64)
+    store.put(b"pinned", data.tobytes(), pin=True)
+    before = store.used_bytes()
+    store.delete(b"pinned")
+    assert store.used_bytes() == before - data.nbytes
+    # Pinned + outstanding view: deferred until the view's release.
+    store.put(b"pinned2", data.tobytes(), pin=True)
+    view = store.get_view(b"pinned2")
+    store.delete(b"pinned2")
+    np.testing.assert_array_equal(np.frombuffer(view, np.float64), data)
+    before = store.used_bytes()
+    store.release(b"pinned2")
+    assert store.used_bytes() == before - data.nbytes

@@ -116,3 +116,62 @@ def test_generator_retry_keeps_stream_binding(ray_start_cluster):
     it = gen.remote()
     out = [ray_tpu.get(r) for r in it]
     assert out == [0, 1, 2]
+
+
+def test_checkpoint_bfloat16_roundtrip(tmp_path):
+    """ml_dtypes leaves (bf16 — the common TPU param dtype) must round-trip
+    with their dtype intact, not as raw void (ADVICE r1 medium)."""
+    import jax.numpy as jnp
+    import ml_dtypes
+    import numpy as np
+    from ray_tpu.train.checkpoint import Checkpoint
+
+    tree = {"w": jnp.ones((3, 4), jnp.bfloat16),
+            "b": np.zeros((2,), np.float32),
+            "e": np.float8_e4m3fn(1.5) if hasattr(np, "float8_e4m3fn")
+                 else np.asarray(1.5, ml_dtypes.bfloat16),
+            "step": 7}
+    ckpt = Checkpoint.from_pytree(tree, str(tmp_path / "c"))
+    back = ckpt.to_pytree()
+    assert back["w"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(back["w"], np.float32), np.ones((3, 4), np.float32))
+    assert back["b"].dtype == np.float32
+    assert back["step"] == 7
+
+
+def test_checkpoint_manager_latest_after_evict(tmp_path):
+    """latest_checkpoint() must track registration order even after
+    score-based eviction (ADVICE r1 low: in-place sort broke it)."""
+    from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "root"), num_to_keep=2,
+                            score_attribute="acc", score_order="max")
+    paths = []
+    for i, acc in enumerate([0.9, 0.1, 0.5]):
+        d = tmp_path / f"src{i}"
+        d.mkdir()
+        (d / "x.txt").write_text(str(i))
+        paths.append(mgr.register(Checkpoint(str(d)), {"acc": acc}))
+    # acc=0.1 (2nd) evicted; latest must be the 3rd registered, best the 1st
+    assert mgr.latest_checkpoint().path == paths[2]
+    assert mgr.best_checkpoint().path == paths[0]
+
+
+def test_stable_hash_partition_deterministic():
+    """join/aggregate partitioning must not depend on PYTHONHASHSEED
+    (ADVICE r1 low)."""
+    import subprocess
+    import sys
+
+    code = ("from ray_tpu.data.execution import _stable_hash;"
+            "print([_stable_hash(x) for x in"
+            " ['key-a', b'key-b', 42, 3.5, True, None]])")
+    outs = {
+        subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            env={**__import__('os').environ, "PYTHONHASHSEED": seed,
+                 "JAX_PLATFORMS": "cpu"},
+        ).stdout.strip()
+        for seed in ("0", "1", "12345")}
+    assert len(outs) == 1 and "[" in next(iter(outs))
