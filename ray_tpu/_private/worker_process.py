@@ -1,0 +1,988 @@
+"""Process workers: the default execution path for tasks and actors.
+
+Reference capability (NOT a port): the raylet worker pool + core-worker
+execution plane — workers are real OS processes
+(``src/ray/raylet/worker_pool.h`` StartWorkerProcess/PopWorker/prestart),
+every task payload crosses a serialization boundary
+(``python/ray/_private/serialization.py``), functions are shipped through
+a function table (``python/ray/_private/function_manager.py:196,265``),
+and workers reach back into the cluster for nested operations
+(``CoreWorkerService`` RPCs, ``protobuf/core_worker.proto:457-577``).
+
+TPU-first placement rule: work that touches the accelerator (declares TPU
+resources, or consumes device-tier ``jax.Array`` arguments) runs in the
+mesh-owning process — one process owns the chip/mesh and XLA releases the
+GIL, so in-process threads are the right execution vehicle for SPMD work.
+Everything else (the control/data plane) runs in spawned worker processes
+pinned to the host CPU platform.
+
+Architecture (single host; the pipe is the wire):
+
+  host Runtime ── WorkerClient ──(mp.Pipe, cloudpickle frames)── worker
+    - ProcessRouter: eligibility + routing + pool mgmt + crash handling
+    - WorkerClient: one live worker process; demux reader thread routes
+      task results/yields and services worker-initiated "core" ops
+      (get/put/submit/wait/actor calls) against the host Runtime
+    - worker process: reader loop + per-task threads; a
+      WorkerProxyRuntime is installed as the global runtime so the full
+      public API (ray_tpu.get/put/remote/actors/generators) works inside
+      tasks transparently.
+
+Process actors: the actor instance lives in a dedicated worker process;
+host-side the existing ActorExecutor machinery (ordering, concurrency
+groups, restarts) drives a proxy instance whose method stubs RPC into the
+process. A dead worker process surfaces as actor death → the normal
+restart path replays the creation spec.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import inspect
+import itertools
+import os
+import queue
+import threading
+import traceback
+import weakref
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu._private.ids import ActorID, TaskID
+from ray_tpu._private.task_spec import TaskKind, TaskSpec
+
+
+class WorkerCrashed(Exception):
+    """The worker process died while something was running on it."""
+
+
+# ---------------------------------------------------------------------------
+# function table (code shipping)
+# ---------------------------------------------------------------------------
+
+_FN_TABLE: Dict[str, bytes] = {}
+_FN_TABLE_LOCK = threading.Lock()
+_FN_MEMO: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def export_function(fn) -> Tuple[str, bytes]:
+    """Serialize ``fn`` once and register it in the function table;
+    returns (function_id, blob). Workers fetch the blob by id on first
+    use and cache it (reference: function_manager.py export/fetch)."""
+    try:
+        cached = _FN_MEMO.get(fn)
+    except TypeError:
+        cached = None
+    if cached is not None:
+        return cached
+    blob = cloudpickle.dumps(fn)
+    fid = hashlib.sha1(blob).hexdigest()
+    with _FN_TABLE_LOCK:
+        _FN_TABLE[fid] = blob
+    entry = (fid, blob)
+    try:
+        _FN_MEMO[fn] = entry
+    except TypeError:
+        pass  # unweakrefable callables just re-serialize
+    return entry
+
+
+def fetch_function_blob(fid: str) -> bytes:
+    with _FN_TABLE_LOCK:
+        blob = _FN_TABLE.get(fid)
+    if blob is None:
+        raise KeyError(f"function {fid} not in function table")
+    return blob
+
+
+# ---------------------------------------------------------------------------
+# worker-process side
+# ---------------------------------------------------------------------------
+
+_current_rid = threading.local()
+
+
+def _dump_exc(e: BaseException) -> bytes:
+    tb = traceback.format_exc()
+    try:
+        return cloudpickle.dumps((e, tb))
+    except Exception:
+        return cloudpickle.dumps(
+            (RuntimeError(f"{type(e).__name__}: {e}"), tb))
+
+
+def _safe_dumps(value: Any) -> bytes:
+    return cloudpickle.dumps(value)
+
+
+class _GeneratorStateProxy:
+    """Worker-side view of a host GeneratorState (ObjectRefGenerator)."""
+
+    def __init__(self, state: "_WorkerState", task_id: TaskID):
+        self._state = state
+        self._task_id = task_id
+
+    def next_ref(self, index: int, timeout: Optional[float] = None):
+        out = self._state.call_host("gen_next", task_id=self._task_id,
+                                    index=index, timeout=timeout)
+        if out is None:
+            raise StopIteration
+        return out
+
+    @property
+    def finished(self) -> bool:
+        return self._state.call_host("gen_finished", task_id=self._task_id)
+
+
+class _GcsProxy:
+    def __init__(self, state: "_WorkerState"):
+        self._state = state
+
+    def get_actor_info(self, actor_id):
+        return self._state.call_host("gcs_get_actor_info",
+                                     actor_id=actor_id)
+
+    def get_named_actor(self, name, namespace):
+        return self._state.call_host("gcs_get_named_actor", name=name,
+                                     namespace=namespace)
+
+
+class _NoopRefcounter:
+    """Worker-held refs are kept alive host-side per task/actor (the host
+    pins every ref a worker creates until the task — or the actor — ends),
+    so worker-local counting is intentionally a no-op."""
+
+    def add_local_ref(self, oid):
+        pass
+
+    def remove_local_ref(self, oid):
+        pass
+
+
+class WorkerProxyRuntime:
+    """Installed as the global runtime inside a worker process: forwards
+    the core API to the host over the pipe. Duck-types the Runtime surface
+    that ObjectRef / RemoteFunction / ActorHandle / the module-level API
+    touch."""
+
+    def __init__(self, state: "_WorkerState"):
+        self._state = state
+        self.refcounter = _NoopRefcounter()
+        self.gcs = _GcsProxy(state)
+        self._actor_lock = threading.RLock()
+        self._actor_executors: Dict[ActorID, Any] = {}
+
+    # Pooled workers serve different runtimes over their lifetime, so
+    # job/namespace are fetched from the currently-bound host.
+    @property
+    def namespace(self):
+        return self._state.call_host("host_info")["namespace"]
+
+    @property
+    def job_id(self):
+        return self._state.call_host("host_info")["job_id"]
+
+    # -- objects ---------------------------------------------------------
+    def get(self, refs, timeout: Optional[float] = None):
+        return self._state.call_host("get", refs=list(refs),
+                                     timeout=timeout)
+
+    def put(self, value, _owner_pin: bool = False):
+        return self._state.call_host("put", value=value)
+
+    def wait(self, refs, num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True):
+        return self._state.call_host("wait", refs=list(refs),
+                                     num_returns=num_returns,
+                                     timeout=timeout,
+                                     fetch_local=fetch_local)
+
+    # -- tasks / actors --------------------------------------------------
+    def submit_task(self, spec: TaskSpec, record_lineage: bool = True):
+        return self._state.call_host("submit_task", spec=spec)
+
+    def create_actor(self, spec: TaskSpec, get_if_exists: bool = False):
+        return self._state.call_host("create_actor", spec=spec,
+                                     get_if_exists=get_if_exists)
+
+    def kill_actor(self, actor_id, no_restart: bool = True,
+                   cause: str = "ray_tpu.kill() called"):
+        return self._state.call_host("kill_actor", actor_id=actor_id,
+                                     no_restart=no_restart, cause=cause)
+
+    def cancel(self, ref, force: bool = False, recursive: bool = True):
+        return self._state.call_host("cancel", ref=ref, force=force,
+                                     recursive=recursive)
+
+    def generator_state(self, task_id: TaskID) -> _GeneratorStateProxy:
+        return _GeneratorStateProxy(self._state, task_id)
+
+    # -- cluster introspection -------------------------------------------
+    def cluster_resources(self):
+        return self._state.call_host("cluster_resources")
+
+    def available_resources(self):
+        return self._state.call_host("available_resources")
+
+
+class _WorkerState:
+    def __init__(self, conn, boot: Dict[str, Any]):
+        self.conn = conn
+        self.boot = boot
+        self.namespace = boot.get("namespace", "default")
+        self.job_id = boot.get("job_id")
+        self._send_lock = threading.Lock()
+        self._ids = itertools.count()
+        self._pending: Dict[str, list] = {}
+        self._pending_lock = threading.Lock()
+        self._task_threads: Dict[str, threading.Thread] = {}
+        self.actor_instance: Any = None
+        self._fn_cache: Dict[str, Any] = {}
+        self.proxy = WorkerProxyRuntime(self)
+
+    def send(self, msg: Dict[str, Any]) -> None:
+        blob = cloudpickle.dumps(msg)
+        with self._send_lock:
+            self.conn.send_bytes(blob)
+
+    def call_host(self, call: str, **kw) -> Any:
+        rid = f"w{next(self._ids)}"
+        ev = threading.Event()
+        slot = [ev, True, None]
+        with self._pending_lock:
+            self._pending[rid] = slot
+        self.send({"op": "core", "id": rid, "call": call,
+                   "task": getattr(_current_rid, "rid", None),
+                   "payload": cloudpickle.dumps(kw)})
+        ev.wait()
+        if slot[1]:
+            return slot[2]
+        raise slot[2]
+
+    # -- main loop -------------------------------------------------------
+    def serve_forever(self) -> None:
+        while True:
+            try:
+                msg = cloudpickle.loads(self.conn.recv_bytes())
+            except (EOFError, OSError, ConnectionResetError):
+                os._exit(0)
+            op = msg.get("op")
+            if op == "shutdown":
+                os._exit(0)
+            elif op == "reply":
+                with self._pending_lock:
+                    slot = self._pending.pop(msg["for"], None)
+                if slot is not None:
+                    slot[1] = msg["ok"]
+                    slot[2] = cloudpickle.loads(msg["value"])
+                    slot[0].set()
+            elif op in ("execute_task", "create_actor", "call_method"):
+                t = threading.Thread(target=self._handle, args=(msg,),
+                                     daemon=True,
+                                     name=f"task-{msg['id']}")
+                self._task_threads[msg["id"]] = t
+                t.start()
+            elif op == "cancel":
+                self._async_raise(msg["target"])
+
+    def _async_raise(self, rid: str) -> None:
+        """Best-effort KeyboardInterrupt into the thread running ``rid``
+        (reference: non-force ray.cancel interrupts the worker)."""
+        import ctypes
+        t = self._task_threads.get(rid)
+        if t is None or not t.is_alive():
+            return
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(t.ident), ctypes.py_object(KeyboardInterrupt))
+
+    def _fn(self, msg: Dict[str, Any]):
+        if "fn_blob" in msg:
+            return cloudpickle.loads(msg["fn_blob"])
+        fid = msg["fn_id"]
+        fn = self._fn_cache.get(fid)
+        if fn is None:
+            fn = cloudpickle.loads(self.call_host("fetch_function",
+                                                  fid=fid))
+            self._fn_cache[fid] = fn
+        return fn
+
+    def _handle(self, msg: Dict[str, Any]) -> None:
+        from ray_tpu._private import runtime_context
+        from ray_tpu.runtime_env import apply_runtime_env
+
+        rid = msg["id"]
+        _current_rid.rid = rid
+        ctx = msg.get("ctx") or {}
+        try:
+            token = runtime_context._set_context(**ctx)
+            try:
+                with apply_runtime_env(msg.get("runtime_env")):
+                    if msg["op"] == "create_actor":
+                        cls = self._fn(msg)
+                        args, kwargs = cloudpickle.loads(msg["args_blob"])
+                        self.actor_instance = cls(*args, **kwargs)
+                        result = None
+                    elif msg["op"] == "call_method":
+                        method = getattr(self.actor_instance, msg["method"])
+                        args, kwargs = cloudpickle.loads(msg["args_blob"])
+                        result = method(*args, **kwargs)
+                    else:
+                        fn = self._fn(msg)
+                        args, kwargs = cloudpickle.loads(msg["args_blob"])
+                        result = fn(*args, **kwargs)
+                    if inspect.isgenerator(result):
+                        self.send({"id": rid, "op": "gen_start"})
+                        for item in result:
+                            self.send({"id": rid, "op": "yield",
+                                       "blob": _safe_dumps(item)})
+                        self.send({"id": rid, "op": "result", "ok": True,
+                                   "blob": _safe_dumps(None)})
+                        return
+            finally:
+                runtime_context._reset_context(token)
+            self.send({"id": rid, "op": "result", "ok": True,
+                       "blob": _safe_dumps(result)})
+        except BaseException as e:  # noqa: BLE001 — shipped to host
+            try:
+                self.send({"id": rid, "op": "result", "ok": False,
+                           "blob": _dump_exc(e)})
+            except (BrokenPipeError, OSError):
+                os._exit(1)
+        finally:
+            self._task_threads.pop(rid, None)
+
+
+def _child_main(fd: int) -> None:
+    """Worker bootstrap, launched as ``python -c`` with an inherited pipe
+    fd (NOT multiprocessing spawn — that re-imports the parent's __main__,
+    which breaks under REPLs/stdin drivers and pulls arbitrary driver-side
+    module state into every worker). The first frame on the pipe is the
+    boot config."""
+    from multiprocessing.connection import Connection
+
+    conn = Connection(fd)
+    boot = cloudpickle.loads(conn.recv_bytes())
+    os.environ.update(boot.get("env", {}))
+    if boot.get("force_cpu_platform"):
+        # Must beat any sitecustomize JAX_PLATFORMS pinning; config-level
+        # override, applied before any backend touch.
+        from ray_tpu._private.platform import force_cpu_platform
+        force_cpu_platform(boot.get("cpu_devices"))
+    from ray_tpu._private import worker as worker_mod
+
+    state = _WorkerState(conn, boot)
+    worker_mod._global_runtime = state.proxy  # type: ignore[assignment]
+    state.serve_forever()
+
+
+# ---------------------------------------------------------------------------
+# host side
+# ---------------------------------------------------------------------------
+
+class _Pending:
+    __slots__ = ("q",)
+
+    def __init__(self):
+        self.q: "queue.Queue" = queue.Queue()
+
+
+_DEAD = object()  # sentinel pushed into pending queues on worker death
+
+
+_BOOT_CODE = ("import sys; "
+              "from ray_tpu._private.worker_process import _child_main; "
+              "_child_main(int(sys.argv[1]))")
+
+
+class WorkerClient:
+    """Host handle to one worker process."""
+
+    def __init__(self, boot: Dict[str, Any]):
+        import multiprocessing as mp
+        import subprocess
+        import sys
+        self.conn, child = mp.Pipe()
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo_root + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        fd = child.fileno()
+        self.proc = subprocess.Popen(
+            [sys.executable, "-c", _BOOT_CODE, str(fd)],
+            pass_fds=(fd,), env=env, start_new_session=True)
+        child.close()
+        # First frame: boot config (platform pinning etc.).
+        self.conn.send_bytes(cloudpickle.dumps(boot))
+        self._send_lock = threading.Lock()
+        self._ids = itertools.count()
+        self._pending: Dict[str, _Pending] = {}
+        self._pending_lock = threading.Lock()
+        # Objects created on behalf of the worker (refs from put/submit),
+        # pinned until the creating task — or the whole actor — ends.
+        self._holds: Dict[str, List[Any]] = {}
+        self.runtime = None          # bound by the router on assignment
+        self.node = None
+        self.actor_id: Optional[ActorID] = None
+        self.expected_death = False
+        self.dead = False
+        self.calls = 0
+        self._on_death: List[Any] = []
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name=f"wkr-read-{self.proc.pid}")
+        self._reader.start()
+
+    # -- plumbing --------------------------------------------------------
+    def _send(self, msg: Dict[str, Any]) -> None:
+        blob = cloudpickle.dumps(msg)
+        try:
+            with self._send_lock:
+                self.conn.send_bytes(blob)
+        except (BrokenPipeError, OSError):
+            raise WorkerCrashed(
+                f"worker {self.proc.pid} pipe closed "
+                f"(exitcode={self.proc.poll()})")
+
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                msg = cloudpickle.loads(self.conn.recv_bytes())
+            except (EOFError, OSError, ConnectionResetError):
+                self._on_dead()
+                return
+            except Exception:
+                self._on_dead()
+                return
+            op = msg.get("op")
+            if op in ("result", "gen_start", "yield"):
+                with self._pending_lock:
+                    pend = self._pending.get(msg["id"])
+                if pend is not None:
+                    pend.q.put(msg)
+            elif op == "core":
+                threading.Thread(target=self._serve_core, args=(msg,),
+                                 daemon=True).start()
+
+    def _on_dead(self) -> None:
+        if self.dead:
+            return
+        self.dead = True
+        with self._pending_lock:
+            pending = list(self._pending.values())
+        for p in pending:
+            p.q.put(_DEAD)
+        self._holds.clear()
+        callbacks, self._on_death = self._on_death, []
+        for cb in callbacks:
+            try:
+                cb(self)
+            except Exception:
+                pass
+
+    def add_death_callback(self, cb) -> None:
+        if self.dead:
+            cb(self)
+        else:
+            self._on_death.append(cb)
+
+    def alive(self) -> bool:
+        return not self.dead and self.proc.poll() is None
+
+    def kill(self, expected: bool = True) -> None:
+        import subprocess
+        self.expected_death = self.expected_death or expected
+        try:
+            self._send({"op": "shutdown"})
+        except WorkerCrashed:
+            pass
+        try:
+            self.proc.wait(timeout=0.5)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            try:
+                self.proc.wait(timeout=1.0)
+            except subprocess.TimeoutExpired:
+                pass
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+    # -- worker-initiated core ops --------------------------------------
+    def _serve_core(self, msg: Dict[str, Any]) -> None:
+        try:
+            value = self._core_dispatch(msg)
+            reply = {"op": "reply", "for": msg["id"], "ok": True,
+                     "value": cloudpickle.dumps(value)}
+        except BaseException as e:  # noqa: BLE001 — shipped back
+            try:
+                blob = cloudpickle.dumps(e)
+            except Exception:
+                blob = cloudpickle.dumps(RuntimeError(repr(e)))
+            reply = {"op": "reply", "for": msg["id"], "ok": False,
+                     "value": blob}
+        try:
+            self._send(reply)
+        except WorkerCrashed:
+            pass
+
+    def _hold(self, task_rid: Optional[str], obj: Any) -> None:
+        key = task_rid or "__actor__"
+        if self.actor_id is not None:
+            key = "__actor__"  # actor-held refs live as long as the actor
+        self._holds.setdefault(key, []).append(obj)
+
+    def _core_dispatch(self, msg: Dict[str, Any]) -> Any:
+        rt = self.runtime
+        if rt is None:
+            raise RuntimeError("worker not bound to a runtime")
+        kw = cloudpickle.loads(msg["payload"])
+        call = msg["call"]
+        task_rid = msg.get("task")
+        if call == "get":
+            return rt.get(kw["refs"], timeout=kw.get("timeout"))
+        if call == "put":
+            ref = rt.put(kw["value"])
+            self._hold(task_rid, ref)
+            return ref
+        if call == "wait":
+            return rt.wait(kw["refs"], num_returns=kw["num_returns"],
+                           timeout=kw["timeout"],
+                           fetch_local=kw["fetch_local"])
+        if call == "submit_task":
+            refs = rt.submit_task(kw["spec"])
+            self._hold(task_rid, refs)
+            return refs
+        if call == "create_actor":
+            return rt.create_actor(kw["spec"],
+                                   get_if_exists=kw["get_if_exists"])
+        if call == "kill_actor":
+            return rt.kill_actor(kw["actor_id"],
+                                 no_restart=kw["no_restart"],
+                                 cause=kw["cause"])
+        if call == "cancel":
+            return rt.cancel(kw["ref"], force=kw["force"],
+                             recursive=kw["recursive"])
+        if call == "gen_next":
+            state = rt.generator_state(kw["task_id"])
+            try:
+                ref = state.next_ref(kw["index"], timeout=kw.get("timeout"))
+                self._hold(task_rid, ref)
+                return ref
+            except StopIteration:
+                return None
+        if call == "gen_finished":
+            return rt.generator_state(kw["task_id"]).finished
+        if call == "gcs_get_actor_info":
+            return rt.gcs.get_actor_info(kw["actor_id"])
+        if call == "gcs_get_named_actor":
+            return rt.gcs.get_named_actor(kw["name"], kw["namespace"])
+        if call == "fetch_function":
+            return fetch_function_blob(kw["fid"])
+        if call == "host_info":
+            return {"namespace": rt.namespace, "job_id": rt.job_id}
+        if call == "cluster_resources":
+            return rt.cluster_resources()
+        if call == "available_resources":
+            return rt.available_resources()
+        raise ValueError(f"unknown core op {call!r}")
+
+    # -- host-initiated work --------------------------------------------
+    def _request(self, msg: Dict[str, Any]) -> Tuple[str, _Pending]:
+        rid = f"h{next(self._ids)}"
+        msg["id"] = rid
+        pend = _Pending()
+        with self._pending_lock:
+            self._pending[rid] = pend
+        if self.dead:
+            pend.q.put(_DEAD)
+            return rid, pend
+        self._send(msg)
+        return rid, pend
+
+    def _finish(self, rid: str) -> None:
+        with self._pending_lock:
+            self._pending.pop(rid, None)
+        self._holds.pop(rid, None)
+
+    def _wait_outcome(self, rid: str, pend: _Pending):
+        """First message decides: value result, error, or generator."""
+        msg = pend.q.get()
+        if msg is _DEAD:
+            self._finish(rid)
+            raise WorkerCrashed(
+                f"worker process {self.proc.pid} died "
+                f"(exitcode={self.proc.poll()})")
+        if msg["op"] == "gen_start":
+            return ("gen", self._gen_iter(rid, pend))
+        ok = msg["ok"]
+        payload = cloudpickle.loads(msg["blob"])
+        self._finish(rid)
+        if ok:
+            return ("ok", payload)
+        e, tb = payload
+        setattr(e, "_remote_traceback", tb)
+        return ("err", e)
+
+    def _gen_iter(self, rid: str, pend: _Pending):
+        try:
+            while True:
+                msg = pend.q.get()
+                if msg is _DEAD:
+                    raise WorkerCrashed(
+                        f"worker process {self.proc.pid} died mid-stream")
+                if msg["op"] == "yield":
+                    yield cloudpickle.loads(msg["blob"])
+                    continue
+                ok = msg["ok"]
+                payload = cloudpickle.loads(msg["blob"])
+                if not ok:
+                    e, tb = payload
+                    setattr(e, "_remote_traceback", tb)
+                    raise e
+                return
+        finally:
+            self._finish(rid)
+
+    @staticmethod
+    def _ctx_fields(spec: TaskSpec, node, runtime) -> Dict[str, Any]:
+        return {
+            "job_id": runtime.job_id,
+            "task_id": spec.task_id,
+            "node_id": node.node_id if node is not None else None,
+            "actor_id": spec.actor_id,
+            "resources": spec.resources,
+            "task_name": spec.name,
+            "placement_group_id": spec.placement_group_id,
+            "pg_capture": spec.pg_capture,
+        }
+
+    def execute_task(self, spec: TaskSpec, node, fid: str,
+                     args_blob: bytes):
+        self.calls += 1
+        rid, pend = self._request({
+            "op": "execute_task", "fn_id": fid, "args_blob": args_blob,
+            "ctx": self._ctx_fields(spec, node, self.runtime),
+            "runtime_env": spec.runtime_env,
+        })
+        self.runtime.process_router.track_task(spec.task_id, self, rid)
+        try:
+            return self._wait_outcome(rid, pend)
+        finally:
+            self.runtime.process_router.untrack_task(spec.task_id)
+
+    def create_actor_instance(self, spec: TaskSpec, node, fid: str,
+                              args_blob: bytes):
+        self.calls += 1
+        rid, pend = self._request({
+            "op": "create_actor", "fn_id": fid, "args_blob": args_blob,
+            "ctx": self._ctx_fields(spec, node, self.runtime),
+            "runtime_env": spec.runtime_env,
+        })
+        return self._wait_outcome(rid, pend)
+
+    def call_method(self, spec: TaskSpec, node, args_blob: bytes):
+        self.calls += 1
+        rid, pend = self._request({
+            "op": "call_method", "method": spec.method_name,
+            "args_blob": args_blob,
+            "ctx": self._ctx_fields(spec, node, self.runtime),
+            "runtime_env": spec.runtime_env,
+        })
+        return self._wait_outcome(rid, pend)
+
+    def cancel_request(self, rid: str) -> None:
+        try:
+            self._send({"op": "cancel", "target": rid})
+        except WorkerCrashed:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# pool (module-level: idle workers survive runtime shutdown and are reused
+# across test runtimes — reference: worker prestart/reuse across jobs)
+# ---------------------------------------------------------------------------
+
+_POOL_LOCK = threading.Lock()
+_IDLE: List[WorkerClient] = []
+_PRESTARTING = [0]
+
+
+def _pool_target() -> int:
+    return int(os.environ.get("RAY_TPU_PROCESS_POOL_SIZE",
+                              str(min(4, max(2, (os.cpu_count() or 4) // 2)))))
+
+
+def _make_boot() -> Dict[str, Any]:
+    boot: Dict[str, Any] = {"env": {}}
+    # Workers never own the accelerator: pin them to the CPU platform with
+    # the same virtual device count the host uses (so jax-in-worker works
+    # under the test mesh and cannot fight over the chip).
+    boot["force_cpu_platform"] = True
+    n = None
+    try:
+        import re
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)",
+                      os.environ.get("XLA_FLAGS", ""))
+        if m:
+            n = int(m.group(1))
+    except Exception:
+        pass
+    boot["cpu_devices"] = n
+    return boot
+
+
+def _spawn_worker() -> WorkerClient:
+    return WorkerClient(_make_boot())
+
+
+def acquire_worker() -> WorkerClient:
+    with _POOL_LOCK:
+        while _IDLE:
+            w = _IDLE.pop()
+            if w.alive():
+                _maybe_prestart_async()
+                return w
+            w.kill()
+    _maybe_prestart_async()
+    return _spawn_worker()
+
+
+def release_worker(w: WorkerClient) -> None:
+    if w.actor_id is not None or not w.alive():
+        w.kill(expected=True)
+        return
+    w.runtime = None
+    w.node = None
+    with _POOL_LOCK:
+        if len(_IDLE) >= _pool_target():
+            w.kill(expected=True)
+            return
+        _IDLE.append(w)
+
+
+def _maybe_prestart_async() -> None:
+    """Keep the idle pool warm in the background (reference: PrestartWorkers)."""
+    def fill():
+        try:
+            while True:
+                with _POOL_LOCK:
+                    deficit = _pool_target() - len(_IDLE) - _PRESTARTING[0]
+                    if deficit <= 0:
+                        return
+                    _PRESTARTING[0] += 1
+                try:
+                    w = _spawn_worker()
+                finally:
+                    with _POOL_LOCK:
+                        _PRESTARTING[0] -= 1
+                with _POOL_LOCK:
+                    if len(_IDLE) < _pool_target():
+                        _IDLE.append(w)
+                    else:
+                        w.kill()
+                        return
+        except Exception:
+            pass
+    threading.Thread(target=fill, daemon=True,
+                     name="worker-prestart").start()
+
+
+def drain_pool() -> None:
+    """Kill every idle pooled worker (test hygiene / interpreter exit)."""
+    with _POOL_LOCK:
+        idle, _IDLE[:] = list(_IDLE), []
+    for w in idle:
+        w.kill()
+
+
+# ---------------------------------------------------------------------------
+# router (owned by the Runtime)
+# ---------------------------------------------------------------------------
+
+def _contains_device_value(value: Any) -> bool:
+    from ray_tpu._private.object_store import _is_device_value
+    return _is_device_value(value)
+
+
+def _wants_accelerator(resources: Dict[str, float]) -> bool:
+    return any(k == "TPU" or k.startswith("TPU") or k == "GPU"
+               for k, v in (resources or {}).items() if v)
+
+
+class _ProcessActorInstance:
+    """Host-side proxy for an actor living in a worker process. The
+    Runtime's actor-task executor detects this type and routes method
+    calls through ProcessRouter.call_actor_method; all the host-side
+    ActorExecutor machinery (ordering, concurrency groups, restarts)
+    drives it exactly like a live instance."""
+
+    __slots__ = ("_client", "_class_name")
+
+    def __init__(self, client: WorkerClient, class_name: str):
+        self._client = client
+        self._class_name = class_name
+
+
+class ProcessRouter:
+    def __init__(self, runtime):
+        self.runtime = runtime
+        self.enabled = os.environ.get(
+            "RAY_TPU_PROCESS_WORKERS", "1") != "0"
+        self._actor_workers: Dict[ActorID, WorkerClient] = {}
+        self._lock = threading.Lock()
+        # task_id -> (client, rid) while a normal task runs in a process
+        self._running: Dict[TaskID, Tuple[WorkerClient, str]] = {}
+
+    # -- eligibility -----------------------------------------------------
+    def _serialize_payload(self, spec: TaskSpec, args, kwargs
+                           ) -> Optional[Tuple[str, bytes]]:
+        if _contains_device_value((args, kwargs)):
+            return None
+        try:
+            fid, _ = export_function(spec.func)
+            args_blob = cloudpickle.dumps((args, kwargs))
+        except Exception:
+            return None
+        return fid, args_blob
+
+    def eligible_task(self, spec: TaskSpec, args, kwargs):
+        if (not self.enabled or spec.kind != TaskKind.NORMAL
+                or _wants_accelerator(spec.resources)):
+            return None
+        return self._serialize_payload(spec, args, kwargs)
+
+    def eligible_actor(self, spec: TaskSpec, args, kwargs):
+        if (not self.enabled or spec.kind != TaskKind.ACTOR_CREATION
+                or _wants_accelerator(spec.resources)):
+            return None
+        cls = spec.func
+        if not inspect.isclass(cls):
+            return None
+        from ray_tpu._private.worker import _class_is_async
+        if _class_is_async(cls):
+            return None  # asyncio actors run on the host loop
+        return self._serialize_payload(spec, args, kwargs)
+
+    # -- normal tasks ----------------------------------------------------
+    def track_task(self, task_id: TaskID, client: WorkerClient,
+                   rid: str) -> None:
+        with self._lock:
+            self._running[task_id] = (client, rid)
+
+    def untrack_task(self, task_id: TaskID) -> None:
+        with self._lock:
+            self._running.pop(task_id, None)
+
+    def worker_pid_for_task(self, task_id: TaskID) -> Optional[int]:
+        """Test/chaos hook: pid of the process running a task."""
+        with self._lock:
+            entry = self._running.get(task_id)
+        return entry[0].proc.pid if entry else None
+
+    def execute_task(self, spec: TaskSpec, node, payload):
+        fid, args_blob = payload
+        client = acquire_worker()
+        client.runtime = self.runtime
+        client.node = node
+        try:
+            outcome = client.execute_task(spec, node, fid, args_blob)
+        except WorkerCrashed:
+            client.kill(expected=False)
+            raise
+        release_worker(client)
+        return outcome
+
+    def cancel_task(self, task_id: TaskID, force: bool) -> bool:
+        with self._lock:
+            entry = self._running.get(task_id)
+        if entry is None:
+            return False
+        client, rid = entry
+        if force:
+            client.expected_death = False
+            client.proc.terminate()  # surfaces as WorkerCrashed
+        else:
+            client.cancel_request(rid)
+        return True
+
+    # -- actors ----------------------------------------------------------
+    def create_actor(self, spec: TaskSpec, node, payload):
+        """Returns a _ProcessActorInstance, or raises the user's __init__
+        exception / WorkerCrashed."""
+        fid, args_blob = payload
+        client = acquire_worker()
+        client.runtime = self.runtime
+        client.node = node
+        client.actor_id = spec.actor_id
+        try:
+            kind, value = client.create_actor_instance(
+                spec, node, fid, args_blob)
+        except WorkerCrashed:
+            client.kill(expected=False)
+            raise
+        if kind == "err":
+            client.actor_id = None
+            release_worker(client)  # init failed cleanly; process reusable
+            raise value
+        with self._lock:
+            self._actor_workers[spec.actor_id] = client
+        actor_id = spec.actor_id
+        client.add_death_callback(
+            lambda c, aid=actor_id: self._actor_worker_died(aid, c))
+        return _ProcessActorInstance(client, getattr(spec.func, "__name__",
+                                                     "Actor"))
+
+    def call_actor_method(self, instance: _ProcessActorInstance,
+                          spec: TaskSpec, node, args, kwargs):
+        client: WorkerClient = instance._client
+        if client.dead:
+            from ray_tpu import exceptions as exc
+            raise exc.ActorDiedError(spec.actor_id,
+                                     "actor worker process died")
+        args_blob = cloudpickle.dumps((args, kwargs))
+        try:
+            return client.call_method(spec, node, args_blob)
+        except WorkerCrashed as e:
+            from ray_tpu import exceptions as exc
+            raise exc.ActorDiedError(spec.actor_id, str(e))
+
+    def _actor_worker_died(self, actor_id: ActorID,
+                           client: WorkerClient) -> None:
+        with self._lock:
+            current = self._actor_workers.get(actor_id)
+            if current is client:
+                self._actor_workers.pop(actor_id, None)
+        if client.expected_death:
+            return
+        rt = self.runtime
+        if rt is None or getattr(rt, "_shutdown", False):
+            return
+        # Unexpected process death → actor death with restart semantics
+        # (reference: GcsActorManager restart path on worker failure).
+        try:
+            rt.on_actor_worker_died(actor_id,
+                                    f"actor worker process died "
+                                    f"(pid {client.proc.pid})")
+        except Exception:
+            pass
+
+    def discard_actor(self, actor_id: ActorID, expected: bool = True) -> None:
+        with self._lock:
+            client = self._actor_workers.pop(actor_id, None)
+        if client is not None:
+            client.kill(expected=expected)
+
+    def actor_worker_pid(self, actor_id: ActorID) -> Optional[int]:
+        with self._lock:
+            client = self._actor_workers.get(actor_id)
+        return client.proc.pid if client else None
+
+    # -- lifecycle -------------------------------------------------------
+    def shutdown(self) -> None:
+        with self._lock:
+            actors = list(self._actor_workers.values())
+            self._actor_workers.clear()
+        for client in actors:
+            client.kill(expected=True)

@@ -110,6 +110,10 @@ class RuntimeEnvSetupError(RayTpuError):
     """Runtime environment failed to materialize."""
 
 
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
 class NodeDiedError(RayTpuError):
     """The node hosting the lease/worker died."""
 
