@@ -18,11 +18,9 @@ import re
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
 
-def force_cpu_platform(n_devices: int | None = None) -> None:
-    """Pin jax to the host CPU platform, optionally with ``n_devices``
-    virtual devices. Must run before the first backend touch
-    (``jax.devices()`` etc.); raises if the backend is already up on a
-    different platform."""
+def pin_cpu_env(n_devices: int | None = None) -> None:
+    """Env-only half of the pin (no jax import): safe in fresh processes
+    where jax has not been imported yet."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     if n_devices:
         flags = os.environ.get("XLA_FLAGS", "")
@@ -32,6 +30,14 @@ def force_cpu_platform(n_devices: int | None = None) -> None:
         else:
             flags = (flags + " " + repl).strip()
         os.environ["XLA_FLAGS"] = flags
+
+
+def force_cpu_platform(n_devices: int | None = None) -> None:
+    """Pin jax to the host CPU platform, optionally with ``n_devices``
+    virtual devices. Must run before the first backend touch
+    (``jax.devices()`` etc.); raises if the backend is already up on a
+    different platform."""
+    pin_cpu_env(n_devices)
 
     import jax
 

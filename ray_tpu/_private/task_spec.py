@@ -30,7 +30,7 @@ COMMON_OPTIONS = {
     "num_cpus", "num_gpus", "num_tpus", "memory", "resources",
     "accelerator_type", "label_selector", "name", "runtime_env",
     "scheduling_strategy", "placement_group", "placement_group_bundle_index",
-    "enable_task_events", "_metadata",
+    "enable_task_events", "_metadata", "_in_process",
 }
 TASK_ONLY_OPTIONS = {
     "max_calls", "max_retries", "retry_exceptions", "num_returns",
@@ -167,6 +167,10 @@ class TaskSpec:
     # generator backpressure
     backpressure_num_objects: int = -1
     enable_task_events: bool = True
+    # TPU-first placement: force execution in the mesh-owning host
+    # process (SPMD mesh actors, accelerator-touching work) instead of a
+    # spawned worker process. Internal option set by Train/Serve/LLM.
+    in_process: bool = False
     enqueued_at: float = 0.0
     label_selector: Optional[Dict[str, Any]] = None
     runtime_env: Optional[Dict[str, Any]] = None

@@ -492,6 +492,13 @@ class Runtime:
         """Rewrite the demand onto bundle-scoped resources and enqueue."""
         strat = spec.scheduling_strategy
         pg = strat.placement_group
+        # The strategy may carry a pickled CLONE of the pg (handle that
+        # crossed a worker/object-store boundary): its event is never set
+        # by the manager and its bundles are stale — re-bind to the live
+        # object by id whenever one exists.
+        live = self.pg_manager.get(pg.id)
+        if live is not None and live is not pg:
+            strat.placement_group = pg = live
         if not pg.is_ready():
             # Queue behind placement; the PG manager sets the event when
             # placed (or removed/unschedulable).
@@ -633,6 +640,17 @@ class Runtime:
             self._on_process_task_crash(spec, node, crash)
             return True
         if kind == "err":
+            with self._tasks_lock:
+                inflight = self._tasks.get(spec.task_id)
+            if (inflight is not None and inflight.cancelled
+                    and isinstance(value, KeyboardInterrupt)):
+                # Non-force cancel: the injected KeyboardInterrupt is the
+                # cancellation surfacing, not an app error — it must not
+                # hit the retry logic nor leak as TaskError(KeyboardInterrupt).
+                self._release_task_resources(spec, node)
+                self._fail_task(spec, exc.TaskError(
+                    exc.TaskCancelledError(spec.task_id), spec.name))
+                return True
             self._finish_task(spec, node,
                               error=exc.TaskError(value, spec.name))
         elif (spec.num_returns in ("streaming", "dynamic")
@@ -814,6 +832,15 @@ class Runtime:
                                 task_name=spec.name)
                 state.report_item(ref)
         except BaseException as e:  # noqa: BLE001
+            from ray_tpu._private.worker_process import WorkerCrashed
+            if isinstance(e, WorkerCrashed):
+                # System failure mid-stream (worker process died): retry
+                # like any other worker crash — already-reported items are
+                # skipped on the replay (deterministic streams), matching
+                # lineage-reconstruction semantics.
+                state.finished = False
+                self._on_process_task_crash(spec, node, e)
+                return
             te = exc.TaskError(e, spec.name)
             state.finish(te.as_instanceof_cause())
             self._fail_task(spec, te)
@@ -869,7 +896,34 @@ class Runtime:
             try:
                 instance = self.process_router.create_actor(
                     spec, node, actor_payload)
-            except BaseException as e:  # noqa: BLE001 (incl. WorkerCrashed)
+            except WorkerCrashed as e:
+                # System failure (worker process died during __init__):
+                # restart semantics, not permanent death — a transient
+                # OOM/SIGKILL must behave like the post-creation
+                # worker-failure path (reference: GcsActorManager
+                # worker-failure restart).
+                if node.alive:
+                    node.ledger.release(spec.resources)
+                info = self.gcs.get_actor_info(actor_id)
+                if (info is not None
+                        and (info.max_restarts == -1
+                             or info.num_restarts < info.max_restarts)):
+                    self.stats["actor_restarts"] += 1
+                    info.num_restarts += 1
+                    self.gcs.update_actor_state(actor_id,
+                                                ActorState.RESTARTING)
+                    respec = _clone_spec_for_retry(spec)
+                    respec.actor_id = actor_id
+                    with self._tasks_lock:
+                        inflight = _InFlightTask(respec)
+                        self._tasks[respec.task_id] = inflight
+                    self._submit_with_deps(respec, inflight,
+                                           respec.dependencies())
+                    return
+                self._actor_creation_failed(
+                    spec, exc.TaskError(e, spec.name), node)
+                return
+            except BaseException as e:  # noqa: BLE001
                 self._actor_creation_failed(
                     spec, exc.TaskError(e, spec.name), node)
                 return
@@ -996,10 +1050,16 @@ class Runtime:
                 self._actor_pending_tasks.setdefault(actor_id, []).append(spec)
                 return
         if not executor.submit(spec):
-            self._fail_task(spec, exc.ActorError(
-                exc.ActorDiedError(actor_id,
-                                   executor.death_cause or "actor died"),
-                spec.name, actor_id))
+            # The executor died but _handle_actor_death hasn't unregistered
+            # it yet (node-death and task-retry race): drop the stale
+            # executor and re-evaluate against GCS state — the task is
+            # buffered if the actor is pending/restarting, failed only on
+            # confirmed death (reference: actor_task_submitter resubmits
+            # queued tasks across restarts, not failing them on the race).
+            with self._actor_lock:
+                if self._actor_executors.get(actor_id) is executor:
+                    self._actor_executors.pop(actor_id, None)
+            self._enqueue_actor_task_when_ready(spec)
 
     def _execute_actor_task(self, spec: TaskSpec, instance: Any,
                             node: Node) -> None:
@@ -1089,10 +1149,12 @@ class Runtime:
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True,
                    cause: str = "ray_tpu.kill() called") -> None:
-        self.process_router.discard_actor(actor_id)
+        # Order matters: stop the executor FIRST so no queued spec can be
+        # dispatched to the worker while/after it is reset and recycled.
         with self._actor_lock:
             executor = self._actor_executors.pop(actor_id, None)
         pending = executor.kill(cause) if executor is not None else []
+        self.process_router.discard_actor(actor_id)
         info = self.gcs.get_actor_info(actor_id)
         if info is not None and info.node_id is not None:
             node = self.get_node(info.node_id)

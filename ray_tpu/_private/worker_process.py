@@ -61,9 +61,26 @@ class WorkerCrashed(Exception):
 # function table (code shipping)
 # ---------------------------------------------------------------------------
 
-_FN_TABLE: Dict[str, bytes] = {}
+_FN_TABLE: "Dict[str, bytes]" = {}
+_FN_REFS: Dict[str, int] = {}
 _FN_TABLE_LOCK = threading.Lock()
 _FN_MEMO: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+# Blobs from unweakrefable callables can't be finalizer-evicted; cap how
+# many zero-ref entries may accumulate before oldest-first eviction.
+_FN_TABLE_SOFT_CAP = 2048
+
+
+def _release_fn_blob(fid: str) -> None:
+    """weakref.finalize callback: the last live callable for this blob was
+    collected — nothing can resubmit it, so the table entry is dead weight
+    (retries hold the spec's live func and re-export on submission)."""
+    with _FN_TABLE_LOCK:
+        n = _FN_REFS.get(fid, 0) - 1
+        if n <= 0:
+            _FN_REFS.pop(fid, None)
+            _FN_TABLE.pop(fid, None)
+        else:
+            _FN_REFS[fid] = n
 
 
 def export_function(fn) -> Tuple[str, bytes]:
@@ -78,11 +95,22 @@ def export_function(fn) -> Tuple[str, bytes]:
         return cached
     blob = cloudpickle.dumps(fn)
     fid = hashlib.sha1(blob).hexdigest()
+    entry = (fid, blob)
     with _FN_TABLE_LOCK:
         _FN_TABLE[fid] = blob
-    entry = (fid, blob)
+        if len(_FN_TABLE) > _FN_TABLE_SOFT_CAP:
+            # evict oldest zero-ref blobs (insertion-ordered dict)
+            for old_fid in [f for f in _FN_TABLE
+                            if _FN_REFS.get(f, 0) <= 0]:
+                if len(_FN_TABLE) <= _FN_TABLE_SOFT_CAP:
+                    break
+                if old_fid != fid:
+                    _FN_TABLE.pop(old_fid, None)
     try:
         _FN_MEMO[fn] = entry
+        with _FN_TABLE_LOCK:
+            _FN_REFS[fid] = _FN_REFS.get(fid, 0) + 1
+        weakref.finalize(fn, _release_fn_blob, fid)
     except TypeError:
         pass  # unweakrefable callables just re-serialize
     return entry
@@ -148,6 +176,30 @@ class _GcsProxy:
                                      namespace=namespace)
 
 
+class _PgManagerProxy:
+    """Worker-side pg_manager facade: returns a picklable clone of the
+    host's PlacementGroup (handle semantics — id/bundles/state)."""
+
+    def __init__(self, state: "_WorkerState"):
+        self._state = state
+
+    def get(self, pg_id):
+        return self._state.call_host("pg_get", pg_id=pg_id)
+
+    def create(self, bundles, strategy, name=""):
+        return self._state.call_host("pg_create", bundles=bundles,
+                                     strategy=strategy, name=name)
+
+    def remove(self, pg):
+        return self._state.call_host("pg_remove", pg_id=pg.id)
+
+    def table(self):
+        return self._state.call_host("pg_table")
+
+    def ready_ref(self, pg_id):
+        return self._state.call_host("pg_ready_ref", pg_id=pg_id)
+
+
 class _NoopRefcounter:
     """Worker-held refs are kept alive host-side per task/actor (the host
     pins every ref a worker creates until the task — or the actor — ends),
@@ -170,6 +222,7 @@ class WorkerProxyRuntime:
         self._state = state
         self.refcounter = _NoopRefcounter()
         self.gcs = _GcsProxy(state)
+        self.pg_manager = _PgManagerProxy(state)
         self._actor_lock = threading.RLock()
         self._actor_executors: Dict[ActorID, Any] = {}
 
@@ -239,6 +292,7 @@ class _WorkerState:
         self._task_threads: Dict[str, threading.Thread] = {}
         self.actor_instance: Any = None
         self._fn_cache: Dict[str, Any] = {}
+        self._gen_sems: Dict[str, threading.Semaphore] = {}
         self.proxy = WorkerProxyRuntime(self)
 
     def send(self, msg: Dict[str, Any]) -> None:
@@ -277,12 +331,17 @@ class _WorkerState:
                     slot[1] = msg["ok"]
                     slot[2] = cloudpickle.loads(msg["value"])
                     slot[0].set()
-            elif op in ("execute_task", "create_actor", "call_method"):
+            elif op in ("execute_task", "create_actor", "call_method",
+                        "reset_actor"):
                 t = threading.Thread(target=self._handle, args=(msg,),
                                      daemon=True,
                                      name=f"task-{msg['id']}")
                 self._task_threads[msg["id"]] = t
                 t.start()
+            elif op == "gen_ack":
+                sem = self._gen_sems.get(msg["target"])
+                if sem is not None:
+                    sem.release()
             elif op == "cancel":
                 self._async_raise(msg["target"])
 
@@ -327,17 +386,50 @@ class _WorkerState:
                         method = getattr(self.actor_instance, msg["method"])
                         args, kwargs = cloudpickle.loads(msg["args_blob"])
                         result = method(*args, **kwargs)
+                    elif msg["op"] == "reset_actor":
+                        # Clean actor teardown: drop the instance so the
+                        # process can be recycled into the idle pool
+                        # (spawns are expensive; prestart can't keep up
+                        # on small hosts). If ANYTHING still references
+                        # the instance after gc (a background thread the
+                        # actor started, a module global, ...) the worker
+                        # is dirty and must be killed, not recycled —
+                        # report it so the host takes the kill path.
+                        inst, self.actor_instance = self.actor_instance, None
+                        wr = weakref.ref(inst) if inst is not None else None
+                        del inst
+                        import gc
+                        gc.collect()
+                        if wr is not None and wr() is not None:
+                            raise RuntimeError("actor instance still "
+                                               "referenced; worker dirty")
+                        result = None
                     else:
                         fn = self._fn(msg)
                         args, kwargs = cloudpickle.loads(msg["args_blob"])
                         result = fn(*args, **kwargs)
                     if inspect.isgenerator(result):
-                        self.send({"id": rid, "op": "gen_start"})
-                        for item in result:
-                            self.send({"id": rid, "op": "yield",
-                                       "blob": _safe_dumps(item)})
-                        self.send({"id": rid, "op": "result", "ok": True,
-                                   "blob": _safe_dumps(None)})
+                        # Producer-side flow control (reference:
+                        # GeneratorBackpressureWaiter): at most
+                        # `backpressure` unacked items cross the pipe;
+                        # the host acks as the consumer pulls them.
+                        bp = msg.get("backpressure") or -1
+                        sem = None
+                        if bp > 0:
+                            sem = threading.Semaphore(bp)
+                            self._gen_sems[rid] = sem
+                        try:
+                            self.send({"id": rid, "op": "gen_start"})
+                            for item in result:
+                                if sem is not None:
+                                    sem.acquire()
+                                self.send({"id": rid, "op": "yield",
+                                           "blob": _safe_dumps(item)})
+                            self.send({"id": rid, "op": "result",
+                                       "ok": True,
+                                       "blob": _safe_dumps(None)})
+                        finally:
+                            self._gen_sems.pop(rid, None)
                         return
             finally:
                 runtime_context._reset_context(token)
@@ -353,22 +445,28 @@ class _WorkerState:
             self._task_threads.pop(rid, None)
 
 
-def _child_main(fd: int) -> None:
-    """Worker bootstrap, launched as ``python -c`` with an inherited pipe
-    fd (NOT multiprocessing spawn — that re-imports the parent's __main__,
-    which breaks under REPLs/stdin drivers and pulls arbitrary driver-side
-    module state into every worker). The first frame on the pipe is the
-    boot config."""
-    from multiprocessing.connection import Connection
+def _child_main(conn) -> None:
+    """Worker bootstrap, forked from the forkserver template process (NOT
+    multiprocessing spawn — that re-imports the parent's __main__, which
+    breaks under REPLs/stdin drivers and pulls arbitrary driver-side
+    module state into every worker; and NOT a fresh ``python -c`` — that
+    pays ~0.3s of interpreter+import startup per worker where a fork is
+    ~10ms). The first frame on the pipe is the boot config."""
+    import signal
 
-    conn = Connection(fd)
+    # Terminal Ctrl+C goes to the whole foreground process group; workers
+    # must not die with it (the driver decides shutdown; force-cancel uses
+    # SIGTERM). The old subprocess path got this from start_new_session.
+    signal.signal(signal.SIGINT, signal.SIG_IGN)
     boot = cloudpickle.loads(conn.recv_bytes())
     os.environ.update(boot.get("env", {}))
     if boot.get("force_cpu_platform"):
-        # Must beat any sitecustomize JAX_PLATFORMS pinning; config-level
-        # override, applied before any backend touch.
-        from ray_tpu._private.platform import force_cpu_platform
-        force_cpu_platform(boot.get("cpu_devices"))
+        # Env-level pinning only (no jax import): jax has NOT been
+        # imported yet in this fresh process — worker startup must stay
+        # cheap (importing jax costs ~1.7s) — so the env vars are
+        # authoritative when user code first imports it.
+        from ray_tpu._private.platform import pin_cpu_env
+        pin_cpu_env(boot.get("cpu_devices"))
     from ray_tpu._private import worker as worker_mod
 
     state = _WorkerState(conn, boot)
@@ -390,28 +488,166 @@ class _Pending:
 _DEAD = object()  # sentinel pushed into pending queues on worker death
 
 
-_BOOT_CODE = ("import sys; "
-              "from ray_tpu._private.worker_process import _child_main; "
-              "_child_main(int(sys.argv[1]))")
+_MP_CTX = None
+_MP_CTX_LOCK = threading.Lock()
+
+
+def _mp_context():
+    """Forkserver context every worker forks from. The forkserver is the
+    template process: it preloads this module (and the worker runtime) once,
+    under a cleaned environment — workers never own the accelerator (router
+    eligibility keeps TPU work in the mesh-owning host process), so the
+    template must not run the TPU plugin's sitecustomize registration
+    (~2s of startup + a tunnel the worker must not touch) and pins
+    ``JAX_PLATFORMS=cpu`` for every descendant."""
+    global _MP_CTX
+    with _MP_CTX_LOCK:
+        if _MP_CTX is not None:
+            return _MP_CTX
+        import multiprocessing as mp
+        from multiprocessing import forkserver as _fs
+
+        ctx = mp.get_context("forkserver")
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        # NOTE deliberately narrow: JAX_PLATFORMS is NOT touched here —
+        # mutating it in the driver's global env, even briefly, races a
+        # driver thread importing jax and could pin the HOST backend to
+        # CPU. The template never imports jax (verified: the preloads
+        # don't pull it when PALLAS_AXON_POOL_IPS is unset), and each
+        # worker pins itself at boot via the boot frame.
+        saved = {k: os.environ.get(k)
+                 for k in ("PALLAS_AXON_POOL_IPS", "PYTHONPATH")}
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["PYTHONPATH"] = repo_root + (
+            os.pathsep + saved["PYTHONPATH"] if saved["PYTHONPATH"] else "")
+        try:
+            # PRIVATE ForkServer instance: multiprocessing's module-level
+            # singleton may already be running (started by user code) with
+            # the wrong env and no preloads — and our template must never
+            # serve user forks either. _start_sans_main swaps this
+            # instance in around each of our Process.start() calls.
+            global _OUR_FORKSERVER
+            _OUR_FORKSERVER = _fs.ForkServer()
+            # pyarrow MUST be imported on a template/main thread: this
+            # image's libarrow ties allocator state to the importing
+            # thread's TLS — first-import inside a short-lived task
+            # thread, then use from another thread after it exits,
+            # segfaults (verified: plain-process repro, no fork needed).
+            # Preloading in the template also makes every forked worker
+            # inherit warm imports for free.
+            _OUR_FORKSERVER.set_forkserver_preload(
+                ["ray_tpu._private.worker_process",
+                 "ray_tpu._private.worker",
+                 "pyarrow"])
+            _OUR_FORKSERVER.ensure_running()
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        _MP_CTX = ctx
+        return ctx
+
+
+_START_LOCK = threading.Lock()
+_OUR_FORKSERVER = None
+
+
+def _start_sans_main(p) -> None:
+    """Start a worker Process on OUR forkserver, WITHOUT multiprocessing's
+    main-module fixup.
+
+    spawn.get_preparation_data() tells the child to re-run the driver's
+    ``__main__`` (runpy.run_path) — a worker must never do that: it would
+    re-execute arbitrary user scripts in every worker (the reference
+    default_worker is likewise a clean entrypoint, never the user script;
+    driver-side functions reach workers through the function table
+    instead). Both monkeypatches are scoped: the lock serializes our
+    starts, the spawn patch checks the starting thread's identity (a
+    concurrent user Process.start() on another thread sees stock
+    behavior), and the forkserver global is restored before release."""
+    from multiprocessing import forkserver as _fs
+    from multiprocessing import spawn as _spawn
+
+    with _START_LOCK:
+        orig = _spawn.get_preparation_data
+        me = threading.get_ident()
+
+        def sans_main(name):
+            d = orig(name)
+            if threading.get_ident() == me:
+                d.pop("init_main_from_path", None)
+                d.pop("init_main_from_name", None)
+            return d
+
+        # popen_forkserver calls the module-level alias (a bound method
+        # of the import-time singleton), so that alias is what we swap.
+        saved_connect = _fs.connect_to_new_process
+        _spawn.get_preparation_data = sans_main
+        if _OUR_FORKSERVER is not None:
+            _fs.connect_to_new_process = _OUR_FORKSERVER.connect_to_new_process
+        try:
+            p.start()
+        finally:
+            _fs.connect_to_new_process = saved_connect
+            _spawn.get_preparation_data = orig
+
+
+class _ProcHandle:
+    """subprocess.Popen-shaped facade over a multiprocessing.Process."""
+
+    __slots__ = ("p",)
+
+    def __init__(self, p):
+        self.p = p
+
+    @property
+    def pid(self):
+        return self.p.pid
+
+    def poll(self):
+        return None if self.p.is_alive() else self.p.exitcode
+
+    def wait(self, timeout=None):
+        self.p.join(timeout)
+        if self.p.is_alive():
+            import subprocess
+            raise subprocess.TimeoutExpired("worker", timeout)
+        return self.p.exitcode
+
+    def terminate(self):
+        try:
+            self.p.terminate()
+        except Exception:
+            pass
+
+    def kill(self):
+        try:
+            self.p.kill()
+        except Exception:
+            pass
+
+
+def _untrack_after(router, task_id, it):
+    """Yield through a worker stream, untracking the task at stream end."""
+    try:
+        yield from it
+    finally:
+        router.untrack_task(task_id)
 
 
 class WorkerClient:
     """Host handle to one worker process."""
 
     def __init__(self, boot: Dict[str, Any]):
-        import multiprocessing as mp
-        import subprocess
-        import sys
-        self.conn, child = mp.Pipe()
-        repo_root = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
-        env = dict(os.environ)
-        env["PYTHONPATH"] = repo_root + (
-            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
-        fd = child.fileno()
-        self.proc = subprocess.Popen(
-            [sys.executable, "-c", _BOOT_CODE, str(fd)],
-            pass_fds=(fd,), env=env, start_new_session=True)
+        ctx = _mp_context()
+        self.conn, child = ctx.Pipe()
+        p = ctx.Process(target=_child_main, args=(child,), daemon=True,
+                        name="ray-tpu-worker")
+        _start_sans_main(p)
+        self.proc = _ProcHandle(p)
         child.close()
         # First frame: boot config (platform pinning etc.).
         self.conn.send_bytes(cloudpickle.dumps(boot))
@@ -527,6 +763,19 @@ class WorkerClient:
         except WorkerCrashed:
             pass
 
+    @staticmethod
+    def _rebind_pg(rt, spec):
+        """Specs built inside a worker carry a pickled PlacementGroup
+        CLONE (stale bundles, no node assignments); re-bind the strategy
+        to the host manager's live object by id."""
+        strat = getattr(spec, "scheduling_strategy", None)
+        pg = getattr(strat, "placement_group", None)
+        if pg is not None:
+            live = rt.pg_manager.get(pg.id)
+            if live is not None:
+                strat.placement_group = live
+        return spec
+
     def _hold(self, task_rid: Optional[str], obj: Any) -> None:
         key = task_rid or "__actor__"
         if self.actor_id is not None:
@@ -551,11 +800,12 @@ class WorkerClient:
                            timeout=kw["timeout"],
                            fetch_local=kw["fetch_local"])
         if call == "submit_task":
-            refs = rt.submit_task(kw["spec"])
+            spec = self._rebind_pg(rt, kw["spec"])
+            refs = rt.submit_task(spec)
             self._hold(task_rid, refs)
             return refs
         if call == "create_actor":
-            return rt.create_actor(kw["spec"],
+            return rt.create_actor(self._rebind_pg(rt, kw["spec"]),
                                    get_if_exists=kw["get_if_exists"])
         if call == "kill_actor":
             return rt.kill_actor(kw["actor_id"],
@@ -580,6 +830,25 @@ class WorkerClient:
             return rt.gcs.get_named_actor(kw["name"], kw["namespace"])
         if call == "fetch_function":
             return fetch_function_blob(kw["fid"])
+        if call == "pg_get":
+            return rt.pg_manager.get(kw["pg_id"])
+        if call == "pg_create":
+            return rt.pg_manager.create(kw["bundles"], kw["strategy"],
+                                        kw["name"])
+        if call == "pg_remove":
+            pg = rt.pg_manager.get(kw["pg_id"])
+            if pg is not None:
+                rt.pg_manager.remove(pg)
+            return None
+        if call == "pg_table":
+            return rt.pg_manager.table()
+        if call == "pg_ready_ref":
+            pg = rt.pg_manager.get(kw["pg_id"])
+            if pg is None:
+                raise ValueError("unknown placement group")
+            ref = pg.ready()
+            self._hold(task_rid, ref)
+            return ref
         if call == "host_info":
             return {"namespace": rt.namespace, "job_id": rt.job_id}
         if call == "cluster_resources":
@@ -634,6 +903,12 @@ class WorkerClient:
                         f"worker process {self.proc.pid} died mid-stream")
                 if msg["op"] == "yield":
                     yield cloudpickle.loads(msg["blob"])
+                    try:
+                        # consumer pulled the item: grant the producer
+                        # another flow-control token
+                        self._send({"op": "gen_ack", "target": rid})
+                    except WorkerCrashed:
+                        pass
                     continue
                 ok = msg["ok"]
                 payload = cloudpickle.loads(msg["blob"])
@@ -665,12 +940,21 @@ class WorkerClient:
             "op": "execute_task", "fn_id": fid, "args_blob": args_blob,
             "ctx": self._ctx_fields(spec, node, self.runtime),
             "runtime_env": spec.runtime_env,
+            "backpressure": spec.backpressure_num_objects,
         })
-        self.runtime.process_router.track_task(spec.task_id, self, rid)
+        router = self.runtime.process_router
+        router.track_task(spec.task_id, self, rid)
         try:
-            return self._wait_outcome(rid, pend)
-        finally:
-            self.runtime.process_router.untrack_task(spec.task_id)
+            outcome = self._wait_outcome(rid, pend)
+        except BaseException:
+            router.untrack_task(spec.task_id)
+            raise
+        if outcome[0] == "gen":
+            # Stay tracked while the worker streams — cancel()/crash
+            # handling must be able to reach a producing generator task.
+            return ("gen", _untrack_after(router, spec.task_id, outcome[1]))
+        router.untrack_task(spec.task_id)
+        return outcome
 
     def create_actor_instance(self, spec: TaskSpec, node, fid: str,
                               args_blob: bytes):
@@ -690,6 +974,13 @@ class WorkerClient:
             "ctx": self._ctx_fields(spec, node, self.runtime),
             "runtime_env": spec.runtime_env,
         })
+        return self._wait_outcome(rid, pend)
+
+    def reset_actor(self):
+        """Tear down the actor instance in-process (clean death path) so
+        the worker can be recycled."""
+        rid, pend = self._request({"op": "reset_actor", "ctx": {},
+                                   "runtime_env": None})
         return self._wait_outcome(rid, pend)
 
     def cancel_request(self, rid: str) -> None:
@@ -834,6 +1125,17 @@ class ProcessRouter:
         self._lock = threading.Lock()
         # task_id -> (client, rid) while a normal task runs in a process
         self._running: Dict[TaskID, Tuple[WorkerClient, str]] = {}
+        if self.enabled:
+            # Launch the forkserver template synchronously during init()
+            # (bounds the brief PALLAS_AXON_POOL_IPS env window to the
+            # init call), then warm the pool in the background so the
+            # first task/actor doesn't pay process-spawn latency
+            # (reference: worker prestart, raylet/worker_pool.h).
+            try:
+                _mp_context()
+            except Exception:
+                pass
+            _maybe_prestart_async()
 
     # -- eligibility -----------------------------------------------------
     def _serialize_payload(self, spec: TaskSpec, args, kwargs
@@ -848,14 +1150,22 @@ class ProcessRouter:
         return fid, args_blob
 
     def eligible_task(self, spec: TaskSpec, args, kwargs):
+        # pg_demand is the pre-rewrite demand snapshot: once a task is
+        # scheduled into a placement group its resources are renamed to
+        # bundle-scoped keys (_pg_<id>_<idx>_TPU) that plain name checks
+        # would miss.
+        demand = getattr(spec, "pg_demand", None) or spec.resources
         if (not self.enabled or spec.kind != TaskKind.NORMAL
-                or _wants_accelerator(spec.resources)):
+                or getattr(spec, "in_process", False)
+                or _wants_accelerator(demand)):
             return None
         return self._serialize_payload(spec, args, kwargs)
 
     def eligible_actor(self, spec: TaskSpec, args, kwargs):
+        demand = getattr(spec, "pg_demand", None) or spec.resources
         if (not self.enabled or spec.kind != TaskKind.ACTOR_CREATION
-                or _wants_accelerator(spec.resources)):
+                or getattr(spec, "in_process", False)
+                or _wants_accelerator(demand)):
             return None
         cls = spec.func
         if not inspect.isclass(cls):
@@ -891,8 +1201,20 @@ class ProcessRouter:
         except WorkerCrashed:
             client.kill(expected=False)
             raise
+        if outcome[0] == "gen":
+            # Streaming generator: the worker keeps producing after this
+            # returns — release it only when the stream is drained, or
+            # a full pool would kill the process mid-stream.
+            return ("gen", self._release_after(client, outcome[1]))
         release_worker(client)
         return outcome
+
+    @staticmethod
+    def _release_after(client: WorkerClient, it):
+        try:
+            yield from it
+        finally:
+            release_worker(client)
 
     def cancel_task(self, task_id: TaskID, force: bool) -> bool:
         with self._lock:
@@ -971,8 +1293,30 @@ class ProcessRouter:
     def discard_actor(self, actor_id: ActorID, expected: bool = True) -> None:
         with self._lock:
             client = self._actor_workers.pop(actor_id, None)
-        if client is not None:
+        if client is None:
+            return
+        with client._pending_lock:
+            busy = bool(client._pending)
+        if not expected or busy or not client.alive():
+            # Unexpected death, or method calls still in flight (a killed
+            # actor's process dies with its running work, reference
+            # semantics; recycling a busy worker would let the pool-full
+            # check kill it mid-call for an unrelated reason).
             client.kill(expected=expected)
+            return
+        # Clean death: reset the in-process instance and recycle the
+        # worker into the idle pool instead of paying a respawn later.
+        try:
+            kind, _ = client.reset_actor()
+        except Exception:
+            kind = "err"
+        if kind != "ok":
+            client.kill(expected=True)
+            return
+        client._on_death.clear()  # stale actor-death callbacks
+        client._holds.pop("__actor__", None)
+        client.actor_id = None
+        release_worker(client)
 
     def actor_worker_pid(self, actor_id: ActorID) -> Optional[int]:
         with self._lock:
@@ -982,7 +1326,11 @@ class ProcessRouter:
     # -- lifecycle -------------------------------------------------------
     def shutdown(self) -> None:
         with self._lock:
-            actors = list(self._actor_workers.values())
+            actors = dict(self._actor_workers)
             self._actor_workers.clear()
-        for client in actors:
-            client.kill(expected=True)
+        for actor_id, client in actors.items():
+            # Recycle cleanly-shut-down actor workers into the pool (the
+            # pool outlives runtimes by design; respawns are expensive).
+            with self._lock:
+                self._actor_workers[actor_id] = client
+            self.discard_actor(actor_id, expected=True)

@@ -182,6 +182,7 @@ class ActorClass:
             actor_name=name,
             namespace=namespace,
             label_selector=options.get("label_selector"),
+            in_process=bool(options.get("_in_process")),
             method_options=dict(self._method_options),
         )
         real_id = rt.create_actor(

@@ -112,6 +112,7 @@ class RemoteFunction:
             backpressure_num_objects=options.get(
                 "_generator_backpressure_num_objects", -1),
             label_selector=options.get("label_selector"),
+            in_process=bool(options.get("_in_process")),
         )
         refs = rt.submit_task(spec)
         if num_returns == "streaming":

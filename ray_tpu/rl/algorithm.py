@@ -73,9 +73,16 @@ class Algorithm:
         else:
             raise ValueError(f"unknown algo {config.algo!r}")
 
+        # Resolve string env specs against the DRIVER's registry before the
+        # runners cross the process boundary (reference: RLlib ships the
+        # env_creator callable to rollout workers, not a registry name).
+        from ray_tpu.rl.env import ENV_REGISTRY
+        env_spec = config.env
+        if isinstance(env_spec, str) and env_spec in ENV_REGISTRY:
+            env_spec = ENV_REGISTRY[env_spec]
         runner_cls = ray_tpu.remote(EnvRunner)
         self.runners = [
-            runner_cls.remote(config.env, policy_factory,
+            runner_cls.remote(env_spec, policy_factory,
                               seed=config.seed + 1 + i)
             for i in range(config.num_env_runners)]
         self._sync_weights()

@@ -33,6 +33,9 @@ def _get_controller(create: bool = True):
     controller_cls = ray_tpu.remote(ServeController)
     handle = controller_cls.options(
         name="serve_controller", lifetime="detached",
+        # Control-plane actor: holds live handles/locks and brokers
+        # device-owning replicas — stays in the mesh-owning process.
+        _in_process=True,
         max_concurrency=32).remote()
     ray_tpu.get(handle.ping.remote())
     return handle

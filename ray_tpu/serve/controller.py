@@ -91,6 +91,9 @@ class ServeController:
         opts = dict(st.deployment.ray_actor_options or {})
         replica_cls = ray_tpu.remote(Replica)
         handle = replica_cls.options(
+            # Replicas wrap user callables that may own jax/device state
+            # (LLM engines); TPU-first placement keeps them with the mesh.
+            _in_process=True,
             max_concurrency=st.deployment.max_ongoing_requests,
             max_restarts=st.deployment.max_restarts, **opts,
         ).remote(st.deployment.func_or_class, st.init_args, st.init_kwargs,

@@ -84,6 +84,11 @@ class WorkerGroup:
         from ray_tpu._private.task_spec import PlacementGroupSchedulingStrategy
         self.workers = [
             worker_cls.options(
+                # SPMD mesh actors: each rank drives jitted device work;
+                # the chip/mesh is owned by the host process and XLA
+                # releases the GIL, so these stay in-process (TPU-first
+                # placement rule; see worker_process.py docstring).
+                _in_process=True,
                 max_concurrency=2,
                 num_cpus=resources_per_worker.get("CPU", 1),
                 resources={k: v for k, v in resources_per_worker.items()

@@ -65,6 +65,20 @@ class PlacementGroup:
         self._ready_ref: Optional[ObjectID] = None
         self._failure: Optional[str] = None
 
+    def __getstate__(self):
+        """PGs are serializable handles (they cross task/worker
+        boundaries); the local wait-machinery is rebuilt on unpickle."""
+        d = dict(self.__dict__)
+        d["_ready_event"] = None
+        d["_ready_ref"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._ready_event = threading.Event()
+        if self.state in ("CREATED", "REMOVED"):
+            self._ready_event.set()
+
     @property
     def bundle_specs(self) -> List[Dict[str, float]]:
         return [dict(b.resources) for b in self.bundles]
@@ -81,6 +95,12 @@ class PlacementGroup:
         from ray_tpu._private.object_ref import ObjectRef
 
         rt = worker.global_worker()
+        if not hasattr(rt, "futures"):
+            # Worker-process handle: the owning runtime lives host-side;
+            # ask it for (and cache) the ready ref.
+            if self._ready_ref is None:
+                self._ready_ref = rt.pg_manager.ready_ref(self.id).id
+            return ObjectRef(self._ready_ref, task_name="pg.ready")
         if self._ready_ref is None:
             self._ready_ref = ObjectID.from_random()
             rt.futures.register(self._ready_ref)
@@ -100,8 +120,30 @@ class PlacementGroup:
         return ObjectRef(self._ready_ref, task_name="pg.ready")
 
     def wait(self, timeout_seconds: float = 30) -> bool:
-        self._ready_event.wait(timeout_seconds)
-        return self.is_ready()
+        if self._ready_event.is_set():
+            return self.is_ready()
+        from ray_tpu._private import worker
+        rt = worker.global_worker()
+        mgr = getattr(rt, "pg_manager", None)
+        if mgr is None or mgr.get(self.id) is self:
+            # Owning runtime: the manager flips our event directly.
+            self._ready_event.wait(timeout_seconds)
+            return self.is_ready()
+        # Worker-process handle: poll the owner for state.
+        import time as _time
+        deadline = _time.monotonic() + timeout_seconds
+        while True:
+            cur = mgr.get(self.id)
+            if cur is None:
+                return False
+            self.state = cur.state
+            self.bundles = cur.bundles  # pick up node assignments
+            if cur.state == "CREATED":
+                self._ready_event.set()
+                return True
+            if cur.state == "REMOVED" or _time.monotonic() >= deadline:
+                return self.is_ready()
+            _time.sleep(0.02)
 
     def __repr__(self):
         return (f"PlacementGroup({self.id.hex()[:12]}, "

@@ -156,31 +156,40 @@ def test_nested_refs_in_containers(ray_start_regular):
     assert ray_tpu.get(consume.remote(refs)) == 21
 
 
-def test_retry_on_app_error(ray_start_regular):
-    state = {"n": 0}
+def test_retry_on_app_error(ray_start_regular, tmp_path):
+    # Attempts counted out-of-band (a file): tasks execute in worker
+    # processes behind a serialization boundary, so driver-closure
+    # mutation must NOT be visible (reference semantics).
+    counter = str(tmp_path / "attempts")
 
     @ray_tpu.remote(max_retries=3, retry_exceptions=True)
     def flaky():
-        state["n"] += 1
-        if state["n"] < 3:
+        import os
+        n = len(os.listdir(os.path.dirname(counter)))
+        open(f"{counter}.{n}", "w").close()
+        if n + 1 < 3:
             raise RuntimeError("transient")
         return "ok"
 
     assert ray_tpu.get(flaky.remote()) == "ok"
-    assert state["n"] == 3
+    import os
+    assert len(os.listdir(tmp_path)) == 3
 
 
-def test_retry_exceptions_allowlist(ray_start_regular):
-    state = {"n": 0}
+def test_retry_exceptions_allowlist(ray_start_regular, tmp_path):
+    counter = str(tmp_path / "attempts")
 
     @ray_tpu.remote(max_retries=5, retry_exceptions=[KeyError])
     def flaky():
-        state["n"] += 1
+        import os
+        n = len(os.listdir(os.path.dirname(counter)))
+        open(f"{counter}.{n}", "w").close()
         raise ValueError("not retryable")
 
     with pytest.raises(ValueError):
         ray_tpu.get(flaky.remote())
-    assert state["n"] == 1
+    import os
+    assert len(os.listdir(tmp_path)) == 1
 
 
 def test_cancel_pending(ray_start_regular):

@@ -1,0 +1,221 @@
+"""Process-worker execution path: isolation, recycling, crash handling.
+
+Reference capabilities exercised here: worker-pool process isolation
+(``src/ray/raylet/worker_pool.h``), serialization boundary on the task
+path (``python/ray/_private/serialization.py``), kill -9 of a worker
+process triggering retry/actor restart (``GcsActorManager`` worker-failure
+path, ``python/ray/tests`` ResourceKiller idea).
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+
+def test_tasks_run_in_separate_process(ray_start_regular):
+    @ray_tpu.remote
+    def worker_pid():
+        return os.getpid()
+
+    pid = ray_tpu.get(worker_pid.remote())
+    assert pid != os.getpid()
+
+
+def test_result_immutability_across_consumers(ray_start_regular):
+    """A consumer mutating a task result must not affect other consumers
+    (reference: every value crosses a serialization boundary)."""
+
+    @ray_tpu.remote
+    def make():
+        return {"xs": [1, 2, 3]}
+
+    @ray_tpu.remote
+    def mutate(d):
+        d["xs"].append(99)
+        return len(d["xs"])
+
+    ref = make.remote()
+    assert ray_tpu.get(mutate.remote(ref)) == 4
+    assert ray_tpu.get(ref)["xs"] == [1, 2, 3]
+    # numpy results come back read-only in the driver
+    @ray_tpu.remote
+    def make_arr():
+        return np.arange(10)
+
+    arr = ray_tpu.get(make_arr.remote())
+    with pytest.raises(ValueError):
+        arr[0] = 5
+
+
+def test_driver_closure_mutation_does_not_leak(ray_start_regular):
+    state = {"n": 0}
+
+    @ray_tpu.remote
+    def bump():
+        state["n"] += 1
+        return state["n"]
+
+    ray_tpu.get(bump.remote())
+    assert state["n"] == 0  # driver copy untouched
+
+
+def test_worker_sigkill_triggers_task_retry(ray_start_regular, tmp_path):
+    """kill -9 of the worker process mid-task → retried on a new process."""
+    marker = str(tmp_path / "attempt")
+
+    @ray_tpu.remote(max_retries=2)
+    def slow():
+        import os as _os
+        import time as _time
+        n = len(_os.listdir(_os.path.dirname(marker)))
+        open(f"{marker}.{n}", "w").close()
+        if n == 0:
+            _time.sleep(30)  # first attempt: get killed mid-flight
+        return _os.getpid()
+
+    rt = ray_tpu._private.worker.global_runtime()
+    ref = slow.remote()
+    # find the worker pid and SIGKILL it
+    deadline = time.monotonic() + 10
+    pid = None
+    while pid is None and time.monotonic() < deadline:
+        with rt.process_router._lock:
+            running = dict(rt.process_router._running)
+        for task_id, (client, _rid) in running.items():
+            pid = client.proc.pid
+        time.sleep(0.05)
+    assert pid is not None, "task never landed on a worker process"
+    os.kill(pid, signal.SIGKILL)
+    out = ray_tpu.get(ref, timeout=30)
+    assert out != pid  # retried on a different process
+    assert len(os.listdir(tmp_path)) == 2
+
+
+def test_actor_sigkill_restart(ray_start_regular):
+    @ray_tpu.remote(max_restarts=1)
+    class P:
+        def __init__(self):
+            self.boot = time.monotonic()
+
+        def pid(self):
+            return os.getpid()
+
+    a = P.remote()
+    rt = ray_tpu._private.worker.global_runtime()
+    pid1 = ray_tpu.get(a.pid.remote())
+    os.kill(pid1, signal.SIGKILL)
+    deadline = time.monotonic() + 20
+    pid2 = None
+    while time.monotonic() < deadline:
+        try:
+            pid2 = ray_tpu.get(a.pid.remote(), timeout=10)
+            break
+        except (exc.ActorError, exc.ActorUnavailableError, exc.TaskError,
+                exc.GetTimeoutError):
+            time.sleep(0.2)
+    assert pid2 is not None and pid2 != pid1
+
+
+def test_dirty_actor_worker_not_recycled(ray_start_regular):
+    """An actor that leaks a reference to itself (background thread) must
+    not poison the worker pool: its process is killed on actor death, and
+    subsequent tasks run correctly on fresh/clean workers."""
+
+    @ray_tpu.remote
+    class Leaky:
+        def __init__(self):
+            import threading
+
+            self.stop = False
+
+            def loop(me=self):
+                while not me.stop:
+                    time.sleep(0.01)
+
+            self.t = threading.Thread(target=loop, daemon=True)
+            self.t.start()
+
+        def pid(self):
+            return os.getpid()
+
+    a = Leaky.remote()
+    leaky_pid = ray_tpu.get(a.pid.remote())
+    ray_tpu.kill(a)
+    # the leaky process must eventually be gone (killed, not recycled)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            os.kill(leaky_pid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail(f"dirty actor worker {leaky_pid} still alive")
+
+    @ray_tpu.remote
+    def ok():
+        return "fine"
+
+    assert ray_tpu.get(ok.remote()) == "fine"
+
+
+def test_function_table_ships_code_once(ray_start_regular):
+    """The same remote function reuses its exported blob (function table,
+    reference: function_manager.py export/fetch_and_register)."""
+    from ray_tpu._private.worker_process import _FN_TABLE
+
+    @ray_tpu.remote
+    def fn(x):
+        return x + 1
+
+    before = len(_FN_TABLE)
+    ray_tpu.get([fn.remote(i) for i in range(5)])
+    added = len(_FN_TABLE) - before
+    assert added <= 1
+
+
+def test_nested_submission_from_worker(ray_start_regular):
+    """Tasks submitted from inside a worker process work transparently."""
+
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(xs):
+        return sum(ray_tpu.get([inner.remote(x) for x in xs]))
+
+    assert ray_tpu.get(outer.remote([1, 2, 3])) == 12
+
+
+def test_placement_group_from_worker(ray_start_cluster):
+    """Workers can create PGs and schedule into them (pg proxy ops)."""
+
+    @ray_tpu.remote
+    def with_pg():
+        from ray_tpu.util.placement_group import (placement_group,
+                                                  remove_placement_group)
+        from ray_tpu._private.task_spec import \
+            PlacementGroupSchedulingStrategy
+
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        assert pg.wait(15)
+
+        @ray_tpu.remote(num_cpus=1, scheduling_strategy=
+                        PlacementGroupSchedulingStrategy(
+                            placement_group=pg,
+                            placement_group_bundle_index=0))
+        def inside():
+            return "placed"
+
+        out = ray_tpu.get(inside.remote(), timeout=20)
+        remove_placement_group(pg)
+        return out
+
+    assert ray_tpu.get(with_pg.remote(), timeout=60) == "placed"

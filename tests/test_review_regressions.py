@@ -32,18 +32,23 @@ def test_method_decorator_num_returns(ray_start_regular):
     assert ray_tpu.get([r1, r2]) == ["x", "y"]
 
 
-def test_max_retries_minus_one_unlimited(ray_start_regular):
-    state = {"n": 0}
+def test_max_retries_minus_one_unlimited(ray_start_regular, tmp_path):
+    # Out-of-band attempt counter: tasks run in worker processes behind a
+    # serialization boundary, so driver-closure mutation must not leak.
+    marker = str(tmp_path)
 
     @ray_tpu.remote(max_retries=-1, retry_exceptions=True)
     def flaky():
-        state["n"] += 1
-        if state["n"] < 6:
+        import os
+        n = len(os.listdir(marker))
+        open(os.path.join(marker, str(n)), "w").close()
+        if n + 1 < 6:
             raise RuntimeError("transient")
         return "ok"
 
     assert ray_tpu.get(flaky.remote()) == "ok"
-    assert state["n"] == 6
+    import os
+    assert len(os.listdir(marker)) == 6
 
 
 def test_kill_during_init_not_resurrected(ray_start_regular):
