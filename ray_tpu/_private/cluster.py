@@ -1,0 +1,588 @@
+"""Driver-side cluster backend: real head + node-daemon processes.
+
+This is the deployment shape where the control plane leaves the driver
+process: a head (``ray_tpu/_private/head.py``, GCS-equivalent) and N node
+daemons (``ray_tpu/_private/daemon.py``, raylet-equivalent) run as
+separately spawned OS processes, and every interaction is a typed msgpack
+RPC. The driver remains the single controller and object owner
+(reference: the driver's core worker owns objects and submits tasks;
+``src/ray/core_worker/``), which is also the right shape for TPU SPMD:
+gang placement is centrally decided and the accelerator plane never
+leaves the mesh-owning process.
+
+What rides the wire (reference contracts):
+- worker lease + task push   (node_manager.proto RequestWorkerLease,
+  core_worker.proto PushTask)
+- PG bundle 2PC              (PrepareBundleResources / Commit / Cancel)
+- object get/put/free/pull   (object_manager.proto), with a same-host
+  zero-copy path through the C++ shm arena (plasma's fd-passing role)
+- worker-initiated core ops  (CoreWorkerService direction: daemons call
+  the driver's owner server)
+- health                     (daemon→head heartbeats; head long-poll
+  pubsub pushes node death to the driver)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu._private import rpc
+from ray_tpu._private import daemon as _daemon_schemas  # noqa: F401 — declares the daemon RPC schemas
+from ray_tpu._private.head import HeadClient
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.rpc import HOLD, Client, Server, declare
+
+declare("core_op", "call", "payload", "task")
+
+INLINE_RESULT = 100 * 1024
+
+
+def _spawn(module: str, args: List[str]) -> Tuple[subprocess.Popen, int]:
+    """Spawn a python -m <module> child; returns (proc, announced_port)."""
+    r, w = os.pipe()
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo_root + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    # Control-plane processes never own the accelerator.
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", module, *args, "--announce-fd", str(w)],
+        pass_fds=(w,), env=env, start_new_session=True)
+    os.close(w)
+    with os.fdopen(r) as f:
+        line = f.readline().strip()
+    if not line:
+        raise RuntimeError(f"{module} failed to start")
+    return proc, int(line)
+
+
+class ArenaCache:
+    """Same-host attach to daemon shm arenas by name (zero-copy reads)."""
+
+    def __init__(self):
+        self._arenas: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def read(self, arena: str, capacity: int, off: int,
+             size: int) -> Optional[memoryview]:
+        try:
+            from ray_tpu.native_store import ShmObjectStore
+        except Exception:
+            return None
+        with self._lock:
+            store = self._arenas.get(arena)
+            if store is None:
+                try:
+                    store = ShmObjectStore(arena, capacity)
+                except Exception:
+                    return None
+                self._arenas[arena] = store
+        return store.read_range(off, size)
+
+    def close(self) -> None:
+        with self._lock:
+            for store in self._arenas.values():
+                try:
+                    store.close(unlink=False)
+                except Exception:
+                    pass
+            self._arenas.clear()
+
+
+class DaemonCrashed(Exception):
+    """The daemon PROCESS died (transport failure): node-level failure."""
+
+
+class RemoteWorkerCrashed(Exception):
+    """A worker process inside a (healthy) daemon died under a task."""
+
+
+class _Stream:
+    def __init__(self):
+        import queue
+
+        self.q: "queue.Queue" = queue.Queue()
+
+
+_STREAM_DEAD = object()
+
+
+class DaemonHandle:
+    """Driver's connection to one node daemon (lease/push/object plane)."""
+
+    def __init__(self, node_id: NodeID, addr: Tuple[str, int],
+                 proc: Optional[subprocess.Popen], arenas: ArenaCache):
+        self.node_id = node_id
+        self.addr = addr
+        self.proc = proc
+        self.arenas = arenas
+        self._streams: Dict[str, _Stream] = {}
+        self._slock = threading.Lock()
+        self.on_actor_worker_died = None  # set by the backend
+        self.client = Client(addr, timeout=None, on_push=self._on_push)
+        self.dead = False
+
+    # -- push demux -------------------------------------------------------
+    def _on_push(self, method: str, msg: Dict[str, Any]) -> None:
+        if method in ("task_yield", "task_stream_end", "task_stream_crash"):
+            with self._slock:
+                stream = self._streams.get(msg["task"])
+            if stream is not None:
+                stream.q.put(msg)
+        elif method == "actor_worker_died":
+            cb = self.on_actor_worker_died
+            if cb is not None:
+                cb(msg["actor_id"], msg["cause"])
+
+    def mark_dead(self) -> None:
+        self.dead = True
+        with self._slock:
+            streams = list(self._streams.values())
+        for stream in streams:
+            stream.q.put(_STREAM_DEAD)
+
+    def _call(self, method: str, **kw) -> Dict[str, Any]:
+        if self.dead:
+            raise DaemonCrashed(f"daemon {self.node_id.hex()[:8]} is dead")
+        try:
+            return self.client.call(method, timeout=None, **kw)
+        except rpc.RpcError as e:
+            self.mark_dead()
+            raise DaemonCrashed(str(e))
+
+    # -- wiring -----------------------------------------------------------
+    def hello(self, owner_addr: Tuple[str, int], job_id, namespace: str):
+        return self._call("hello_driver", owner_addr=list(owner_addr),
+                          job_id=cloudpickle.dumps(job_id),
+                          namespace=namespace)
+
+    # -- lease + task push ------------------------------------------------
+    def execute_task(self, spec, fid: str, args_blob: bytes):
+        """Lease a worker, push the task, decode the outcome. Returns the
+        same (kind, value) contract as ProcessRouter.execute_task."""
+        lease = self._call("request_worker_lease",
+                           task_meta={"name": spec.name})
+        lease_id = lease["lease_id"]
+        task_hex = spec.task_id.hex()
+        stream = _Stream()
+        with self._slock:
+            self._streams[task_hex] = stream
+        out = None
+        try:
+            out = self._call(
+                "push_task", spec=_slim_spec_blob(spec), fid=fid,
+                args=args_blob, lease_id=lease_id,
+                backpressure=spec.backpressure_num_objects)
+            return self._decode_outcome(out, spec, stream)
+        finally:
+            if out_is_final(out):
+                # Streams keep their lease until drained: the daemon
+                # releases the worker at stream end (returning it now
+                # would let a full pool kill the producer mid-stream).
+                with self._slock:
+                    self._streams.pop(task_hex, None)
+                try:
+                    if not self.dead:
+                        self.client.call("return_worker",
+                                         lease_id=lease_id, timeout=5.0)
+                except rpc.RpcError:
+                    pass
+
+    def _decode_outcome(self, out: Dict[str, Any], spec, stream: _Stream):
+        kind = out["outcome"]
+        if kind == "crashed":
+            # the WORKER died; the daemon itself is healthy
+            raise RemoteWorkerCrashed(out["error"])
+        if kind == "ok":
+            return ("ok", cloudpickle.loads(out["blob"]))
+        if kind == "err":
+            e, tb = cloudpickle.loads(out["blob"])
+            setattr(e, "_remote_traceback", tb)
+            return ("err", e)
+        if kind == "stored":
+            return ("stored", (bytes(out["oid"]), out["nbytes"]))
+        if kind == "gen":
+            return ("gen", self._stream_iter(spec, stream))
+        if kind == "dead":
+            raise DaemonCrashed("actor worker is dead")
+        raise RuntimeError(f"unknown outcome {kind!r}")
+
+    def _stream_iter(self, spec, stream: _Stream):
+        task_hex = spec.task_id.hex()
+        try:
+            while True:
+                msg = stream.q.get()
+                if msg is _STREAM_DEAD:
+                    raise DaemonCrashed("daemon died mid-stream")
+                op = msg["m"]
+                if op == "task_yield":
+                    yield cloudpickle.loads(msg["blob"])
+                    try:
+                        self.client.call("gen_ack", task_id=task_hex,
+                                         timeout=5.0)
+                    except rpc.RpcError:
+                        pass
+                    continue
+                if op == "task_stream_crash":
+                    raise RemoteWorkerCrashed(msg["error"])
+                if not msg["ok"]:
+                    e, tb = cloudpickle.loads(msg["blob"])
+                    setattr(e, "_remote_traceback", tb)
+                    raise e
+                return
+        finally:
+            with self._slock:
+                self._streams.pop(task_hex, None)
+
+    # -- actors -----------------------------------------------------------
+    def create_actor(self, spec, fid: str, args_blob: bytes):
+        out = self._call("create_actor", spec=_slim_spec_blob(spec),
+                         fid=fid, args=args_blob)
+        kind = out["outcome"]
+        if kind == "crashed":
+            # the WORKER died; the daemon itself is healthy
+            raise RemoteWorkerCrashed(out["error"])
+        if kind == "err":
+            e, tb = cloudpickle.loads(out["blob"])
+            setattr(e, "_remote_traceback", tb)
+            raise e
+        return RemoteActorInstance(self, spec.actor_id)
+
+    def call_actor_method(self, spec, args_blob: bytes):
+        task_hex = spec.task_id.hex()
+        stream = _Stream()
+        with self._slock:
+            self._streams[task_hex] = stream
+        out = self._call("call_actor_method", spec=_slim_spec_blob(spec),
+                         args=args_blob)
+        return self._decode_outcome(out, spec, stream)
+
+    def kill_actor(self, actor_id, expected: bool = True) -> None:
+        try:
+            self._call("kill_actor", actor_id=actor_id.hex(),
+                       expected=expected)
+        except DaemonCrashed:
+            pass
+
+    def cancel_task(self, task_id, force: bool) -> bool:
+        try:
+            return self._call("cancel_task", task_id=task_id.hex(),
+                              force=force)["found"]
+        except DaemonCrashed:
+            return False
+
+    # -- PG 2PC -----------------------------------------------------------
+    def prepare_bundle(self, pg_id: str, index: int,
+                       resources: Dict[str, float]) -> bool:
+        try:
+            return self._call("prepare_bundle", pg_id=pg_id, index=index,
+                              resources=resources)["ok"]
+        except DaemonCrashed:
+            return False
+
+    def commit_bundle(self, pg_id: str, index: int) -> bool:
+        try:
+            return self._call("commit_bundle", pg_id=pg_id,
+                              index=index)["ok"]
+        except DaemonCrashed:
+            return False
+
+    def cancel_bundle(self, pg_id: str, index: int) -> None:
+        try:
+            self._call("cancel_bundle", pg_id=pg_id, index=index)
+        except DaemonCrashed:
+            pass
+
+    # -- object plane -----------------------------------------------------
+    def get_object_blob(self, oid: bytes) -> Optional[bytes]:
+        out = self._call("get_object", oid=oid, prefer_shm=True)
+        if out.get("missing"):
+            return None
+        if "shm" in out and out.get("shm"):
+            view = self.arenas.read(out["shm"], out["capacity"],
+                                    out["off"], out["size"])
+            try:
+                if view is not None:
+                    return bytes(view)  # copy out, then release the pin
+                # attach failed: re-request as bytes
+                out2 = self._call("get_object", oid=oid, prefer_shm=False)
+                return None if out2.get("missing") else out2["blob"]
+            finally:
+                try:
+                    self.client.call("release_object", oid=oid,
+                                     timeout=5.0)
+                except rpc.RpcError:
+                    pass
+        return out["blob"]
+
+    def put_object_blob(self, oid: bytes, blob: bytes) -> None:
+        self._call("put_object", oid=oid, blob=blob)
+
+    def free_objects(self, oids: List[bytes]) -> None:
+        try:
+            self._call("free_objects", oids=oids)
+        except DaemonCrashed:
+            pass
+
+    def pull_object(self, oid: bytes, from_addr: Tuple[str, int]) -> bool:
+        out = self._call("pull_object", oid=oid, from_addr=list(from_addr))
+        return out.get("ok", False)
+
+    # -- lifecycle --------------------------------------------------------
+    def stop(self) -> None:
+        try:
+            if not self.dead:
+                self.client.call("daemon_stop", timeout=2.0)
+        except rpc.RpcError:
+            pass
+        self.mark_dead()
+        if self.proc is not None:
+            try:
+                self.proc.wait(timeout=2.0)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+    def sigkill(self) -> None:
+        """Chaos path: hard-kill the daemon process (node failure)."""
+        if self.proc is not None:
+            try:
+                self.proc.kill()
+            except OSError:
+                pass
+        self.mark_dead()
+
+
+def out_is_final(out) -> bool:
+    return out is None or out.get("outcome") != "gen"
+
+
+def _slim_spec_blob(spec) -> bytes:
+    """Spec metadata without the live callable/args (the daemon runs no
+    user code; payloads travel as fid + args blob)."""
+    import copy
+
+    slim = copy.copy(spec)
+    slim.func = None
+    slim.args = ()
+    slim.kwargs = {}
+    slim.scheduling_strategy = "DEFAULT"
+    return cloudpickle.dumps(slim)
+
+
+class RemoteActorInstance:
+    """Driver-side handle to an actor hosted in a daemon's worker."""
+
+    __slots__ = ("daemon", "actor_id")
+
+    def __init__(self, daemon: DaemonHandle, actor_id):
+        self.daemon = daemon
+        self.actor_id = actor_id
+
+
+class RemoteStore:
+    """Store facade for a RemoteNode: values live in the daemon's object
+    table; the driver keeps a metadata mirror (ids + sizes) and fetches
+    on demand (RPC bytes, or zero-copy shm range on the same host)."""
+
+    def __init__(self, daemon: DaemonHandle):
+        self.daemon = daemon
+        self._meta: Dict[Any, Tuple[bytes, int]] = {}  # ObjectID -> (key, n)
+        self._lock = threading.Lock()
+
+    def register_remote(self, object_id, daemon_key: bytes,
+                        nbytes: int) -> None:
+        with self._lock:
+            self._meta[object_id] = (daemon_key, nbytes)
+
+    def put(self, object_id, value, nbytes: int = 0) -> None:
+        blob = cloudpickle.dumps(value)
+        key = b"put:" + object_id.binary()
+        self.daemon.put_object_blob(key, blob)
+        with self._lock:
+            self._meta[object_id] = (key, len(blob))
+
+    def get(self, object_id):
+        with self._lock:
+            entry = self._meta.get(object_id)
+        if entry is None:
+            raise KeyError(object_id)
+        blob = self.daemon.get_object_blob(entry[0])
+        if blob is None:
+            raise KeyError(object_id)
+        return cloudpickle.loads(blob)
+
+    def contains(self, object_id) -> bool:
+        with self._lock:
+            return object_id in self._meta
+
+    def delete(self, object_id) -> None:
+        with self._lock:
+            entry = self._meta.pop(object_id, None)
+        if entry is not None and not self.daemon.dead:
+            self.daemon.free_objects([entry[0]])
+
+    def object_ids(self):
+        with self._lock:
+            return list(self._meta)
+
+    def nbytes_of(self, object_id) -> int:
+        with self._lock:
+            entry = self._meta.get(object_id)
+        return entry[1] if entry else 0
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            return sum(n for _, n in self._meta.values())
+
+    def close(self) -> None:
+        with self._lock:
+            self._meta.clear()
+
+    @property
+    def stats(self):
+        return {"gets": 0, "puts": 0}
+
+
+class _OwnerHolder:
+    """Pins refs created on behalf of daemon workers (cleared per daemon
+    on disconnect; reference: owner-side borrower bookkeeping)."""
+
+    def __init__(self):
+        self._held: Dict[Any, List[Any]] = {}
+        self._lock = threading.Lock()
+
+    def _hold(self, task_rid, obj) -> None:
+        with self._lock:
+            self._held.setdefault(task_rid or "_", []).append(obj)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._held.clear()
+
+
+class OwnerService:
+    """The driver's RPC server for daemon-initiated core operations
+    (CoreWorkerService direction, ``core_worker.proto:457-577``)."""
+
+    def __init__(self, runtime):
+        self.runtime = runtime
+        self.holder = _OwnerHolder()
+
+    def handle_core_op(self, conn, rid, msg):
+        def run():
+            from ray_tpu._private.worker_process import dispatch_core_op
+
+            try:
+                kw = cloudpickle.loads(msg["payload"])
+                value = dispatch_core_op(self.runtime, self.holder,
+                                         msg["call"], kw, msg.get("task"))
+                conn.reply(rid, ok=True, value=cloudpickle.dumps(value))
+            except BaseException as e:  # noqa: BLE001 — shipped back
+                try:
+                    blob = cloudpickle.dumps(e)
+                except Exception:
+                    blob = cloudpickle.dumps(RuntimeError(repr(e)))
+                conn.reply(rid, ok=False, value=blob)
+
+        threading.Thread(target=run, daemon=True,
+                         name="owner-core-op").start()
+        return HOLD
+
+
+class ClusterBackend:
+    """Spawns + tracks the head and daemon processes for one driver."""
+
+    def __init__(self, runtime, num_daemons: int,
+                 resources_per_daemon: Dict[str, float],
+                 object_store_bytes: int = 256 * 1024 * 1024):
+        object_store_bytes = max(object_store_bytes, 1 << 20)
+        self.runtime = runtime
+        self.arenas = ArenaCache()
+        self.head_proc, head_port = _spawn("ray_tpu._private.head", [])
+        self.head = HeadClient(("127.0.0.1", head_port))
+        self.owner_server = Server(OwnerService(runtime)).start()
+        self.daemons: Dict[NodeID, DaemonHandle] = {}
+        self._lock = threading.Lock()
+        import json
+
+        for _ in range(num_daemons):
+            node_id = NodeID.from_random()
+            proc, port = _spawn("ray_tpu._private.daemon", [
+                "--head", f"127.0.0.1:{head_port}",
+                "--node-id", node_id.hex(),
+                "--resources", json.dumps(resources_per_daemon),
+                "--object-store-bytes", str(object_store_bytes),
+            ])
+            handle = DaemonHandle(node_id, ("127.0.0.1", port), proc,
+                                  self.arenas)
+            handle.hello(self.owner_server.addr, runtime.job_id,
+                         runtime.namespace)
+            handle.on_actor_worker_died = self._make_actor_death_cb()
+            with self._lock:
+                self.daemons[node_id] = handle
+        self.head.subscribe("node", self._on_node_event)
+
+    def _make_actor_death_cb(self):
+        def cb(actor_id_hex: str, cause: str) -> None:
+            from ray_tpu._private.ids import ActorID
+
+            try:
+                self.runtime.on_actor_worker_died(
+                    ActorID.from_hex(actor_id_hex), cause)
+            except Exception:
+                pass
+
+        return cb
+
+    def _on_node_event(self, event: Dict[str, Any]) -> None:
+        if event.get("kind") != "death":
+            return
+        node_id = NodeID.from_hex(event["node_id"])
+        with self._lock:
+            handle = self.daemons.get(node_id)
+        if handle is None or handle.dead:
+            return
+        handle.mark_dead()
+        # Route through the runtime's node-death flow (lost objects,
+        # task retries, actor restarts).
+        node = self.runtime.get_node(node_id)
+        if node is not None:
+            try:
+                self.runtime.remove_node(node, _from_cluster=True)
+            except Exception:
+                pass
+
+    def report_daemon_dead(self, handle: DaemonHandle, reason: str) -> None:
+        handle.mark_dead()
+        try:
+            self.head.mark_node_dead(handle.node_id.hex(), reason)
+        except rpc.RpcError:
+            pass
+
+    def shutdown(self) -> None:
+        with self._lock:
+            daemons = list(self.daemons.values())
+            self.daemons.clear()
+        for handle in daemons:
+            handle.stop()
+        try:
+            self.head.stop_head()
+        except Exception:
+            pass
+        self.head.close()
+        try:
+            self.head_proc.wait(timeout=2.0)
+        except subprocess.TimeoutExpired:
+            self.head_proc.kill()
+        self.owner_server.stop()
+        self.arenas.close()

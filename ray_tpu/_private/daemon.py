@@ -1,0 +1,616 @@
+"""Node daemon process (the raylet equivalent).
+
+Reference capability: the per-node daemon of
+``src/ray/raylet/node_manager.cc`` — worker-lease protocol
+(``HandleRequestWorkerLease`` :1754), a pool of real worker processes
+(``worker_pool.h``), placement-group bundle 2PC
+(``node_manager.proto:443-452``), and the node's object plane (plasma
+store + ``object_manager.cc:247,354`` pull/push). Spawned as its own OS
+process (``python -m ray_tpu._private.daemon``); all traffic is typed
+msgpack RPC (:mod:`ray_tpu._private.rpc`).
+
+Division of labor (TPU-first): the daemon executes HOST-plane work only —
+its workers are CPU-pinned processes (forkserver pool reused from
+:mod:`worker_process`). Accelerator work never lands here; it stays in
+the mesh-owning driver. The daemon never unpickles user payloads (raw
+blobs in, raw blobs out, like the real raylet): user code exists only in
+its worker processes.
+
+Object plane: results too big to inline live in the daemon's object
+table — small ones in a dict, large ones in the C++ shm arena
+(``native/shm_store.cc``) — and are served by (a) raw-bytes RPC, (b)
+same-host zero-copy: ``get_object`` replies (arena name, offset, size)
+with a pinned ref; the client attaches the arena by name and reads the
+range directly (plasma's fd-passing role), then releases; (c)
+daemon⇄daemon ``pull_object`` for inter-node transfer.
+
+Worker-initiated core ops (nested ``ray_tpu.*`` inside tasks) forward
+raw to the OWNER (driver) over a dedicated connection — the
+CoreWorkerService direction of the reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu._private import rpc
+from ray_tpu._private.head import HeadClient, HEARTBEAT_S
+from ray_tpu._private.ids import ActorID, NodeID, TaskID
+from ray_tpu._private.rpc import Client, Connection, Server, declare
+
+INLINE_RESULT = 100 * 1024  # reference: max_direct_call_object_size
+
+declare("hello_driver", "owner_addr", "job_id", "namespace")
+declare("request_worker_lease", "task_meta")
+declare("return_worker", "lease_id")
+declare("push_task", "spec", "fid", "args", "lease_id", "backpressure")
+declare("create_actor", "spec", "fid", "args")
+declare("call_actor_method", "spec", "args")
+declare("kill_actor", "actor_id", "expected")
+declare("cancel_task", "task_id", "force")
+declare("gen_ack", "task_id")
+declare("prepare_bundle", "pg_id", "index", "resources")
+declare("commit_bundle", "pg_id", "index")
+declare("cancel_bundle", "pg_id", "index")
+declare("put_object", "oid", "blob")
+declare("get_object", "oid", "prefer_shm")
+declare("release_object", "oid")
+declare("free_objects", "oids")
+declare("pull_object", "oid", "from_addr")
+declare("daemon_ping")
+declare("daemon_stop")
+declare("daemon_stats")
+declare("core_op", "call", "payload", "task")
+declare("core_release", "task")
+
+
+# ---------------------------------------------------------------------------
+# object table: dict for small blobs, C++ shm arena for large ones
+# ---------------------------------------------------------------------------
+
+class ObjectTable:
+    def __init__(self, arena_name: str, capacity: int):
+        self._small: Dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+        self.arena_name = arena_name
+        self.capacity = capacity
+        self._shm = None
+        try:
+            from ray_tpu.native_store import ShmObjectStore
+
+            self._shm = ShmObjectStore(arena_name, capacity)
+        except Exception:
+            self._shm = None  # g++ missing: dict-only fallback
+
+    def put(self, oid: bytes, blob: bytes) -> None:
+        if self._shm is not None and len(blob) > INLINE_RESULT:
+            try:
+                self._shm.put(oid, blob, pin=True)
+                return
+            except KeyError:
+                return  # already stored (idempotent retry)
+            except Exception:
+                pass  # arena full → dict
+        with self._lock:
+            self._small[oid] = blob
+
+    def get_blob(self, oid: bytes) -> Optional[bytes]:
+        with self._lock:
+            blob = self._small.get(oid)
+        if blob is not None:
+            return blob
+        if self._shm is not None:
+            try:
+                view = self._shm.get_view(oid)  # increfs
+                try:
+                    return view.tobytes()
+                finally:
+                    self._shm.release(oid)
+            except KeyError:
+                return None
+        return None
+
+    def get_shm_ref(self, oid: bytes):
+        """(arena, capacity, off, size) with a held ref, or None."""
+        if self._shm is None:
+            return None
+        try:
+            off, size = self._shm.get_ref(oid)
+        except KeyError:
+            return None
+        return (self.arena_name, self.capacity, off, size)
+
+    def release(self, oid: bytes) -> None:
+        if self._shm is not None:
+            try:
+                self._shm.release(oid)
+            except Exception:
+                pass
+
+    def contains(self, oid: bytes) -> bool:
+        with self._lock:
+            if oid in self._small:
+                return True
+        return self._shm is not None and self._shm.contains(oid)
+
+    def delete(self, oid: bytes) -> None:
+        with self._lock:
+            self._small.pop(oid, None)
+        if self._shm is not None:
+            try:
+                self._shm.delete(oid)
+            except Exception:
+                pass
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            small = sum(len(b) for b in self._small.values())
+        return small + (self._shm.used_bytes() if self._shm else 0)
+
+    def close(self) -> None:
+        if self._shm is not None:
+            self._shm.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# the daemon's runtime shim (what WorkerClient/_core paths need)
+# ---------------------------------------------------------------------------
+
+class _NodeStub:
+    __slots__ = ("node_id",)
+
+    def __init__(self, node_id: NodeID):
+        self.node_id = node_id
+
+
+class DaemonRuntime:
+    """Forwards worker-initiated core ops to the owner (driver)."""
+
+    def __init__(self, service: "DaemonService"):
+        self.service = service
+        self.job_id = None
+        self.namespace = None
+        self._shutdown = False
+        from ray_tpu._private.worker_process import ProcessRouter
+
+        self.process_router = ProcessRouter(self)
+
+    def forward_core_op(self, msg: Dict[str, Any]) -> Tuple[bool, bytes]:
+        owner = self.service.owner
+        if owner is None:
+            raise RuntimeError("daemon has no owner connection")
+        out = owner.call("core_op", call=msg["call"],
+                         payload=msg["payload"],
+                         task=msg.get("task"), timeout=None)
+        return out["ok"], out["value"]
+
+    def on_actor_worker_died(self, actor_id: ActorID, cause: str) -> None:
+        self.service.notify_driver("actor_worker_died",
+                                   actor_id=actor_id.hex(), cause=cause)
+
+
+# ---------------------------------------------------------------------------
+# service
+# ---------------------------------------------------------------------------
+
+class DaemonService:
+    def __init__(self, node_id_hex: str, resources: Dict[str, float],
+                 object_store_bytes: int):
+        self.node_id = NodeID.from_hex(node_id_hex)
+        self.resources = resources
+        self.objects = ObjectTable(f"rtpu_{node_id_hex[:12]}",
+                                   object_store_bytes)
+        self.owner: Optional[Client] = None
+        self.driver_conn: Optional[Connection] = None
+        self.runtime = DaemonRuntime(self)
+        self.node_stub = _NodeStub(self.node_id)
+        self._lock = threading.Lock()
+        self._leases: Dict[str, Any] = {}          # lease_id -> WorkerClient
+        self._lease_seq = 0
+        # task_id hex -> (client, worker rid) for cancel/gen_ack
+        self._task_rids: Dict[str, Tuple[Any, str]] = {}
+        self._bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}
+        self._peers: Dict[Tuple[str, int], Client] = {}
+
+    # -- wiring ----------------------------------------------------------
+    def handle_hello_driver(self, conn, rid, msg):
+        self.driver_conn = conn
+        self.owner = Client(tuple(msg["owner_addr"]), timeout=None)
+        self.runtime.job_id = cloudpickle.loads(msg["job_id"])
+        self.runtime.namespace = msg["namespace"]
+        # Don't report ready until the worker pool is warm: the first
+        # lease otherwise pays a cold fork while racing driver work for
+        # the CPU (reference: worker prestart hides process start cost).
+        from ray_tpu._private import worker_process as wp
+
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with wp._POOL_LOCK:
+                if wp._IDLE:
+                    break
+            time.sleep(0.02)
+        return {"ok": True, "pid": os.getpid()}
+
+    def notify_driver(self, kind: str, **kw) -> None:
+        conn = self.driver_conn
+        if conn is not None and not conn.closed:
+            conn.push(kind, **kw)
+
+    def on_disconnect(self, conn: Connection) -> None:
+        if conn is self.driver_conn:
+            # Driver gone: this daemon's work is orphaned; exit like a
+            # raylet whose GCS/driver session ended.
+            threading.Thread(target=lambda: (time.sleep(0.2),
+                                             os._exit(0)),
+                             daemon=True).start()
+
+    # -- worker lease protocol ------------------------------------------
+    def handle_request_worker_lease(self, conn, rid, msg):
+        """Grant a pooled worker (reference: HandleRequestWorkerLease →
+        WorkerPool::PopWorker)."""
+        from ray_tpu._private import worker_process as wp
+
+        client = wp.acquire_worker()
+        client.raw_outcomes = True
+        client.runtime = self.runtime
+        client.node = self.node_stub
+        with self._lock:
+            self._lease_seq += 1
+            lease_id = f"l{self._lease_seq}"
+            self._leases[lease_id] = client
+        return {"lease_id": lease_id, "worker_pid": client.proc.pid}
+
+    def handle_return_worker(self, conn, rid, msg):
+        from ray_tpu._private import worker_process as wp
+
+        with self._lock:
+            client = self._leases.pop(msg["lease_id"], None)
+        if client is not None and client.actor_id is None:
+            wp.release_worker(client)
+        return {"ok": True}
+
+    def _leased(self, lease_id: str):
+        with self._lock:
+            client = self._leases.get(lease_id)
+        if client is None:
+            raise KeyError(f"unknown lease {lease_id!r}")
+        return client
+
+    # -- task execution --------------------------------------------------
+    def _pump_outcome(self, conn, rid, client, spec, outcome,
+                      on_done=None) -> None:
+        """Shared reply/stream pump for push_task and call_actor_method:
+        inline or stored result, generator stream pushes, worker-crash
+        reporting. ``on_done(crashed: bool)`` runs when the interaction —
+        including any stream — is over."""
+        from ray_tpu._private.worker_process import WorkerCrashed
+
+        task_hex = spec.task_id.hex()
+        if outcome[0] == "gen":
+            conn.reply(rid, outcome="gen")
+            crashed = False
+            try:
+                for kind, blob in outcome[1]:
+                    if kind == "yield_raw":
+                        conn.push("task_yield", task=task_hex, blob=blob)
+                    else:
+                        conn.push("task_stream_end", task=task_hex,
+                                  ok=False, blob=blob)
+                        break
+                else:
+                    conn.push("task_stream_end", task=task_hex,
+                              ok=True, blob=b"")
+            except WorkerCrashed as e:
+                crashed = True
+                client.kill(expected=False)
+                conn.push("task_stream_crash", task=task_hex,
+                          error=str(e))
+            finally:
+                with self._lock:
+                    self._task_rids.pop(task_hex, None)
+                if on_done is not None:
+                    on_done(crashed)
+            return
+        with self._lock:
+            self._task_rids.pop(task_hex, None)
+        try:
+            ok = outcome[0] == "ok_raw"
+            blob = outcome[1]
+            if ok and len(blob) > INLINE_RESULT:
+                oid = b"res:" + spec.task_id.binary()
+                self.objects.put(oid, blob)
+                conn.reply(rid, outcome="stored", oid=oid,
+                           nbytes=len(blob))
+            else:
+                conn.reply(rid, outcome="ok" if ok else "err", blob=blob)
+        finally:
+            if on_done is not None:
+                on_done(False)
+
+    def handle_push_task(self, conn, rid, msg):
+        """Execute on the leased worker; replies with the outcome. Big
+        results go to the object table and return as a location; streams
+        flow back as task_yield/task_result pushes."""
+        spec = cloudpickle.loads(msg["spec"])
+        client = self._leased(msg["lease_id"])
+        spec.backpressure_num_objects = msg["backpressure"]
+        task_hex = spec.task_id.hex()
+
+        def release_lease(crashed: bool) -> None:
+            from ray_tpu._private import worker_process as wp
+
+            with self._lock:
+                self._leases.pop(msg["lease_id"], None)
+            # (the driver never calls return_worker for streams; and for
+            # final outcomes its return_worker becomes a no-op)
+            if not crashed and client.actor_id is None and client.alive():
+                wp.release_worker(client)
+
+        def run():
+            from ray_tpu._private.worker_process import WorkerCrashed
+
+            try:
+                wrid, pend = client._request({
+                    "op": "execute_task", "fn_id": msg["fid"],
+                    "args_blob": msg["args"],
+                    "ctx": client._ctx_fields(spec, self.node_stub,
+                                              self.runtime),
+                    "runtime_env": spec.runtime_env,
+                    "backpressure": msg["backpressure"],
+                })
+                with self._lock:
+                    self._task_rids[task_hex] = (client, wrid)
+                outcome = client._wait_outcome(wrid, pend)
+            except WorkerCrashed as e:
+                client.kill(expected=False)
+                with self._lock:
+                    self._task_rids.pop(task_hex, None)
+                release_lease(True)
+                conn.reply(rid, outcome="crashed", error=str(e))
+                return
+            self._pump_outcome(conn, rid, client, spec, outcome,
+                               on_done=release_lease)
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"task-{task_hex[:8]}").start()
+        return rpc.HOLD
+
+    def handle_cancel_task(self, conn, rid, msg):
+        with self._lock:
+            entry = self._task_rids.get(msg["task_id"])
+        if entry is None:
+            return {"found": False}
+        client, wrid = entry
+        if msg["force"]:
+            client.expected_death = False
+            client.proc.terminate()
+        else:
+            client.cancel_request(wrid)
+        return {"found": True}
+
+    def handle_gen_ack(self, conn, rid, msg):
+        with self._lock:
+            entry = self._task_rids.get(msg["task_id"])
+        if entry is not None:
+            client, wrid = entry
+            try:
+                client._send({"op": "gen_ack", "target": wrid})
+            except Exception:
+                pass
+        return {"ok": True}
+
+    # -- actors ----------------------------------------------------------
+    def handle_create_actor(self, conn, rid, msg):
+        spec = cloudpickle.loads(msg["spec"])
+
+        def run():
+            from ray_tpu._private import worker_process as wp
+
+            client = wp.acquire_worker()
+            client.raw_outcomes = True
+            client.runtime = self.runtime
+            client.node = self.node_stub
+            client.actor_id = spec.actor_id
+            try:
+                kind, blob = client.create_actor_instance(
+                    spec, self.node_stub, msg["fid"], msg["args"])
+            except wp.WorkerCrashed as e:
+                client.kill(expected=False)
+                conn.reply(rid, outcome="crashed", error=str(e))
+                return
+            if kind == "err_raw":
+                client.actor_id = None
+                wp.release_worker(client)
+                conn.reply(rid, outcome="err", blob=blob)
+                return
+            router = self.runtime.process_router
+            with router._lock:
+                router._actor_workers[spec.actor_id] = client
+            actor_id = spec.actor_id
+            client.add_death_callback(
+                lambda c, aid=actor_id: router._actor_worker_died(aid, c))
+            conn.reply(rid, outcome="ok", worker_pid=client.proc.pid)
+
+        threading.Thread(target=run, daemon=True).start()
+        return rpc.HOLD
+
+    def handle_call_actor_method(self, conn, rid, msg):
+        spec = cloudpickle.loads(msg["spec"])
+        router = self.runtime.process_router
+        with router._lock:
+            client = router._actor_workers.get(spec.actor_id)
+        if client is None or client.dead:
+            conn.reply(rid, outcome="dead")
+            return rpc.HOLD
+        task_hex = spec.task_id.hex()
+
+        def run():
+            from ray_tpu._private.worker_process import WorkerCrashed
+
+            try:
+                wrid, pend = client._request({
+                    "op": "call_method", "method": spec.method_name,
+                    "args_blob": msg["args"],
+                    "ctx": client._ctx_fields(spec, self.node_stub,
+                                              self.runtime),
+                    "runtime_env": spec.runtime_env,
+                })
+                with self._lock:
+                    self._task_rids[task_hex] = (client, wrid)
+                outcome = client._wait_outcome(wrid, pend)
+            except WorkerCrashed as e:
+                with self._lock:
+                    self._task_rids.pop(task_hex, None)
+                conn.reply(rid, outcome="crashed", error=str(e))
+                return
+            self._pump_outcome(conn, rid, client, spec, outcome)
+
+        threading.Thread(target=run, daemon=True).start()
+        return rpc.HOLD
+
+    def handle_kill_actor(self, conn, rid, msg):
+        actor_id = ActorID.from_hex(msg["actor_id"])
+        self.runtime.process_router.discard_actor(
+            actor_id, expected=msg["expected"])
+        return {"ok": True}
+
+    # -- placement group bundle 2PC --------------------------------------
+    def handle_prepare_bundle(self, conn, rid, msg):
+        """Phase 1: reserve (advisory ledger — placement authority is the
+        single controller; the 2PC matches the reference wire contract,
+        node_manager.proto PrepareBundleResources)."""
+        key = (msg["pg_id"], msg["index"])
+        with self._lock:
+            self._bundles[key] = {"resources": msg["resources"],
+                                  "state": "PREPARED"}
+        return {"ok": True}
+
+    def handle_commit_bundle(self, conn, rid, msg):
+        key = (msg["pg_id"], msg["index"])
+        with self._lock:
+            entry = self._bundles.get(key)
+            if entry is None:
+                return {"ok": False}
+            entry["state"] = "COMMITTED"
+        return {"ok": True}
+
+    def handle_cancel_bundle(self, conn, rid, msg):
+        with self._lock:
+            self._bundles.pop((msg["pg_id"], msg["index"]), None)
+        return {"ok": True}
+
+    # -- object plane -----------------------------------------------------
+    def handle_put_object(self, conn, rid, msg):
+        self.objects.put(msg["oid"], msg["blob"])
+        return {"ok": True}
+
+    def handle_get_object(self, conn, rid, msg):
+        if msg["prefer_shm"]:
+            ref = self.objects.get_shm_ref(msg["oid"])
+            if ref is not None:
+                arena, cap, off, size = ref
+                return {"shm": arena, "capacity": cap, "off": off,
+                        "size": size}
+        blob = self.objects.get_blob(msg["oid"])
+        if blob is None:
+            return {"missing": True}
+        return {"blob": blob}
+
+    def handle_release_object(self, conn, rid, msg):
+        self.objects.release(msg["oid"])
+        return {"ok": True}
+
+    def handle_free_objects(self, conn, rid, msg):
+        for oid in msg["oids"]:
+            self.objects.delete(oid)
+        return {"ok": True}
+
+    def handle_pull_object(self, conn, rid, msg):
+        """Inter-node transfer: fetch from a peer daemon into the local
+        table (reference: ObjectManager::Pull / Push)."""
+        if self.objects.contains(msg["oid"]):
+            return {"ok": True, "already": True}
+        addr = tuple(msg["from_addr"])
+        with self._lock:
+            peer = self._peers.get(addr)
+            if peer is None or peer.dead:
+                peer = self._peers[addr] = Client(addr)
+        out = peer.call("get_object", oid=msg["oid"], prefer_shm=False)
+        if out.get("missing"):
+            return {"ok": False, "missing": True}
+        self.objects.put(msg["oid"], out["blob"])
+        return {"ok": True}
+
+    # -- misc -------------------------------------------------------------
+    def handle_core_release(self, conn, rid, msg):
+        return {"ok": True}  # owner-side holds are driver-local
+
+    def handle_daemon_ping(self, conn, rid, msg):
+        return {"pid": os.getpid(), "node_id": self.node_id.hex()}
+
+    def handle_daemon_stats(self, conn, rid, msg):
+        with self._lock:
+            leases = len(self._leases)
+            running = len(self._task_rids)
+        return {"leases": leases, "running": running,
+                "store_used": self.objects.used_bytes(),
+                "actors": len(
+                    self.runtime.process_router._actor_workers)}
+
+    def handle_daemon_stop(self, conn, rid, msg):
+        def stop():
+            time.sleep(0.1)
+            self.runtime.process_router.shutdown()
+            self.objects.close()
+            os._exit(0)
+
+        threading.Thread(target=stop, daemon=True).start()
+        return {"ok": True}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--head", required=True,
+                        help="host:port of the head process")
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--resources", default="{}",
+                        help="JSON resource map")
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--object-store-bytes", type=int,
+                        default=256 * 1024 * 1024)
+    parser.add_argument("--announce-fd", type=int, default=-1)
+    args = parser.parse_args()
+
+    resources = json.loads(args.resources)
+    service = DaemonService(args.node_id, resources,
+                            args.object_store_bytes)
+    server = Server(service, host=args.host, port=0).start()
+    if args.announce_fd >= 0:
+        os.write(args.announce_fd, f"{server.addr[1]}\n".encode())
+        os.close(args.announce_fd)
+
+    head_host, head_port = args.head.rsplit(":", 1)
+    head = HeadClient((head_host, int(head_port)))
+    head.register_node(args.node_id, resources, json.loads(args.labels),
+                       server.addr)
+
+    while True:  # heartbeat loop; exit if the head declared us dead
+        time.sleep(HEARTBEAT_S)
+        try:
+            out = head.heartbeat(args.node_id, resources)
+        except rpc.RpcError:
+            os._exit(0)  # head gone: session over
+        if out.get("dead"):
+            os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,318 @@
+"""Head control-plane process (the GCS-server equivalent).
+
+Reference capability: ``src/ray/gcs/gcs_server/gcs_server.h:91`` — node
+membership, active health checking (``gcs_health_check_manager.h``),
+internal KV (``gcs_kv_manager.h``), and long-poll pubsub
+(``src/ray/pubsub/publisher.h:300``). Spawned as its own OS process
+(``python -m ray_tpu._private.head``); every interaction is a typed
+msgpack RPC (:mod:`ray_tpu._private.rpc`).
+
+TPU-first division of labor: the head holds *cluster* state only — node
+directory, health, KV (function table / rendezvous), pubsub. Object
+ownership, scheduling authority, and lineage stay with the single
+controller (the driver), which matches the SPMD model: gang placement is
+decided centrally, and the accelerator data plane never crosses this
+process.
+
+Services:
+- NodeInfo: register_node / heartbeat / list_nodes / drain_node;
+  a monitor thread marks nodes dead after ``DEAD_AFTER_S`` without a
+  heartbeat and publishes ``node_death`` (active health checking).
+- InternalKV: kv_put / kv_get / kv_del / kv_keys (bytes in, bytes out).
+- Pubsub: subscribe(channel) parks the request (long-poll HOLD); publish
+  completes every parked subscriber with the event batch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import rpc
+from ray_tpu._private.rpc import HOLD, Client, Connection, Server, declare
+
+HEARTBEAT_S = 0.2
+DEAD_AFTER_S = 1.5
+
+declare("register_node", "node_id", "resources", "labels", "addr")
+declare("heartbeat", "node_id", "available")
+declare("list_nodes")
+declare("drain_node", "node_id")
+declare("mark_node_dead", "node_id", "reason")
+declare("kv_put", "key", "value", "overwrite", "ns")
+declare("kv_get", "key", "ns")
+declare("kv_del", "key", "ns")
+declare("kv_keys", "prefix", "ns")
+declare("subscribe", "channel", "cursor")
+declare("publish", "channel", "event")
+declare("head_stop")
+
+
+class _NodeEntry:
+    __slots__ = ("node_id", "resources", "labels", "addr", "alive",
+                 "last_beat", "available", "reason")
+
+    def __init__(self, node_id: str, resources: Dict[str, float],
+                 labels: Dict[str, str], addr: Tuple[str, int]):
+        self.node_id = node_id
+        self.resources = resources
+        self.labels = labels
+        self.addr = addr
+        self.alive = True
+        self.last_beat = time.monotonic()
+        self.available = dict(resources)
+        self.reason = ""
+
+    def view(self) -> Dict[str, Any]:
+        return {"node_id": self.node_id, "resources": self.resources,
+                "labels": self.labels, "addr": list(self.addr),
+                "alive": self.alive, "available": self.available,
+                "reason": self.reason}
+
+
+class HeadService:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, _NodeEntry] = {}
+        self._kv: Dict[bytes, bytes] = {}
+        # pubsub: channel -> (event log, parked subscriber conns)
+        self._events: Dict[str, List[Any]] = {}
+        self._parked: Dict[str, List[Tuple[Connection, int, int]]] = {}
+        self._stop = threading.Event()
+        self._monitor = threading.Thread(target=self._health_loop,
+                                         daemon=True, name="head-health")
+        self._monitor.start()
+
+    # -- node membership / health ---------------------------------------
+    def handle_register_node(self, conn, rid, msg):
+        entry = _NodeEntry(msg["node_id"], msg["resources"],
+                           msg["labels"], tuple(msg["addr"]))
+        with self._lock:
+            self._nodes[msg["node_id"]] = entry
+        conn.meta["node_id"] = msg["node_id"]
+        self._publish("node", {"kind": "added", "node": entry.view()})
+        return {"ok": True}
+
+    def handle_heartbeat(self, conn, rid, msg):
+        with self._lock:
+            entry = self._nodes.get(msg["node_id"])
+            if entry is None:
+                return {"ok": False, "unknown": True}
+            entry.last_beat = time.monotonic()
+            entry.available = msg["available"]
+            was_dead = not entry.alive
+        if was_dead:
+            # A heartbeat from a node we declared dead: tell it to exit
+            # (reference: raylets that lost GCS contact must not rejoin
+            # with stale state).
+            return {"ok": False, "dead": True}
+        return {"ok": True}
+
+    def handle_list_nodes(self, conn, rid, msg):
+        with self._lock:
+            return {"nodes": [e.view() for e in self._nodes.values()]}
+
+    def handle_drain_node(self, conn, rid, msg):
+        self._mark_dead(msg["node_id"], "drained")
+        return {"ok": True}
+
+    def handle_mark_node_dead(self, conn, rid, msg):
+        # The driver observed a daemon failure directly (RPC error) and
+        # reports it before the health window elapses.
+        self._mark_dead(msg["node_id"], msg["reason"])
+        return {"ok": True}
+
+    def _mark_dead(self, node_id: str, reason: str) -> None:
+        with self._lock:
+            entry = self._nodes.get(node_id)
+            if entry is None or not entry.alive:
+                return
+            entry.alive = False
+            entry.reason = reason
+        self._publish("node", {"kind": "death", "node_id": node_id,
+                               "reason": reason})
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(HEARTBEAT_S):
+            now = time.monotonic()
+            dead: List[str] = []
+            with self._lock:
+                for entry in self._nodes.values():
+                    if entry.alive and now - entry.last_beat > DEAD_AFTER_S:
+                        dead.append(entry.node_id)
+            for node_id in dead:
+                self._mark_dead(node_id, "missed heartbeats")
+
+    def on_disconnect(self, conn: Connection) -> None:
+        node_id = conn.meta.get("node_id")
+        if node_id:
+            self._mark_dead(node_id, "connection lost")
+        # drop parked long-polls from this conn
+        with self._lock:
+            for parked in self._parked.values():
+                parked[:] = [p for p in parked if p[0] is not conn]
+
+    # -- internal KV -----------------------------------------------------
+    def handle_kv_put(self, conn, rid, msg):
+        key = msg["ns"] + b":" + msg["key"]
+        with self._lock:
+            if not msg["overwrite"] and key in self._kv:
+                return {"added": False}
+            self._kv[key] = msg["value"]
+        return {"added": True}
+
+    def handle_kv_get(self, conn, rid, msg):
+        with self._lock:
+            value = self._kv.get(msg["ns"] + b":" + msg["key"])
+        return {"value": value}
+
+    def handle_kv_del(self, conn, rid, msg):
+        with self._lock:
+            self._kv.pop(msg["ns"] + b":" + msg["key"], None)
+        return {"ok": True}
+
+    def handle_kv_keys(self, conn, rid, msg):
+        pre = msg["ns"] + b":" + msg["prefix"]
+        nslen = len(msg["ns"]) + 1
+        with self._lock:
+            keys = [k[nslen:] for k in self._kv if k.startswith(pre)]
+        return {"keys": keys}
+
+    # -- long-poll pubsub -------------------------------------------------
+    def handle_subscribe(self, conn, rid, msg):
+        """Long-poll: reply immediately if the cursor is behind, else park
+        until the next publish (reference: long_poll.py:70,222 — clients
+        hold a request open and the host completes it on change)."""
+        channel, cursor = msg["channel"], msg["cursor"]
+        with self._lock:
+            log = self._events.setdefault(channel, [])
+            if cursor < len(log):
+                return {"events": log[cursor:], "cursor": len(log)}
+            self._parked.setdefault(channel, []).append(
+                (conn, rid, cursor))
+        return HOLD
+
+    def _publish(self, channel: str, event: Any) -> None:
+        with self._lock:
+            log = self._events.setdefault(channel, [])
+            log.append(event)
+            parked = self._parked.pop(channel, [])
+            cursor = len(log)
+        for conn, rid, start in parked:
+            conn.reply(rid, events=log[start:], cursor=cursor)
+
+    def handle_publish(self, conn, rid, msg):
+        self._publish(msg["channel"], msg["event"])
+        return {"ok": True}
+
+    def handle_head_stop(self, conn, rid, msg):
+        self._stop.set()
+        threading.Thread(target=lambda: (time.sleep(0.1),
+                                         __import__("os")._exit(0)),
+                         daemon=True).start()
+        return {"ok": True}
+
+
+class HeadClient:
+    """Typed client for head services, with a background subscriber."""
+
+    def __init__(self, addr: Tuple[str, int]):
+        self._client = Client(addr)
+        self.addr = addr
+        self._sub_stop = threading.Event()
+        self._sub_threads: List[threading.Thread] = []
+
+    # node info
+    def register_node(self, node_id: str, resources: Dict[str, float],
+                      labels: Dict[str, str], addr: Tuple[str, int]):
+        return self._client.call("register_node", node_id=node_id,
+                                 resources=resources, labels=labels,
+                                 addr=list(addr))
+
+    def heartbeat(self, node_id: str, available: Dict[str, float]):
+        return self._client.call("heartbeat", node_id=node_id,
+                                 available=available, timeout=5.0)
+
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        return self._client.call("list_nodes")["nodes"]
+
+    def mark_node_dead(self, node_id: str, reason: str) -> None:
+        self._client.call("mark_node_dead", node_id=node_id, reason=reason)
+
+    # kv
+    def kv_put(self, key: bytes, value: bytes, overwrite: bool = True,
+               namespace: bytes = b"") -> bool:
+        return self._client.call("kv_put", key=key, value=value,
+                                 overwrite=overwrite,
+                                 ns=namespace)["added"]
+
+    def kv_get(self, key: bytes, namespace: bytes = b"") -> Optional[bytes]:
+        return self._client.call("kv_get", key=key, ns=namespace)["value"]
+
+    def kv_del(self, key: bytes, namespace: bytes = b"") -> None:
+        self._client.call("kv_del", key=key, ns=namespace)
+
+    def kv_keys(self, prefix: bytes = b"",
+                namespace: bytes = b"") -> List[bytes]:
+        return self._client.call("kv_keys", prefix=prefix,
+                                 ns=namespace)["keys"]
+
+    # pubsub
+    def subscribe(self, channel: str, callback) -> None:
+        """Long-poll subscription: dedicated connection per channel (a
+        parked poll must not block other requests' replies)."""
+        def loop():
+            cursor = 0
+            sub = Client(self.addr, timeout=None)
+            while not self._sub_stop.is_set():
+                try:
+                    out = sub.call("subscribe", channel=channel,
+                                   cursor=cursor, timeout=None)
+                except rpc.RpcError:
+                    return
+                cursor = out["cursor"]
+                for event in out["events"]:
+                    try:
+                        callback(event)
+                    except Exception:
+                        pass
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"head-sub-{channel}")
+        t.start()
+        self._sub_threads.append(t)
+
+    def publish(self, channel: str, event: Any) -> None:
+        self._client.call("publish", channel=channel, event=event)
+
+    def stop_head(self) -> None:
+        try:
+            self._client.call("head_stop", timeout=2.0)
+        except rpc.RpcError:
+            pass
+
+    def close(self) -> None:
+        self._sub_stop.set()
+        self._client.close()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--announce-fd", type=int, default=-1,
+                        help="write the bound port here once listening")
+    args = parser.parse_args()
+    server = Server(HeadService(), host=args.host, port=args.port).start()
+    if args.announce_fd >= 0:
+        import os
+
+        os.write(args.announce_fd, f"{server.addr[1]}\n".encode())
+        os.close(args.announce_fd)
+    threading.Event().wait()  # serve forever
+
+
+if __name__ == "__main__":
+    main()

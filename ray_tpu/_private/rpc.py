@@ -1,0 +1,331 @@
+"""Typed RPC layer: length-prefixed msgpack frames over TCP.
+
+This is the control-plane wire of the distributed runtime — the role gRPC
+plays in the reference (``src/ray/rpc``, 37 protos; e.g.
+``protobuf/node_manager.proto:394-494``, ``gcs_service.proto:68-860``).
+Design choices, TPU-first rationale:
+
+- The accelerator data plane NEVER rides this wire: tensors move via XLA
+  collectives over ICI inside jitted programs, or via the shm object
+  store between same-host processes. RPC carries control messages and
+  (pickled) host-plane payloads only.
+- Typed messages: every method has a declared field schema
+  (``SCHEMAS``); send() validates required fields so protocol drift is
+  caught at the caller, like proto field checks.
+- Framing: ``u32 length | msgpack map``. msgpack handles bytes natively,
+  so serialized task payloads embed without base64.
+
+Server model: thread-per-connection, dispatch by method name to a
+service object (``handle_<method>``). A handler may return
+``HOLD`` to park the request (long-poll; reference
+``pubsub/publisher.h:300``) and complete it later via
+``Connection.reply``.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import msgpack
+
+_LEN = struct.Struct("!I")
+MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    """Transport-level failure (peer died, protocol violation)."""
+
+
+class RemoteError(Exception):
+    """The remote handler raised; message carries the remote repr."""
+
+
+class _Hold:
+    """Sentinel: handler parked the request for a deferred reply."""
+
+
+HOLD = _Hold()
+
+
+# ---------------------------------------------------------------------------
+# message schemas (the "proto file"): method -> required field names
+# ---------------------------------------------------------------------------
+
+SCHEMAS: Dict[str, Tuple[str, ...]] = {}
+
+
+def declare(method: str, *fields: str) -> None:
+    SCHEMAS[method] = fields
+
+
+def _validate(method: str, kw: Dict[str, Any]) -> None:
+    fields = SCHEMAS.get(method)
+    if fields is None:
+        raise RpcError(f"undeclared rpc method {method!r}")
+    missing = [f for f in fields if f not in kw]
+    if missing:
+        raise RpcError(f"{method}: missing fields {missing}")
+
+
+def _send_frame(sock: socket.socket, obj: Dict[str, Any],
+                lock: threading.Lock) -> None:
+    blob = msgpack.packb(obj, use_bin_type=True)
+    if len(blob) > MAX_FRAME:
+        raise RpcError(f"frame too large: {len(blob)}")
+    with lock:
+        sock.sendall(_LEN.pack(len(blob)) + blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise RpcError("connection closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Dict[str, Any]:
+    (n,) = _LEN.unpack(_recv_exact(sock, 4))
+    return msgpack.unpackb(_recv_exact(sock, n), raw=False)
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class Client:
+    """One TCP connection to a Server; thread-safe request/reply."""
+
+    def __init__(self, addr: Tuple[str, int], timeout: float = 30.0,
+                 on_push: Optional[Callable[[str, Dict[str, Any]], None]]
+                 = None):
+        self.addr = addr
+        self._sock = socket.create_connection(addr, timeout=10.0)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._wlock = threading.Lock()
+        self._id = 0
+        self._id_lock = threading.Lock()
+        self._pending: Dict[int, list] = {}
+        self._plock = threading.Lock()
+        self._timeout = timeout
+        self._on_push = on_push
+        self.dead = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name=f"rpc-client-{addr[1]}")
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = _recv_frame(self._sock)
+                rid = msg.get("i")
+                if rid is None:
+                    # server push (no correlation id)
+                    if self._on_push is not None:
+                        try:
+                            self._on_push(msg.get("m", ""), msg)
+                        except Exception:
+                            pass
+                    continue
+                with self._plock:
+                    slot = self._pending.pop(rid, None)
+                if slot is not None:
+                    slot[1] = msg
+                    slot[0].set()
+        except (RpcError, OSError):
+            self._fail_all()
+
+    def _fail_all(self) -> None:
+        self.dead = True
+        with self._plock:
+            pending, self._pending = self._pending, {}
+        for slot in pending.values():
+            slot[1] = None
+            slot[0].set()
+
+    def call(self, method: str, timeout: Optional[float] = None,
+             **kw) -> Dict[str, Any]:
+        """Blocking request/reply. Raises RemoteError on handler error,
+        RpcError on transport failure."""
+        _validate(method, kw)
+        if self.dead:
+            raise RpcError(f"connection to {self.addr} is dead")
+        with self._id_lock:
+            self._id += 1
+            rid = self._id
+        slot = [threading.Event(), None]
+        with self._plock:
+            self._pending[rid] = slot
+        msg = dict(kw)
+        msg["m"] = method
+        msg["i"] = rid
+        try:
+            _send_frame(self._sock, msg, self._wlock)
+        except (OSError, RpcError):
+            self._fail_all()
+            raise RpcError(f"send to {self.addr} failed")
+        if not slot[0].wait(timeout if timeout is not None
+                            else self._timeout):
+            with self._plock:
+                self._pending.pop(rid, None)
+            raise RpcError(f"{method} to {self.addr} timed out")
+        reply = slot[1]
+        if reply is None:
+            raise RpcError(f"connection to {self.addr} died during "
+                           f"{method}")
+        if reply.get("e"):
+            raise RemoteError(reply["e"])
+        return reply
+
+    def notify(self, method: str, **kw) -> None:
+        """Fire-and-forget (no reply expected)."""
+        _validate(method, kw)
+        msg = dict(kw)
+        msg["m"] = method
+        try:
+            _send_frame(self._sock, msg, self._wlock)
+        except (OSError, RpcError):
+            self._fail_all()
+            raise RpcError(f"send to {self.addr} failed")
+
+    def close(self) -> None:
+        self.dead = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class Connection:
+    """Server-side handle to one client connection."""
+
+    def __init__(self, sock: socket.socket, peer):
+        self.sock = sock
+        self.peer = peer
+        self.wlock = threading.Lock()
+        self.meta: Dict[str, Any] = {}   # services stash identity here
+        self.closed = False
+
+    def reply(self, rid: int, **kw) -> None:
+        msg = dict(kw)
+        msg["i"] = rid
+        try:
+            _send_frame(self.sock, msg, self.wlock)
+        except (OSError, RpcError):
+            self.closed = True
+
+    def reply_error(self, rid: int, err: str) -> None:
+        self.reply(rid, e=err)
+
+    def push(self, method: str, **kw) -> None:
+        """Server-initiated message (no correlation id)."""
+        msg = dict(kw)
+        msg["m"] = method
+        try:
+            _send_frame(self.sock, msg, self.wlock)
+        except (OSError, RpcError):
+            self.closed = True
+
+
+class Server:
+    """Threaded RPC server. ``service`` exposes ``handle_<method>``
+    callables with signature (conn, rid, msg) -> reply dict | HOLD.
+    Optional ``on_disconnect(conn)`` on the service is called when a
+    client connection drops (daemon death detection hook)."""
+
+    def __init__(self, service: Any, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.service = service
+        self._srv = socket.create_server((host, port))
+        self.addr = self._srv.getsockname()
+        self._stop = False
+        self._conns: list = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"rpc-server-{self.addr[1]}")
+
+    def start(self) -> "Server":
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                sock, peer = self._srv.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = Connection(sock, peer)
+            self._conns.append(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True,
+                             name=f"rpc-conn-{peer[1]}").start()
+
+    def _serve_conn(self, conn: Connection) -> None:
+        try:
+            while not self._stop:
+                msg = _recv_frame(conn.sock)
+                method = msg.get("m", "")
+                rid = msg.get("i")
+                handler = getattr(self.service, f"handle_{method}", None)
+                if handler is None:
+                    if rid is not None:
+                        conn.reply_error(rid, f"no such method {method!r}")
+                    continue
+                try:
+                    out = handler(conn, rid, msg)
+                except Exception as e:  # noqa: BLE001 — shipped back
+                    if rid is not None:
+                        conn.reply_error(rid, f"{type(e).__name__}: {e}")
+                    continue
+                if out is HOLD or rid is None:
+                    continue
+                conn.reply(rid, **(out or {}))
+        except (RpcError, OSError):
+            pass
+        finally:
+            conn.closed = True
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+            cb = getattr(self.service, "on_disconnect", None)
+            if cb is not None and not self._stop:
+                try:
+                    cb(conn)
+                except Exception:
+                    pass
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for conn in self._conns:
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+
+
+def wait_for_server(addr: Tuple[str, int], timeout: float = 15.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(addr, timeout=1.0):
+                return
+        except OSError:
+            time.sleep(0.05)
+    raise RpcError(f"server at {addr} did not come up in {timeout}s")

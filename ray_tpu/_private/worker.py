@@ -135,7 +135,8 @@ class Runtime:
                  resources_per_node: Optional[Dict[str, float]] = None,
                  object_store_memory: int = 2 * 1024 ** 3,
                  namespace: Optional[str] = None,
-                 session_dir: Optional[str] = None):
+                 session_dir: Optional[str] = None,
+                 cluster: Optional[str] = None):
         self.job_id = JobID.from_random()
         self.worker_id = WorkerID.from_random()
         self.namespace = namespace or self.job_id.hex()
@@ -169,6 +170,8 @@ class Runtime:
         self._actor_pending_tasks: Dict[ActorID, List[TaskSpec]] = {}
         self._actor_lock = threading.RLock()
         self._actor_executors: Dict[ActorID, ActorExecutor] = {}
+        # actor_id -> DaemonHandle for actors hosted on node daemons
+        self._remote_actors: Dict[ActorID, Any] = {}
 
         self._generators: Dict[TaskID, GeneratorState] = {}
 
@@ -190,9 +193,25 @@ class Runtime:
 
         if resources_per_node is None:
             resources_per_node = self._detect_resources()
-        for _ in range(num_nodes):
-            self.add_node(dict(resources_per_node),
-                          object_store_memory=object_store_memory)
+        self.cluster_backend = None
+        if cluster is None:
+            cluster = os.environ.get("RAY_TPU_CLUSTER") or None
+        if cluster == "daemons":
+            # Real head + node-daemon OS processes behind the wire
+            # protocol; every schedulable node is a daemon. In-process /
+            # accelerator work still executes driver-side, on the
+            # assigned node's dispatch thread (see _execute_inline).
+            from ray_tpu._private.cluster import ClusterBackend
+            backend = ClusterBackend(self, num_nodes,
+                                     dict(resources_per_node),
+                                     object_store_bytes=object_store_memory)
+            self.cluster_backend = backend
+            for node_id, handle in backend.daemons.items():
+                self.add_remote_node(handle, dict(resources_per_node))
+        else:
+            for _ in range(num_nodes):
+                self.add_node(dict(resources_per_node),
+                              object_store_memory=object_store_memory)
 
     # ------------------------------------------------------------------
     # cluster topology
@@ -224,8 +243,145 @@ class Runtime:
         self.gcs.register_node(node.info())
         return node
 
-    def remove_node(self, node: Node) -> None:
-        """Simulate node failure: lose its objects, tasks, and actors."""
+    def add_remote_node(self, handle, resources: Dict[str, float]) -> Node:
+        """Register a node daemon process as a schedulable node. The Node
+        machinery (ledger, dispatch queue, backlog) runs driver-side —
+        single-controller placement — while execution, workers, and the
+        object payloads live in the daemon."""
+        from ray_tpu._private.cluster import RemoteStore
+        store = RemoteStore(handle)
+        node = Node(handle.node_id, resources, {}, store,
+                    execute_task=self._execute_on_remote_node)
+        node.daemon = handle
+        with self._nodes_lock:
+            self._nodes[handle.node_id] = node
+        self.gcs.register_node(node.info())
+        return node
+
+    def _execute_on_remote_node(self, spec: TaskSpec, node: Node) -> None:
+        """Task execution on a node-daemon process (wire protocol:
+        RequestWorkerLease + PushTask; reference call stack SURVEY §3.1).
+        """
+        from ray_tpu._private.cluster import DaemonCrashed
+        if spec.kind == TaskKind.ACTOR_CREATION:
+            self._execute_actor_creation(spec, node)
+            return
+        if spec.kind == TaskKind.ACTOR_TASK:
+            self._run_actor_task_from_node(spec, node)
+            return
+        with self._tasks_lock:
+            inflight = self._tasks.get(spec.task_id)
+        if inflight is not None:
+            with inflight.lock:
+                if inflight.cancelled:
+                    return
+                inflight.state = TaskState.RUNNING
+        try:
+            args, kwargs = self._resolve_args(spec)
+        except exc.TaskError as te:
+            self._finish_task(spec, node, error=te)
+            return
+        from ray_tpu._private.cluster import RemoteWorkerCrashed
+        from ray_tpu._private.worker_process import _wants_accelerator
+        demand = getattr(spec, "pg_demand", None) or spec.resources
+        payload = None
+        if not getattr(spec, "in_process", False) and \
+                not _wants_accelerator(demand):
+            payload = self.process_router._serialize_payload(spec, args,
+                                                             kwargs)
+        if payload is None:
+            # Accelerator-plane / in_process / unserializable work stays
+            # in the mesh-owning driver process: run it right here on the
+            # node's dispatch thread (resources stay accounted on this
+            # node; the compute itself is driver-side XLA).
+            self._execute_inline(spec, node, args, kwargs)
+            return
+        fid, args_blob = payload
+        try:
+            kind, value = node.daemon.execute_task(spec, fid, args_blob)
+        except RemoteWorkerCrashed as crash:
+            # one worker died; the daemon (node) is fine — plain retry
+            self._on_process_task_crash(spec, node, crash)
+            return
+        except DaemonCrashed as crash:
+            self._on_daemon_crash(node)
+            self._on_process_task_crash(spec, node, crash)
+            return
+        self._finish_remote_outcome(spec, node, kind, value)
+
+    def _finish_remote_outcome(self, spec: TaskSpec, node: Node,
+                               kind: str, value) -> None:
+        if kind == "err":
+            with self._tasks_lock:
+                inflight = self._tasks.get(spec.task_id)
+            if (inflight is not None and inflight.cancelled
+                    and isinstance(value, KeyboardInterrupt)):
+                self._release_task_resources(spec, node)
+                self._fail_task(spec, exc.TaskError(
+                    exc.TaskCancelledError(spec.task_id), spec.name))
+                return
+            self._finish_task(spec, node, error=exc.TaskError(
+                value, spec.name))
+            return
+        if kind == "gen" or spec.num_returns in ("streaming", "dynamic"):
+            self._drain_generator(spec, node, value)
+            return
+        if kind == "stored":
+            daemon_key, nbytes = value
+            n = spec.num_returns
+            if n == 1 or not isinstance(n, int):
+                oid = spec.return_ids[0]
+                node.store.register_remote(oid, daemon_key, nbytes)
+                with self._loc_lock:
+                    self._locations.setdefault(oid, set()).add(
+                        node.node_id)
+                self.task_events.record(task_id=spec.task_id.hex(),
+                                        name=spec.name, event="FINISHED")
+                self._release_task_resources(spec, node)
+                self.futures.complete(oid)
+                self._on_task_done(spec, TaskState.FINISHED)
+                return
+            # multi-return tuple stored remotely: fetch once and split
+            value = node.store.daemon.get_object_blob(daemon_key)
+            import cloudpickle as _cp
+            value = _cp.loads(value)
+            kind = "ok"
+        self._finish_task(spec, node, result=value)
+
+    def _on_daemon_crash(self, node: Node) -> None:
+        """Daemon RPC failure observed first-hand: report to the head and
+        run the node-death flow (objects lost, actors restart)."""
+        backend = self.cluster_backend
+        handle = getattr(node, "daemon", None)
+        if backend is None or handle is None:
+            return
+        backend.report_daemon_dead(handle, "rpc failure")
+        if self.get_node(node.node_id) is not None:
+            try:
+                self.remove_node(node, _from_cluster=True)
+            except Exception:
+                pass
+
+    def _run_actor_task_from_node(self, spec: TaskSpec, node: Node) -> None:
+        # Actor tasks are driven by the ActorExecutor, not the dispatch
+        # queue; reaching here means a retry raced — resubmit properly.
+        inflight = None
+        with self._tasks_lock:
+            inflight = self._tasks.get(spec.task_id)
+        self._submit_actor_task(spec, inflight, spec.dependencies())
+
+    def remove_node(self, node: Node, _from_cluster: bool = False) -> None:
+        """Simulate node failure: lose its objects, tasks, and actors.
+        For daemon-backed nodes this hard-kills the daemon process."""
+        handle = getattr(node, "daemon", None)
+        if handle is not None and not _from_cluster:
+            handle.sigkill()
+            if self.cluster_backend is not None:
+                try:
+                    self.cluster_backend.head.mark_node_dead(
+                        node.node_id.hex(), "removed")
+                except Exception:
+                    pass
         with self._nodes_lock:
             self._nodes.pop(node.node_id, None)
         pending_by_actor = node.shutdown()
@@ -566,7 +722,11 @@ class Runtime:
                     if node is None or not node.alive:
                         continue
                     try:
-                        size = node.store._entries[dep].nbytes  # noqa: SLF001
+                        store = node.store
+                        if hasattr(store, "nbytes_of"):
+                            size = store.nbytes_of(dep)
+                        else:
+                            size = store._entries[dep].nbytes  # noqa: SLF001
                     except KeyError:
                         continue
                     if size > best_size:
@@ -603,6 +763,13 @@ class Runtime:
             return
         if self._try_process_execute(spec, node, args, kwargs):
             return
+        self._execute_inline(spec, node, args, kwargs)
+
+    def _execute_inline(self, spec: TaskSpec, node: Node, args: tuple,
+                        kwargs: dict) -> None:
+        """In-driver execution: accelerator-plane / in_process work runs
+        on the node's (driver-side) dispatch thread — the mesh-owning
+        process, with XLA releasing the GIL."""
         token = runtime_context._set_context(
             job_id=self.job_id, task_id=spec.task_id, node_id=node.node_id,
             actor_id=None, resources=spec.resources, task_name=spec.name,
@@ -889,39 +1056,44 @@ class Runtime:
             self._actor_creation_failed(spec, te, node)
             return
         from ray_tpu._private.worker_process import WorkerCrashed
+        from ray_tpu._private.cluster import DaemonCrashed
         instance = None
-        actor_payload = self.process_router.eligible_actor(spec, args,
-                                                           kwargs)
+        if getattr(node, "daemon", None) is not None:
+            payload = None
+            if (inspect.isclass(spec.func)
+                    and not _class_is_async(spec.func)
+                    and not getattr(spec, "in_process", False)):
+                payload = self.process_router._serialize_payload(
+                    spec, args, kwargs)
+            if payload is not None:
+                fid, args_blob = payload
+                try:
+                    instance = node.daemon.create_actor(spec, fid,
+                                                        args_blob)
+                    self._remote_actors[spec.actor_id] = node.daemon
+                except RemoteWorkerCrashed as e:
+                    self._retry_or_fail_creation(spec, node, e)
+                    return
+                except DaemonCrashed as e:
+                    self._on_daemon_crash(node)
+                    self._retry_or_fail_creation(spec, node, e)
+                    return
+                except BaseException as e:  # noqa: BLE001
+                    self._actor_creation_failed(
+                        spec, exc.TaskError(e, spec.name), node)
+                    return
+            # unserializable / in_process: fall through and create the
+            # instance in the driver (mesh-owning process)
+        actor_payload = None
+        if instance is None and getattr(node, "daemon", None) is None:
+            actor_payload = self.process_router.eligible_actor(spec, args,
+                                                               kwargs)
         if actor_payload is not None:
             try:
                 instance = self.process_router.create_actor(
                     spec, node, actor_payload)
             except WorkerCrashed as e:
-                # System failure (worker process died during __init__):
-                # restart semantics, not permanent death — a transient
-                # OOM/SIGKILL must behave like the post-creation
-                # worker-failure path (reference: GcsActorManager
-                # worker-failure restart).
-                if node.alive:
-                    node.ledger.release(spec.resources)
-                info = self.gcs.get_actor_info(actor_id)
-                if (info is not None
-                        and (info.max_restarts == -1
-                             or info.num_restarts < info.max_restarts)):
-                    self.stats["actor_restarts"] += 1
-                    info.num_restarts += 1
-                    self.gcs.update_actor_state(actor_id,
-                                                ActorState.RESTARTING)
-                    respec = _clone_spec_for_retry(spec)
-                    respec.actor_id = actor_id
-                    with self._tasks_lock:
-                        inflight = _InFlightTask(respec)
-                        self._tasks[respec.task_id] = inflight
-                    self._submit_with_deps(respec, inflight,
-                                           respec.dependencies())
-                    return
-                self._actor_creation_failed(
-                    spec, exc.TaskError(e, spec.name), node)
+                self._retry_or_fail_creation(spec, node, e)
                 return
             except BaseException as e:  # noqa: BLE001
                 self._actor_creation_failed(
@@ -981,6 +1153,32 @@ class Runtime:
         self._on_task_done(spec, TaskState.FINISHED)
         for pspec in pending:
             executor.submit(pspec)
+
+    def _retry_or_fail_creation(self, spec: TaskSpec, node: Node,
+                                e: BaseException) -> None:
+        """System failure (worker process / daemon died during __init__):
+        restart semantics, not permanent death — a transient OOM/SIGKILL
+        must behave like the post-creation worker-failure path
+        (reference: GcsActorManager worker-failure restart)."""
+        actor_id = spec.actor_id
+        if node.alive:
+            node.ledger.release(spec.resources)
+        info = self.gcs.get_actor_info(actor_id)
+        if (info is not None
+                and (info.max_restarts == -1
+                     or info.num_restarts < info.max_restarts)):
+            self.stats["actor_restarts"] += 1
+            info.num_restarts += 1
+            self.gcs.update_actor_state(actor_id, ActorState.RESTARTING)
+            respec = _clone_spec_for_retry(spec)
+            respec.actor_id = actor_id
+            with self._tasks_lock:
+                inflight = _InFlightTask(respec)
+                self._tasks[respec.task_id] = inflight
+            self._submit_with_deps(respec, inflight, respec.dependencies())
+            return
+        self._actor_creation_failed(spec, exc.TaskError(e, spec.name),
+                                    node)
 
     def _actor_creation_failed(self, spec: TaskSpec, error: exc.TaskError,
                                node: Optional[Node] = None) -> None:
@@ -1082,8 +1280,35 @@ class Runtime:
             placement_group_id=spec.placement_group_id,
             pg_capture=spec.pg_capture)
         from ray_tpu._private.worker_process import _ProcessActorInstance
+        from ray_tpu._private.cluster import (DaemonCrashed,
+                                              RemoteActorInstance,
+                                              RemoteWorkerCrashed)
         try:
-            if isinstance(instance, _ProcessActorInstance):
+            if isinstance(instance, RemoteActorInstance):
+                import cloudpickle as _cp
+                try:
+                    kind, result = instance.daemon.call_actor_method(
+                        spec, _cp.dumps((args, kwargs)))
+                except (DaemonCrashed, RemoteWorkerCrashed) as e:
+                    raise exc.ActorDiedError(spec.actor_id, str(e))
+                if kind == "err":
+                    raise result
+                if kind == "stored":
+                    # the finally below resets the runtime context
+                    daemon_key, nbytes = result
+                    node.store.register_remote(spec.return_ids[0],
+                                               daemon_key, nbytes)
+                    with self._loc_lock:
+                        self._locations.setdefault(
+                            spec.return_ids[0], set()).add(node.node_id)
+                    self.task_events.record(task_id=spec.task_id.hex(),
+                                            name=spec.name,
+                                            event="FINISHED")
+                    self._release_task_resources(spec, node)
+                    self.futures.complete(spec.return_ids[0])
+                    self._on_task_done(spec, TaskState.FINISHED)
+                    return
+            elif isinstance(instance, _ProcessActorInstance):
                 kind, result = self.process_router.call_actor_method(
                     instance, spec, node, args, kwargs)
                 if kind == "err":
@@ -1182,6 +1407,9 @@ class Runtime:
                             pending_tasks: List[TaskSpec],
                             may_restart: bool) -> None:
         self.process_router.discard_actor(actor_id)
+        remote = self._remote_actors.pop(actor_id, None)
+        if remote is not None and not remote.dead:
+            remote.kill_actor(actor_id, expected=True)
         info = self.gcs.get_actor_info(actor_id)
         if info is None:
             return
@@ -1253,6 +1481,13 @@ class Runtime:
             # async KeyboardInterrupt into the executing thread.
             if self.process_router.cancel_task(target.spec.task_id, force):
                 return
+            # Daemon-executed task: forward over the wire (CancelTask,
+            # core_worker.proto:525).
+            node = self.get_node(target.node_id) if target.node_id else None
+            daemon = getattr(node, "daemon", None) if node else None
+            if daemon is not None and daemon.cancel_task(
+                    target.spec.task_id, force):
+                return
         if not was_running or force:
             self._fail_task(target.spec, exc.TaskError(
                 exc.TaskCancelledError(target.spec.task_id),
@@ -1284,6 +1519,11 @@ class Runtime:
     def shutdown(self) -> None:
         self._shutdown = True
         self.process_router.shutdown()
+        if self.cluster_backend is not None:
+            try:
+                self.cluster_backend.shutdown()
+            except Exception:
+                pass
         for node in self.nodes():
             node.shutdown(fail_tasks=False)
             node.store.close()

@@ -630,6 +630,99 @@ class _ProcHandle:
             pass
 
 
+
+
+def rebind_pg(rt, spec):
+    """Specs built inside a worker carry a pickled PlacementGroup CLONE
+    (stale bundles, no node assignments); re-bind the strategy to the host
+    manager's live object by id."""
+    strat = getattr(spec, "scheduling_strategy", None)
+    pg = getattr(strat, "placement_group", None)
+    if pg is not None:
+        live = rt.pg_manager.get(pg.id)
+        if live is not None:
+            strat.placement_group = live
+    return spec
+
+
+def dispatch_core_op(rt, holder, call: str, kw: Dict[str, Any],
+                     task_rid: Optional[str]) -> Any:
+    """Owner-side dispatch of a worker/daemon-initiated core operation.
+
+    Shared by the in-process WorkerClient pipe path and the cluster-mode
+    owner RPC service (reference: CoreWorkerService,
+    ``protobuf/core_worker.proto:457-577``). ``holder`` pins refs created
+    on behalf of the remote caller via ``_hold(task_rid, obj)``.
+    """
+    if call == "get":
+            return rt.get(kw["refs"], timeout=kw.get("timeout"))
+    if call == "put":
+        ref = rt.put(kw["value"])
+        holder._hold(task_rid, ref)
+        return ref
+    if call == "wait":
+        return rt.wait(kw["refs"], num_returns=kw["num_returns"],
+                       timeout=kw["timeout"],
+                       fetch_local=kw["fetch_local"])
+    if call == "submit_task":
+        spec = rebind_pg(rt, kw["spec"])
+        refs = rt.submit_task(spec)
+        holder._hold(task_rid, refs)
+        return refs
+    if call == "create_actor":
+        return rt.create_actor(rebind_pg(rt, kw["spec"]),
+                               get_if_exists=kw["get_if_exists"])
+    if call == "kill_actor":
+        return rt.kill_actor(kw["actor_id"],
+                             no_restart=kw["no_restart"],
+                             cause=kw["cause"])
+    if call == "cancel":
+        return rt.cancel(kw["ref"], force=kw["force"],
+                         recursive=kw["recursive"])
+    if call == "gen_next":
+        state = rt.generator_state(kw["task_id"])
+        try:
+            ref = state.next_ref(kw["index"], timeout=kw.get("timeout"))
+            holder._hold(task_rid, ref)
+            return ref
+        except StopIteration:
+            return None
+    if call == "gen_finished":
+        return rt.generator_state(kw["task_id"]).finished
+    if call == "gcs_get_actor_info":
+        return rt.gcs.get_actor_info(kw["actor_id"])
+    if call == "gcs_get_named_actor":
+        return rt.gcs.get_named_actor(kw["name"], kw["namespace"])
+    if call == "fetch_function":
+        return fetch_function_blob(kw["fid"])
+    if call == "pg_get":
+        return rt.pg_manager.get(kw["pg_id"])
+    if call == "pg_create":
+        return rt.pg_manager.create(kw["bundles"], kw["strategy"],
+                                    kw["name"])
+    if call == "pg_remove":
+        pg = rt.pg_manager.get(kw["pg_id"])
+        if pg is not None:
+            rt.pg_manager.remove(pg)
+        return None
+    if call == "pg_table":
+        return rt.pg_manager.table()
+    if call == "pg_ready_ref":
+        pg = rt.pg_manager.get(kw["pg_id"])
+        if pg is None:
+            raise ValueError("unknown placement group")
+        ref = pg.ready()
+        holder._hold(task_rid, ref)
+        return ref
+    if call == "host_info":
+        return {"namespace": rt.namespace, "job_id": rt.job_id}
+    if call == "cluster_resources":
+        return rt.cluster_resources()
+    if call == "available_resources":
+        return rt.available_resources()
+    raise ValueError(f"unknown core op {call!r}")
+
+
 def _untrack_after(router, task_id, it):
     """Yield through a worker stream, untracking the task at stream end."""
     try:
@@ -748,9 +841,17 @@ class WorkerClient:
     # -- worker-initiated core ops --------------------------------------
     def _serve_core(self, msg: Dict[str, Any]) -> None:
         try:
-            value = self._core_dispatch(msg)
-            reply = {"op": "reply", "for": msg["id"], "ok": True,
-                     "value": cloudpickle.dumps(value)}
+            forward = getattr(self.runtime, "forward_core_op", None)
+            if forward is not None:
+                # Daemon mode: raw round-trip to the owner (driver); the
+                # blob is already pickled at the owner's edge.
+                ok, blob = forward(msg)
+                reply = {"op": "reply", "for": msg["id"], "ok": ok,
+                         "value": blob}
+            else:
+                value = self._core_dispatch(msg)
+                reply = {"op": "reply", "for": msg["id"], "ok": True,
+                         "value": cloudpickle.dumps(value)}
         except BaseException as e:  # noqa: BLE001 — shipped back
             try:
                 blob = cloudpickle.dumps(e)
@@ -763,19 +864,6 @@ class WorkerClient:
         except WorkerCrashed:
             pass
 
-    @staticmethod
-    def _rebind_pg(rt, spec):
-        """Specs built inside a worker carry a pickled PlacementGroup
-        CLONE (stale bundles, no node assignments); re-bind the strategy
-        to the host manager's live object by id."""
-        strat = getattr(spec, "scheduling_strategy", None)
-        pg = getattr(strat, "placement_group", None)
-        if pg is not None:
-            live = rt.pg_manager.get(pg.id)
-            if live is not None:
-                strat.placement_group = live
-        return spec
-
     def _hold(self, task_rid: Optional[str], obj: Any) -> None:
         key = task_rid or "__actor__"
         if self.actor_id is not None:
@@ -787,77 +875,8 @@ class WorkerClient:
         if rt is None:
             raise RuntimeError("worker not bound to a runtime")
         kw = cloudpickle.loads(msg["payload"])
-        call = msg["call"]
-        task_rid = msg.get("task")
-        if call == "get":
-            return rt.get(kw["refs"], timeout=kw.get("timeout"))
-        if call == "put":
-            ref = rt.put(kw["value"])
-            self._hold(task_rid, ref)
-            return ref
-        if call == "wait":
-            return rt.wait(kw["refs"], num_returns=kw["num_returns"],
-                           timeout=kw["timeout"],
-                           fetch_local=kw["fetch_local"])
-        if call == "submit_task":
-            spec = self._rebind_pg(rt, kw["spec"])
-            refs = rt.submit_task(spec)
-            self._hold(task_rid, refs)
-            return refs
-        if call == "create_actor":
-            return rt.create_actor(self._rebind_pg(rt, kw["spec"]),
-                                   get_if_exists=kw["get_if_exists"])
-        if call == "kill_actor":
-            return rt.kill_actor(kw["actor_id"],
-                                 no_restart=kw["no_restart"],
-                                 cause=kw["cause"])
-        if call == "cancel":
-            return rt.cancel(kw["ref"], force=kw["force"],
-                             recursive=kw["recursive"])
-        if call == "gen_next":
-            state = rt.generator_state(kw["task_id"])
-            try:
-                ref = state.next_ref(kw["index"], timeout=kw.get("timeout"))
-                self._hold(task_rid, ref)
-                return ref
-            except StopIteration:
-                return None
-        if call == "gen_finished":
-            return rt.generator_state(kw["task_id"]).finished
-        if call == "gcs_get_actor_info":
-            return rt.gcs.get_actor_info(kw["actor_id"])
-        if call == "gcs_get_named_actor":
-            return rt.gcs.get_named_actor(kw["name"], kw["namespace"])
-        if call == "fetch_function":
-            return fetch_function_blob(kw["fid"])
-        if call == "pg_get":
-            return rt.pg_manager.get(kw["pg_id"])
-        if call == "pg_create":
-            return rt.pg_manager.create(kw["bundles"], kw["strategy"],
-                                        kw["name"])
-        if call == "pg_remove":
-            pg = rt.pg_manager.get(kw["pg_id"])
-            if pg is not None:
-                rt.pg_manager.remove(pg)
-            return None
-        if call == "pg_table":
-            return rt.pg_manager.table()
-        if call == "pg_ready_ref":
-            pg = rt.pg_manager.get(kw["pg_id"])
-            if pg is None:
-                raise ValueError("unknown placement group")
-            ref = pg.ready()
-            self._hold(task_rid, ref)
-            return ref
-        if call == "host_info":
-            return {"namespace": rt.namespace, "job_id": rt.job_id}
-        if call == "cluster_resources":
-            return rt.cluster_resources()
-        if call == "available_resources":
-            return rt.available_resources()
-        raise ValueError(f"unknown core op {call!r}")
+        return dispatch_core_op(rt, self, msg["call"], kw, msg.get("task"))
 
-    # -- host-initiated work --------------------------------------------
     def _request(self, msg: Dict[str, Any]) -> Tuple[str, _Pending]:
         rid = f"h{next(self._ids)}"
         msg["id"] = rid
@@ -875,6 +894,10 @@ class WorkerClient:
             self._pending.pop(rid, None)
         self._holds.pop(rid, None)
 
+    # Daemons run no user code: with raw_outcomes they hand result blobs
+    # through without unpickling (the owner deserializes at the edge).
+    raw_outcomes = False
+
     def _wait_outcome(self, rid: str, pend: _Pending):
         """First message decides: value result, error, or generator."""
         msg = pend.q.get()
@@ -886,6 +909,9 @@ class WorkerClient:
         if msg["op"] == "gen_start":
             return ("gen", self._gen_iter(rid, pend))
         ok = msg["ok"]
+        if self.raw_outcomes:
+            self._finish(rid)
+            return ("ok_raw" if ok else "err_raw", msg["blob"])
         payload = cloudpickle.loads(msg["blob"])
         self._finish(rid)
         if ok:
@@ -902,6 +928,12 @@ class WorkerClient:
                     raise WorkerCrashed(
                         f"worker process {self.proc.pid} died mid-stream")
                 if msg["op"] == "yield":
+                    if self.raw_outcomes:
+                        # no ack here: in daemon mode the ack comes from
+                        # the DRIVER's consumer via the gen_ack RPC, so
+                        # flow control tracks end-consumption, not relay
+                        yield ("yield_raw", msg["blob"])
+                        continue
                     yield cloudpickle.loads(msg["blob"])
                     try:
                         # consumer pulled the item: grant the producer
@@ -911,6 +943,10 @@ class WorkerClient:
                         pass
                     continue
                 ok = msg["ok"]
+                if self.raw_outcomes:
+                    if not ok:
+                        yield ("err_raw", msg["blob"])
+                    return
                 payload = cloudpickle.loads(msg["blob"])
                 if not ok:
                     e, tb = payload
@@ -1310,7 +1346,7 @@ class ProcessRouter:
             kind, _ = client.reset_actor()
         except Exception:
             kind = "err"
-        if kind != "ok":
+        if kind not in ("ok", "ok_raw"):
             client.kill(expected=True)
             return
         client._on_death.clear()  # stale actor-death callbacks

@@ -147,6 +147,25 @@ class ShmObjectStore:
         view.flags.writeable = False
         return view
 
+    def get_ref(self, object_id: bytes) -> "tuple[int, int]":
+        """(offset, size) of the sealed object, holding a ref so the range
+        stays valid until release(). Cross-process clients attach the
+        arena by name and read the range directly (the fd-passing role of
+        plasma's fling.cc, done via shm_open-by-name)."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.rtpu_get(self._handle, object_id,
+                                ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            raise KeyError(f"object {object_id!r} not in store (rc={rc})")
+        return off.value, size.value
+
+    def read_range(self, offset: int, size: int) -> memoryview:
+        """Read-only view of raw arena bytes (attach-side of get_ref)."""
+        view = np.frombuffer(self._buf, np.uint8, count=size, offset=offset)
+        view.flags.writeable = False
+        return memoryview(view)
+
     def release(self, object_id: bytes) -> None:
         self._lib.rtpu_release(self._handle, object_id)
 

@@ -193,6 +193,9 @@ class PlacementGroupManager:
             if node is not None and node.alive:
                 node.ledger.remove_total(b.scoped_resources(pg.id))
                 node.ledger.release(b.resources)
+                daemon = getattr(node, "daemon", None)
+                if daemon is not None:
+                    daemon.cancel_bundle(pg.id.hex(), b.index)
             b.node_id = None
 
     def remove(self, pg: PlacementGroup) -> None:
@@ -271,18 +274,31 @@ class PlacementGroupManager:
         acquired: List[tuple] = []
         ok = True
         for bundle, node in assignment:
-            if node.ledger.try_acquire(bundle.resources):
-                acquired.append((bundle, node))
-            else:
+            if not node.ledger.try_acquire(bundle.resources):
                 ok = False
                 break
+            # Daemon-backed node: phase-1 PREPARE on the wire (reference:
+            # node_manager.proto PrepareBundleResources 2PC).
+            daemon = getattr(node, "daemon", None)
+            if daemon is not None and not daemon.prepare_bundle(
+                    pg.id.hex(), bundle.index, dict(bundle.resources)):
+                node.ledger.release(bundle.resources)
+                ok = False
+                break
+            acquired.append((bundle, node))
         if not ok:  # roll back the partial reservation (2PC abort)
             for bundle, node in acquired:
                 node.ledger.release(bundle.resources)
+                daemon = getattr(node, "daemon", None)
+                if daemon is not None:
+                    daemon.cancel_bundle(pg.id.hex(), bundle.index)
             return False
         for bundle, node in acquired:
             node.ledger.add_total(bundle.scoped_resources(pg.id))
             bundle.node_id = node.node_id
+            daemon = getattr(node, "daemon", None)
+            if daemon is not None:
+                daemon.commit_bundle(pg.id.hex(), bundle.index)
         return True
 
     def _assign(self, pg: PlacementGroup,
