@@ -45,6 +45,17 @@ class LlamaConfig:
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # Context-parallel scheme when the sp mesh axis is >1 (SURVEY §5.7):
+    # "ring" = ppermute K/V rotation (any head count, O(S/sp) memory);
+    # "ulysses" = all-to-all head/seq swap (needs n_heads % sp == 0,
+    # local full-sequence attention so any local kernel applies).
+    attention_impl: str = "ring"
+
+    def __post_init__(self):
+        if self.attention_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"attention_impl must be 'ring' or 'ulysses', "
+                f"got {self.attention_impl!r}")
 
     @property
     def head_dim(self) -> int:
@@ -166,14 +177,60 @@ class LlamaModel:
             axes, is_leaf=lambda x: isinstance(x, tuple))
 
     # -- forward ------------------------------------------------------------
+    def _embed_lookup(self, table: jax.Array, tokens: jax.Array) -> jax.Array:
+        """Vocab-parallel embedding lookup.
+
+        The table is vocab-sharded over tp; a plain gather forces XLA into
+        "involuntary full rematerialization" (replicate + repartition) of
+        the table. Megatron-style instead: each tp shard looks up only
+        tokens in its vocab range and a psum combines — communication is
+        one all-reduce of [B,S,D] activations, never the table.
+        """
+        mesh = self.mesh
+        if mesh is None or mesh.shape.get("tp", 1) == 1:
+            return table[tokens]
+        from jax.sharding import PartitionSpec as P
+
+        from ray_tpu.parallel.mesh import shard_map_compat
+
+        present = set(mesh.shape.keys())
+        seq_ax = "sp" if "sp" in present else None
+        # The table keeps BOTH its shardings inside the shard_map (vocab
+        # over tp, embed dim over fsdp) so no table bytes ever move; each
+        # fsdp rank looks up its D-slice for the dp batch shard, and the
+        # follow-up _constrain reshards only the [B,S,D] activations.
+        dp_ax = "dp" if "dp" in present else None
+        fsdp_ax = "fsdp" if "fsdp" in present else None
+        vshard = self.cfg.vocab_size // mesh.shape["tp"]
+
+        def lookup(table_local, tok):
+            start = jax.lax.axis_index("tp") * vshard
+            local = tok - start
+            valid = (local >= 0) & (local < vshard)
+            safe = jnp.where(valid, local, 0)
+            out = table_local[safe] * valid[..., None].astype(
+                table_local.dtype)
+            return jax.lax.psum(out, "tp")
+
+        fn = shard_map_compat(
+            lookup, mesh,
+            (P("tp", fsdp_ax), P(dp_ax, seq_ax)),
+            P(dp_ax, seq_ax, fsdp_ax))
+        return fn(table, tokens)
+
     def _attention(self, q, k, v, positions):
         if self._sp > 1:
             if positions is not None:
                 raise NotImplementedError(
                     "explicit positions are not supported with sp>1: the "
-                    "ring-attention causal mask assumes contiguous 0..S-1")
+                    "context-parallel causal mask assumes contiguous "
+                    "0..S-1")
             # Inside pjit the arrays are globally-shaped; shard_map splits
-            # them per-device and runs the ppermute ring over ICI.
+            # them per-device and runs the collective scheme over ICI.
+            if self.cfg.attention_impl == "ulysses":
+                from ray_tpu.ops.ulysses import ulysses_attention_sharded
+                return ulysses_attention_sharded(q, k, v, self.mesh,
+                                                 causal=True)
             from ray_tpu.ops.ring_attention import ring_attention_sharded
             return ring_attention_sharded(q, k, v, self.mesh, causal=True)
         return attention(q, k, v, causal=True, positions_q=positions,
@@ -205,7 +262,7 @@ class LlamaModel:
               positions: Optional[jax.Array] = None) -> jax.Array:
         """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
         cfg = self.cfg
-        x = params["embed"].astype(cfg.dtype)[tokens]
+        x = self._embed_lookup(params["embed"].astype(cfg.dtype), tokens)
         x = self._constrain(x, "batch", "seq", "embed")
 
         block = self._block
@@ -245,7 +302,7 @@ class LlamaModel:
         B, T = tokens.shape
         S = cache["k"].shape[2]
         q_pos = offsets[:, None] + jnp.arange(T)[None, :]        # [B, T]
-        x = params["embed"].astype(cfg.dtype)[tokens]
+        x = self._embed_lookup(params["embed"].astype(cfg.dtype), tokens)
 
         batch_idx = jnp.arange(B)[:, None]
 

@@ -102,18 +102,11 @@ def ring_attention_sharded(q, k, v, mesh, *, axis_name: str = "sp",
                            batch_axes=("dp", "fsdp"), head_axis: str = "tp"):
     """Convenience wrapper: shard_map ring_attention over ``mesh``."""
     from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+
+    from ray_tpu.parallel.mesh import shard_map_compat
 
     spec = P(batch_axes, axis_name, head_axis, None)
     ring = functools.partial(ring_attention, axis_name=axis_name,
                              causal=causal)
-    try:
-        fn = shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
-    except TypeError:  # older jax spells it check_rep
-        fn = shard_map(ring, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_rep=False)
+    fn = shard_map_compat(ring, mesh, (spec, spec, spec), spec)
     return fn(q, k, v)

@@ -1,5 +1,6 @@
 """Ops layer: norms, rope, attention, ring attention (8 virtual devices)."""
 
+import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -159,3 +160,110 @@ def test_blockwise_attention_matches_reference_fwd_and_grad():
     np.testing.assert_allclose(np.asarray(jax.grad(f_blk)(q)),
                                np.asarray(jax.grad(f_ref)(q)),
                                rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses all-to-all context parallelism (SURVEY §5.7 requires both schemes)
+# ---------------------------------------------------------------------------
+
+def test_ulysses_attention_matches_reference():
+    """shard_map Ulysses over sp=4 must equal full attention."""
+    from jax.sharding import Mesh
+    from ray_tpu.ops.ulysses import ulysses_attention_sharded
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("sp",))
+    rng = np.random.default_rng(3)
+    B, S, H, D = 2, 32, 4, 8
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, 2, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, 2, D)), jnp.float32)
+
+    out = ulysses_attention_sharded(q, k, v, mesh, batch_axes=(),
+                                    head_axis=None)
+    expect = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_matches_ring():
+    from jax.sharding import Mesh
+    from ray_tpu.ops.ulysses import ulysses_attention_sharded
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("sp",))
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.normal(size=(1, 64, 4, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 64, 4, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 64, 4, 8)), jnp.float32)
+    u = ulysses_attention_sharded(q, k, v, mesh, batch_axes=(),
+                                  head_axis=None)
+    r = ring_attention_sharded(q, k, v, mesh, batch_axes=(),
+                               head_axis=None)
+    np.testing.assert_allclose(np.asarray(u), np.asarray(r),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_gradients_match_reference():
+    from jax.sharding import Mesh
+    from ray_tpu.ops.ulysses import ulysses_attention_sharded
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("sp",))
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.normal(size=(1, 32, 4, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 32, 4, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, 32, 4, 8)), jnp.float32)
+    cot = jnp.asarray(rng.normal(size=(1, 32, 4, 8)), jnp.float32)
+
+    def f_uly(q, k, v):
+        return (ulysses_attention_sharded(q, k, v, mesh, batch_axes=(),
+                                          head_axis=None) * cot).sum()
+
+    def f_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=True) * cot).sum()
+
+    gu = jax.grad(f_uly, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gu, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from jax.sharding import Mesh
+    from ray_tpu.ops.ulysses import ulysses_attention_sharded
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("sp",))
+    q = jnp.zeros((1, 32, 3, 8), jnp.float32)  # 3 heads, sp=4
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention_sharded(q, q, q, mesh, batch_axes=(),
+                                  head_axis=None)
+
+
+# ---------------------------------------------------------------------------
+# Explicit MoE expert all-to-all dispatch (VERDICT r1 #7)
+# ---------------------------------------------------------------------------
+
+def test_moe_alltoall_matches_einsum_dispatch():
+    """The explicit all-to-all scheme must agree with the dense einsum
+    scheme when capacity is ample (no drops on either side)."""
+    from ray_tpu.models.moe import MoEConfig, MoEModel
+
+    from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+    mesh = build_mesh(MeshSpec.auto(8, sp=2, ep=2))
+    cfg_a = MoEConfig.debug_moe(num_experts=4)
+    cfg_a = dataclasses.replace(cfg_a, capacity_factor=4.0,
+                                dtype=jnp.float32)
+    cfg_b = dataclasses.replace(cfg_a, moe_dispatch="alltoall")
+
+    model_a = MoEModel(cfg_a, mesh=mesh)
+    model_b = MoEModel(cfg_b, mesh=mesh)
+    params = model_a.init(jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg_a.vocab_size, (2, 32)))
+
+    with mesh:
+        la, aux_a = model_a.apply_with_aux(params, tokens)
+        lb, aux_b = model_b.apply_with_aux(params, tokens)
+    np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                               rtol=5e-3, atol=5e-4)
+    np.testing.assert_allclose(float(aux_a), float(aux_b),
+                               rtol=5e-2, atol=1e-3)
