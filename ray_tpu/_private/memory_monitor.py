@@ -1,0 +1,244 @@
+"""Memory monitor + worker-killing policies (OOM defense).
+
+Reference capability: ``src/ray/common/memory_monitor.h:52`` samples
+system/cgroup memory against a usage threshold; on breach the raylet
+applies a worker-killing policy (``raylet/worker_killing_policy*.h``):
+``retriable-FIFO`` prefers the newest retriable work, ``group-by-owner``
+penalizes the owner with the most submitted tasks. Killing a retriable
+task's worker converts an imminent host OOM (which would take down the
+whole node, driver included) into a task retry; when retries are
+exhausted the task fails with :class:`OutOfMemoryError`.
+
+TPU note: this guards HOST memory only. Device HBM pressure is handled
+by XLA allocation failures inside the mesh-owning process and by the
+object store's create/eviction backpressure — a host monitor must never
+SIGKILL the process that owns the TPU client, so only worker processes
+(never the driver) are candidates.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Any, List, Optional
+
+CHECK_INTERVAL_S = float(os.environ.get("RAY_TPU_MEMORY_MONITOR_INTERVAL",
+                                        "1.0"))
+USAGE_THRESHOLD = float(os.environ.get("RAY_TPU_MEMORY_USAGE_THRESHOLD",
+                                       "0.95"))
+
+
+def _cgroup_limit() -> Optional[int]:
+    for path in ("/sys/fs/cgroup/memory.max",
+                 "/sys/fs/cgroup/memory/memory.limit_in_bytes"):
+        try:
+            raw = open(path).read().strip()
+            if raw and raw != "max":
+                val = int(raw)
+                if 0 < val < 1 << 60:
+                    return val
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def system_memory_limit() -> int:
+    limit = _cgroup_limit()
+    if limit is not None:
+        return limit
+    try:
+        for line in open("/proc/meminfo"):
+            if line.startswith("MemTotal:"):
+                return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 1 << 62
+
+
+def _cgroup_current() -> Optional[int]:
+    for path in ("/sys/fs/cgroup/memory.current",
+                 "/sys/fs/cgroup/memory/memory.usage_in_bytes"):
+        try:
+            return int(open(path).read().strip())
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def process_rss(pid: int) -> int:
+    """Proportional set size when available (shared pages — the shm
+    object arena, forkserver template — counted once per sharer), RSS
+    as fallback."""
+    try:
+        with open(f"/proc/{pid}/smaps_rollup") as f:
+            for line in f:
+                if line.startswith("Pss:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, IndexError, ValueError):
+        pass
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
+class _Candidate:
+    __slots__ = ("pid", "kind", "task_id", "actor_id", "retriable",
+                 "started_at", "owner_key")
+
+    def __init__(self, pid, kind, task_id=None, actor_id=None,
+                 retriable=True, started_at=0.0, owner_key=""):
+        self.pid = pid
+        self.kind = kind                # "task" | "actor"
+        self.task_id = task_id
+        self.actor_id = actor_id
+        self.retriable = retriable
+        self.started_at = started_at
+        self.owner_key = owner_key
+
+
+class RetriableFIFOPolicy:
+    """Prefer the NEWEST retriable task (cheapest progress to lose);
+    fall back to the newest restartable actor, then anything
+    (reference: worker_killing_policy_retriable_fifo.h)."""
+
+    def pick(self, candidates: List[_Candidate]) -> Optional[_Candidate]:
+        for pool in (
+                [c for c in candidates if c.kind == "task" and c.retriable],
+                [c for c in candidates if c.kind == "actor"
+                 and c.retriable],
+                candidates):
+            if pool:
+                return max(pool, key=lambda c: c.started_at)
+        return None
+
+
+class GroupByOwnerPolicy:
+    """Penalize the owner group with the most running work; newest first
+    within the group (reference: worker_killing_policy_group_by_owner.h).
+    Here every task shares one owner (the single controller), so groups
+    are keyed by task name — a fan-out that floods memory gets trimmed
+    before unrelated singleton work dies."""
+
+    def pick(self, candidates: List[_Candidate]) -> Optional[_Candidate]:
+        groups: dict = {}
+        for c in candidates:
+            groups.setdefault(c.owner_key, []).append(c)
+        if not groups:
+            return None
+        biggest = max(groups.values(), key=len)
+        retriable = [c for c in biggest if c.retriable]
+        pool = retriable or biggest
+        return max(pool, key=lambda c: c.started_at)
+
+
+class MemoryMonitor:
+    """Samples driver+worker RSS; on threshold breach kills one worker
+    process per tick using the configured policy."""
+
+    def __init__(self, runtime, limit_bytes: Optional[int] = None,
+                 threshold: float = USAGE_THRESHOLD,
+                 policy: Optional[Any] = None,
+                 interval_s: float = CHECK_INTERVAL_S):
+        self.runtime = runtime
+        self.limit = limit_bytes or int(
+            os.environ.get("RAY_TPU_MEMORY_LIMIT_BYTES", "0")) or \
+            system_memory_limit()
+        self.threshold = threshold
+        self.policy = policy or (
+            GroupByOwnerPolicy()
+            if os.environ.get("RAY_TPU_WORKER_KILLING_POLICY")
+            == "group_by_owner" else RetriableFIFOPolicy())
+        self.interval_s = interval_s
+        self.kills = 0
+        self.oom_killed_tasks: set = set()
+        self.oom_killed_actors: set = set()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="memory-monitor")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def set_limit(self, limit_bytes: int) -> None:
+        self.limit = limit_bytes
+
+    # -- sampling ---------------------------------------------------------
+    def _worker_pids(self):
+        """(pid, candidate) for every live worker process."""
+        router = self.runtime.process_router
+        out: List[_Candidate] = []
+        with router._lock:
+            running = dict(router._running)
+            actors = dict(router._actor_workers)
+        with self.runtime._tasks_lock:
+            tasks = dict(self.runtime._tasks)
+        for task_id, (client, _rid) in running.items():
+            inflight = tasks.get(task_id)
+            spec = inflight.spec if inflight else None
+            retriable = bool(spec is not None
+                             and (spec.max_retries != 0))
+            out.append(_Candidate(
+                client.proc.pid, "task", task_id=task_id,
+                retriable=retriable,
+                started_at=getattr(spec, "enqueued_at", 0.0) or 0.0,
+                owner_key=getattr(spec, "name", "")))
+        for actor_id, client in actors.items():
+            info = self.runtime.gcs.get_actor_info(actor_id)
+            restartable = bool(info is not None
+                               and (info.max_restarts == -1
+                                    or info.num_restarts
+                                    < info.max_restarts))
+            out.append(_Candidate(
+                client.proc.pid, "actor", actor_id=actor_id,
+                retriable=restartable, started_at=client.calls,
+                owner_key=getattr(info, "class_name", "") or ""))
+        return out
+
+    def usage_bytes(self, candidates=None) -> int:
+        # Prefer the cgroup's own accounting (one number, shared pages
+        # counted once — reference memory_monitor.h samples system used
+        # memory for exactly this reason); PSS summation is the
+        # fallback outside a memory cgroup.
+        current = _cgroup_current()
+        if current is not None:
+            return current
+        total = process_rss(os.getpid())
+        for cand in candidates or self._worker_pids():
+            total += process_rss(cand.pid)
+        return total
+
+    # -- enforcement ------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._tick()
+            except Exception:
+                pass
+
+    def _tick(self) -> None:
+        candidates = self._worker_pids()
+        used = self.usage_bytes(candidates)
+        if used < self.limit * self.threshold:
+            return
+        victim = self.policy.pick(candidates)
+        if victim is None:
+            return
+        self.kills += 1
+        if victim.task_id is not None:
+            self.oom_killed_tasks.add(victim.task_id)
+        if victim.actor_id is not None:
+            self.oom_killed_actors.add(victim.actor_id)
+        try:
+            os.kill(victim.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def was_oom_killed(self, task_id) -> bool:
+        return task_id in self.oom_killed_tasks

@@ -191,6 +191,15 @@ class Runtime:
         from ray_tpu._private.worker_process import ProcessRouter
         self.process_router = ProcessRouter(self)
 
+        # OOM defense: sample driver+worker RSS, kill a worker per policy
+        # on threshold breach (reference: common/memory_monitor.h:52 +
+        # raylet/worker_killing_policy*.h). The driver (mesh owner) is
+        # never a victim.
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+        self.memory_monitor = MemoryMonitor(self)
+        if os.environ.get("RAY_TPU_MEMORY_MONITOR", "1") != "0":
+            self.memory_monitor.start()
+
         if resources_per_node is None:
             resources_per_node = self._detect_resources()
         self.cluster_backend = None
@@ -840,10 +849,20 @@ class Runtime:
             self._fail_task(spec, exc.TaskError(
                 exc.TaskCancelledError(spec.task_id), spec.name))
             return
+        oom = self.memory_monitor.was_oom_killed(spec.task_id)
         if _retries_left(spec):
             self.task_events.record(task_id=spec.task_id.hex(),
-                                    name=spec.name, event="RETRY")
+                                    name=spec.name,
+                                    event="RETRY_OOM" if oom else "RETRY")
             self._retry(spec)
+            return
+        if oom:
+            self._fail_task(spec, exc.TaskError(
+                exc.OutOfMemoryError(
+                    f"task {spec.name} was killed by the memory monitor "
+                    f"({self.memory_monitor.kills} kills; limit "
+                    f"{self.memory_monitor.limit >> 20} MiB) and "
+                    f"exhausted its retries"), spec.name))
             return
         self._fail_task(spec, exc.TaskError(
             exc.WorkerCrashedError(str(crash)), spec.name))
@@ -1518,6 +1537,7 @@ class Runtime:
     # ------------------------------------------------------------------
     def shutdown(self) -> None:
         self._shutdown = True
+        self.memory_monitor.stop()
         self.process_router.shutdown()
         if self.cluster_backend is not None:
             try:

@@ -12,6 +12,7 @@ from ray_tpu.data.dataset import Dataset, GroupedData
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
                                    from_pandas, range, read_binary_files,
+                                  read_images, read_numpy,
                                    read_csv, read_json, read_parquet,
                                    read_text)
 
@@ -20,5 +21,7 @@ __all__ = [
     "range", "from_items", "from_numpy", "from_pandas", "from_arrow",
     "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files",
+    "read_images",
+    "read_numpy",
     "Count", "Sum", "Min", "Max", "Mean", "Std",
 ]

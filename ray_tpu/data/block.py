@@ -35,6 +35,13 @@ def block_from_rows(rows: List[Row]) -> Block:
 def _column_from_numpy(v) -> "pa.Array":
     arr = np.asarray(v)
     if arr.ndim > 1 and arr.dtype != object:
+        if any(s == 0 for s in arr.strides) or not arr.flags.c_contiguous:
+            # Arrow's tensor import rejects degenerate strides (numpy uses
+            # stride 0 for size-1 dims even on contiguous arrays); rebuild
+            # with canonical strides.
+            fixed = np.empty(arr.shape, arr.dtype)
+            fixed[...] = arr
+            arr = fixed
         # fixed-shape tensor column: preserves dtype/shape, zero-copy both
         # ways (reference: ray.data ArrowTensorArray extension type)
         return pa.FixedShapeTensorArray.from_numpy_ndarray(arr)

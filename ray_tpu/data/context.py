@@ -17,6 +17,9 @@ class DataContext:
     default_batch_size: int = 256
     enable_progress_bars: bool = False
     eager_free: bool = True
+    # store-usage fraction above which upstream operators are throttled
+    # (backpressure_policy.ObjectStoreMemoryBackpressurePolicy)
+    object_store_backpressure_threshold: float = 0.8
 
     _local = threading.local()
 

@@ -94,11 +94,15 @@ class PhysicalOperator:
     def launch(self) -> None:
         raise NotImplementedError
 
-    def on_task_done(self, ref, error: Optional[Exception]) -> None:
+    def on_task_done(self, ref, error: Optional[Exception],
+                     value: Any = None) -> None:
         self.active.pop(ref, None)
         if error is not None:
             raise error
         self.outqueue.append(ref)
+
+    def maybe_autoscale(self) -> None:
+        """Hook: operators with elastic resources resize here per tick."""
 
     def done(self) -> bool:
         return (self.inputs_done and not self.inqueue and not self.active)
@@ -145,24 +149,71 @@ class MapOperator(PhysicalOperator):
 
 
 class ActorPoolMapOperator(PhysicalOperator):
-    """Stateful map over a pool of actors (reference:
-    `execution/operators/actor_pool_map_operator.py`)."""
+    """Stateful map over an ELASTIC pool of actors (reference:
+    `execution/operators/actor_pool_map_operator.py` + per-op actor-pool
+    autoscaling): concurrency=(min, max) or n. The pool grows while the
+    input queue outruns the workers and shrinks (idle kill) when input
+    dries up — per-operator dynamic sizing, not a static cap."""
+
+    _IDLE_TICKS_BEFORE_SHRINK = 40
 
     def __init__(self, name: str, op: L.MapBatches):
-        size = (op.concurrency[1] if op.concurrency else 2)
-        super().__init__(name, max_in_flight=size)
-        worker_cls = ray_tpu.remote(_MapWorker)
-        self.workers = [worker_cls.remote(op.fn_constructor, op.batch_format)
-                        for _ in range(size)]
+        if op.concurrency:
+            self.min_size, self.max_size = op.concurrency
+        else:
+            self.min_size, self.max_size = 2, 2
+        super().__init__(name, max_in_flight=self.max_size)
+        self._op = op
+        self._worker_cls = ray_tpu.remote(_MapWorker)
+        self.workers = [self._make_worker()
+                        for _ in range(self.min_size)]
         self._next = 0
-        self._ref_worker: Dict[Any, int] = {}
+        self._idle_ticks = 0
+
+    def _make_worker(self):
+        return self._worker_cls.remote(self._op.fn_constructor,
+                                       self._op.batch_format)
 
     def launch(self) -> None:
         block_ref = self.inqueue.popleft()
         w = self._next % len(self.workers)
         self._next += 1
-        ref = self.workers[w].apply.remote(block_ref)
-        self.active[ref] = True
+        worker = self.workers[w]
+        ref = worker.apply.remote(block_ref)
+        self.active[ref] = worker   # ref -> owning worker (shrink safety)
+
+    def can_launch(self, max_out: int) -> bool:
+        return (bool(self.inqueue)
+                and len(self.active) < len(self.workers)
+                and len(self.outqueue) + len(self.active) < max_out)
+
+    def maybe_autoscale(self) -> None:
+        backlog = len(self.inqueue)
+        busy = len(self.active)
+        if (backlog > 0 and busy == len(self.workers)
+                and len(self.workers) < self.max_size):
+            # the POOL is the binding constraint (all workers busy and
+            # work queuing — not a downstream-backpressure veto): grow
+            self.workers.append(self._make_worker())
+            self._idle_ticks = 0
+            return
+        if backlog == 0 and busy < len(self.workers):
+            self._idle_ticks += 1
+            if (self._idle_ticks >= self._IDLE_TICKS_BEFORE_SHRINK
+                    and len(self.workers) > self.min_size):
+                # only shrink a worker with NO in-flight task
+                busy_workers = set(id(w) for w in self.active.values())
+                for i in range(len(self.workers) - 1, -1, -1):
+                    if id(self.workers[i]) not in busy_workers:
+                        victim = self.workers.pop(i)
+                        self._idle_ticks = 0
+                        try:
+                            ray_tpu.kill(victim)
+                        except Exception:
+                            pass
+                        break
+        else:
+            self._idle_ticks = 0
 
     def shutdown(self) -> None:
         for w in self.workers:
@@ -172,32 +223,49 @@ class ActorPoolMapOperator(PhysicalOperator):
                 pass
 
 
+def _limit_slice_task(block: Block, remaining: int):
+    n = block.num_rows
+    taken = min(n, remaining)
+    out = block if taken == n else block.slice(0, taken)
+    return out, taken
+
+
 class LimitOperator(PhysicalOperator):
-    """Streaming limit: slices blocks until the budget is spent."""
+    """Streaming limit WITHOUT blocking the scheduling loop: each block
+    is sliced by a remote task (num_returns=2: block + rows-taken); the
+    loop learns the consumed count from the tiny inline second return.
+    Sequential (max_in_flight=1) so the budget is exact."""
 
     def __init__(self, limit: int):
         super().__init__(f"limit={limit}", max_in_flight=1)
         self.remaining = limit
+        self._slice = ray_tpu.remote(_limit_slice_task).options(
+            num_returns=2)
+        self._taken_refs: Dict[Any, Any] = {}
 
     def can_launch(self, max_out: int) -> bool:
-        return bool(self.inqueue)
+        return (bool(self.inqueue) and not self.active
+                and self.remaining > 0)
 
     def launch(self) -> None:
         ref = self.inqueue.popleft()
-        if self.remaining <= 0:
-            return
-        block = ray_tpu.get(ref)
-        n = block.num_rows
-        if n <= self.remaining:
-            self.remaining -= n
-            self.outqueue.append(ray_tpu.put(block))
-        else:
-            self.outqueue.append(
-                ray_tpu.put(block.slice(0, self.remaining)))
-            self.remaining = 0
+        block_ref, taken_ref = self._slice.remote(ref, self.remaining)
+        self.active[block_ref] = True
+        self._taken_refs[block_ref] = taken_ref
+
+    def on_task_done(self, ref, error: Optional[Exception],
+                     value: Any = None) -> None:
+        self.active.pop(ref, None)
+        taken_ref = self._taken_refs.pop(ref, None)
+        if error is not None:
+            raise error
+        if taken_ref is not None:
+            self.remaining -= int(ray_tpu.get(taken_ref))
+        self.outqueue.append(ref)
 
     def done(self) -> bool:
-        return super().done() or self.remaining <= 0
+        return super().done() or (self.remaining <= 0
+                                  and not self.active)
 
 
 # ---------------------------------------------------------------------------
@@ -425,18 +493,27 @@ class StreamingExecutor:
     while upstream still reads block N)."""
 
     def __init__(self, operators: List[PhysicalOperator],
-                 max_out_queue: Optional[int] = None, stats=None):
+                 max_out_queue: Optional[int] = None, stats=None,
+                 backpressure_policies=None):
+        from ray_tpu.data.backpressure_policy import default_policies
         from ray_tpu.data.context import DataContext
         ctx = DataContext.get_current()
         self.ops = operators
         self.max_out_queue = (max_out_queue if max_out_queue is not None
                               else ctx.max_operator_output_queue)
         self.stats = stats
+        self.policies = (backpressure_policies
+                         if backpressure_policies is not None
+                         else default_policies())
         for op in operators:
             op.max_in_flight = min(op.max_in_flight,
                                    ctx.max_in_flight_tasks_per_operator)
         for a, b in zip(operators[:-1], operators[1:]):
             a.downstream = b
+
+    def _admit(self, op: PhysicalOperator) -> bool:
+        return (op.can_launch(self.max_out_queue)
+                and all(p.can_launch(op, self) for p in self.policies))
 
     def execute(self) -> Iterator[Any]:
         ops = self.ops
@@ -458,9 +535,10 @@ class StreamingExecutor:
                 # (select_operator_to_run heuristic — drain before read)
                 launched = False
                 for op in reversed(ops):
-                    while op.can_launch(self.max_out_queue):
+                    while self._admit(op):
                         op.launch()
                         launched = True
+                    op.maybe_autoscale()
                 # poll in-flight tasks
                 in_flight = [r for op in ops for r in op.active]
                 if in_flight:
@@ -469,8 +547,8 @@ class StreamingExecutor:
                     for ref in done:
                         owner = next(o for o in ops if ref in o.active)
                         try:
-                            ray_tpu.get(ref)
-                            owner.on_task_done(ref, None)
+                            value = ray_tpu.get(ref)
+                            owner.on_task_done(ref, None, value=value)
                             if self.stats is not None:
                                 self.stats.record(owner.name, blocks=1)
                         except Exception as e:

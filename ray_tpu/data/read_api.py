@@ -64,17 +64,8 @@ def from_arrow(table: pa.Table) -> Dataset:
 
 
 def _expand_paths(paths, suffix: str) -> List[str]:
-    if isinstance(paths, str):
-        paths = [paths]
-    out: List[str] = []
-    for p in paths:
-        if os.path.isdir(p):
-            out.extend(sorted(_glob.glob(os.path.join(p, f"*{suffix}"))))
-        elif "*" in p:
-            out.extend(sorted(_glob.glob(p)))
-        else:
-            out.append(p)
-    return out
+    from ray_tpu.data.filesystem import expand_paths
+    return expand_paths(paths, suffix)
 
 
 def _file_read_dataset(paths, suffix: str, reader: Callable,
@@ -112,6 +103,51 @@ def read_text(paths) -> Dataset:
 
 def read_binary_files(paths) -> Dataset:
     def reader(f):
-        with open(f, "rb") as fh:
+        from ray_tpu.data.filesystem import resolve_filesystem
+        fs, local = resolve_filesystem(f)
+        with fs.open_input(local) as fh:
             return block_from_rows([{"bytes": fh.read(), "path": f}])
     return _file_read_dataset(paths, "", reader, "read_binary_files")
+
+
+_IMAGE_SUFFIXES = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+
+def read_images(paths, *, size=None, mode: str = "RGB") -> Dataset:
+    """Decode image files into an ``image`` tensor column (HWC uint8),
+    optionally resizing to ``size=(h, w)`` (reference:
+    ``data/read_api.py read_images`` / image datasource)."""
+    def reader(f):
+        import numpy as _np
+        from PIL import Image
+
+        from ray_tpu.data.filesystem import resolve_filesystem
+        fs, local = resolve_filesystem(f)
+        with fs.open_input(local) as fh:
+            img = Image.open(fh)
+            img.load()
+        if mode:
+            img = img.convert(mode)
+        if size is not None:
+            img = img.resize((size[1], size[0]))
+        arr = _np.ascontiguousarray(img)
+        return block_from_batch(
+            {"image": _np.ascontiguousarray(arr[None, ...]),
+             "path": _np.asarray([f])})
+
+    files = [f for f in _expand_paths(paths, "")
+             if f.lower().endswith(_IMAGE_SUFFIXES)
+             and os.path.isfile(f)]
+    tasks = [lambda f=f: reader(f) for f in files]
+    return Dataset(L.Read("read_images", [], read_tasks=tasks))
+
+
+def read_numpy(paths, column: str = "data") -> Dataset:
+    """One block per .npy file."""
+    def reader(f):
+        from ray_tpu.data.filesystem import resolve_filesystem
+        fs, local = resolve_filesystem(f)
+        with fs.open_input(local) as fh:
+            arr = np.load(fh)
+        return block_from_batch({column: arr})
+    return _file_read_dataset(paths, ".npy", reader, "read_numpy")

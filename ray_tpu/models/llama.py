@@ -194,7 +194,11 @@ class LlamaModel:
         from ray_tpu.parallel.mesh import shard_map_compat
 
         present = set(mesh.shape.keys())
-        seq_ax = "sp" if "sp" in present else None
+        sp = mesh.shape.get("sp", 1)
+        # decode steps carry T=1 (or odd prefill lengths): only shard the
+        # seq dim when it actually divides over sp
+        seq_ax = ("sp" if "sp" in present and sp > 1
+                  and tokens.shape[1] % sp == 0 else None)
         # The table keeps BOTH its shardings inside the shard_map (vocab
         # over tp, embed dim over fsdp) so no table bytes ever move; each
         # fsdp rank looks up its D-slice for the dp batch shard, and the

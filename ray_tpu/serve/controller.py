@@ -35,18 +35,90 @@ class _DeploymentState:
                 min(self.target_replicas,
                     deployment.autoscaling_config.max_replicas))
         self.replicas: List[Any] = []
+        self.replica_slots: List[int] = []   # parallel to replicas
         self.version = 0
         self.last_scale_ts = 0.0
 
 
+_CKPT_KEY = b"serve::applications"
+
+
 class ServeController:
+    """Crash-recoverable: deployment specs checkpoint to the GCS KV on
+    every deploy/delete; a restarted incarnation restores them and
+    re-binds to still-live NAMED replica actors instead of leaking them
+    (reference: controller recovery from GCS checkpoints,
+    serve/tests/test_controller_crashes.py)."""
+
     def __init__(self):
+        from ray_tpu.serve.deployment_scheduler import DeploymentScheduler
+        from ray_tpu.serve.long_poll import LongPollHost
         self._state: Dict[str, _DeploymentState] = {}
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._tick_s = 0.5
+        self._long_poll = LongPollHost()
+        self._scheduler = DeploymentScheduler()
+        self._compact_counter = 0
+        self._recover_from_checkpoint()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
+
+    # -- long-poll host (push config propagation) ----------------------
+    def listen_for_change(self, keys_to_versions: Dict[str, int]
+                          ) -> Dict[str, Any]:
+        return self._long_poll.listen(keys_to_versions)
+
+    def _publish_replicas(self, name: str) -> None:
+        with self._lock:
+            st = self._state.get(name)
+            if st is None:
+                return
+            snapshot = {"replicas": list(st.replicas),
+                        "version": st.version}
+        self._long_poll.publish(f"replicas::{name}", snapshot)
+
+    # -- crash recovery -------------------------------------------------
+    def _kv(self):
+        from ray_tpu._private import worker
+        rt = worker.global_runtime()
+        return rt.gcs if rt is not None and hasattr(rt, "gcs") else None
+
+    def _checkpoint(self) -> None:
+        import cloudpickle
+        kv = self._kv()
+        if kv is None:
+            return
+        with self._lock:
+            specs = {name: (st.deployment, st.init_args, st.init_kwargs,
+                            st.target_replicas)
+                     for name, st in self._state.items()}
+        try:
+            kv.kv_put(_CKPT_KEY, cloudpickle.dumps(specs))
+        except Exception:
+            pass
+
+    def _recover_from_checkpoint(self) -> None:
+        import cloudpickle
+        kv = self._kv()
+        if kv is None:
+            return
+        try:
+            blob = kv.kv_get(_CKPT_KEY)
+        except Exception:
+            return
+        if not blob:
+            return
+        try:
+            specs = cloudpickle.loads(blob)
+        except Exception:
+            return
+        for name, (dep, args, kwargs, target) in specs.items():
+            st = _DeploymentState(dep, args, kwargs)
+            st.target_replicas = target
+            with self._lock:
+                self._state[name] = st
+            self._reconcile_one(name)
 
     # -- deploy --------------------------------------------------------
     def deploy_application(self, app: Application,
@@ -81,24 +153,40 @@ class ServeController:
             st = _DeploymentState(dep, tuple(args), kwargs)
             self._state[dep.name] = st
         self._reconcile_one(dep.name)
+        self._checkpoint()
         return dep.name
 
     def _self_handle(self):
         return ray_tpu.get_actor("serve_controller")
 
     # -- reconciliation ------------------------------------------------
-    def _start_replica(self, st: _DeploymentState):
+    def _start_replica(self, st: _DeploymentState, slot: int):
+        from ray_tpu._private.task_spec import NodeAffinitySchedulingStrategy
         opts = dict(st.deployment.ray_actor_options or {})
+        name = st.deployment.name
+        # SPREAD placement across alive nodes (deployment_scheduler.py;
+        # reference SPREAD default :34); soft affinity so a full node
+        # doesn't block the replica.
+        node_hex = self._scheduler.pick_node_for_replica(name)
+        if node_hex is not None and "scheduling_strategy" not in opts:
+            opts["scheduling_strategy"] = NodeAffinitySchedulingStrategy(
+                node_id=node_hex, soft=True)
         replica_cls = ray_tpu.remote(Replica)
         handle = replica_cls.options(
             # Replicas wrap user callables that may own jax/device state
             # (LLM engines); TPU-first placement keeps them with the mesh.
             _in_process=True,
+            # Named so a restarted controller re-binds instead of leaking
+            # the live replica (crash recovery).
+            name=f"SERVE_REPLICA::{name}::{slot}",
+            get_if_exists=True,
             max_concurrency=st.deployment.max_ongoing_requests,
             max_restarts=st.deployment.max_restarts, **opts,
         ).remote(st.deployment.func_or_class, st.init_args, st.init_kwargs,
                  st.deployment.user_config)
         ray_tpu.get(handle.ping.remote())   # fail fast on ctor errors
+        if node_hex is not None:
+            self._scheduler.record(name, handle, node_hex)
         return handle
 
     def _reconcile_one(self, name: str) -> None:
@@ -109,17 +197,27 @@ class ServeController:
             target = st.target_replicas
             changed = False
             while len(st.replicas) < target:
-                st.replicas.append(self._start_replica(st))
+                # lowest unused slot: a mid-list removal must NOT make us
+                # collide with a live higher slot via get_if_exists
+                used = set(st.replica_slots)
+                slot = next(i for i in range(target + len(used) + 1)
+                            if i not in used)
+                st.replicas.append(self._start_replica(st, slot=slot))
+                st.replica_slots.append(slot)
                 changed = True
             while len(st.replicas) > target:
                 victim = st.replicas.pop()
+                st.replica_slots.pop()
                 changed = True
+                self._scheduler.forget(name, victim)
                 try:
                     ray_tpu.kill(victim)
                 except Exception:
                     pass
             if changed:
                 st.version += 1
+        if changed:
+            self._publish_replicas(name)
 
     def _check_health(self, name: str) -> None:
         with self._lock:
@@ -127,17 +225,21 @@ class ServeController:
             if st is None:
                 return
             alive = []
+            alive_slots = []
             changed = False
-            for r in st.replicas:
+            for r, slot in zip(st.replicas, st.replica_slots):
                 try:
                     ray_tpu.get(r.ping.remote(), timeout=5)
                     alive.append(r)
+                    alive_slots.append(slot)
                 except Exception:
                     changed = True
             if changed:
                 st.replicas = alive
+                st.replica_slots = alive_slots
                 st.version += 1
         if changed:
+            self._publish_replicas(name)
             self._reconcile_one(name)
 
     # -- autoscaling ---------------------------------------------------
@@ -178,8 +280,55 @@ class ServeController:
                 for name in list(self._state):
                     self._check_health(name)
                     self._autoscale_one(name)
+                self._compact_counter += 1
+                if self._compact_counter % 20 == 0:
+                    self._maybe_compact()
             except Exception:
                 traceback.print_exc()
+
+    def _maybe_compact(self) -> None:
+        """Migrate the least-loaded node's replicas so the node can be
+        released (reference: get_node_to_compact :638). One node per
+        pass; the reconcile path recreates replicas elsewhere."""
+        node_hex = self._scheduler.get_node_to_compact()
+        if node_hex is None:
+            return
+        doomed = self._scheduler.replicas_on(node_hex)
+        if not doomed:
+            return
+        by_dep = {}
+        for deployment, rid in doomed:
+            by_dep.setdefault(deployment, set()).add(rid)
+        # keep evicted replicas off the compacted node while they are
+        # re-placed (otherwise SPREAD immediately picks the now-empty
+        # node and compaction churns forever)
+        self._scheduler.block_node(node_hex)
+        for name, rids in by_dep.items():
+            with self._lock:
+                st = self._state.get(name)
+                if st is None:
+                    continue
+                keep, evict = [], []
+                keep_slots = []
+                for r, slot in zip(st.replicas, st.replica_slots):
+                    if id(r) in rids:
+                        evict.append(r)
+                    else:
+                        keep.append(r)
+                        keep_slots.append(slot)
+                if not evict:
+                    continue
+                st.replicas = keep
+                st.replica_slots = keep_slots
+                st.version += 1
+            for r in evict:
+                self._scheduler.forget(name, r)
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+            self._publish_replicas(name)
+            self._reconcile_one(name)
 
     # -- introspection (handles, status API) ---------------------------
     def get_replicas(self, name: str) -> Dict[str, Any]:
@@ -204,12 +353,16 @@ class ServeController:
     def delete_deployment(self, name: str) -> None:
         with self._lock:
             st = self._state.pop(name, None)
+        self._scheduler.forget_deployment(name)
         if st:
             for r in st.replicas:
                 try:
                     ray_tpu.kill(r)
                 except Exception:
                     pass
+        self._checkpoint()
+        self._long_poll.publish(f"replicas::{name}",
+                                {"replicas": [], "version": 1 << 30})
 
     def reconfigure_deployment(self, name: str, user_config: Dict) -> None:
         with self._lock:

@@ -2,10 +2,11 @@
 
 Reference: `serve/_private/router.py:341,365,676` (AsyncioRouter),
 `serve/_private/request_router/pow_2_router.py:27` (power-of-two-choices on
-queue length), `serve/_private/long_poll.py` (membership push). Here the
-handle pulls the replica set from the controller when its cached version
-goes stale (poll-on-miss) and routes by P2C over locally-tracked in-flight
-counts.
+queue length), `serve/_private/long_poll.py` (membership push). Replica
+membership is PUSHED: each handle keeps a long-poll listen open against
+the controller (serve/long_poll.py) and applies snapshots the moment a
+deploy/scale/death publishes — no periodic-poll staleness window. Routing
+is P2C over locally-tracked in-flight counts.
 """
 
 from __future__ import annotations
@@ -32,62 +33,139 @@ class DeploymentResponse:
         return self._ref
 
 
+class _HandleState:
+    """Router state SHARED by a handle and all its method views: one
+    replica set, one in-flight table, and at most ONE long-poll listener
+    per deployment handle family (method composition must not multiply
+    listener threads or parked controller listens)."""
+
+    def __init__(self, deployment_name: str, controller):
+        self.deployment_name = deployment_name
+        self.controller = controller
+        self.lock = threading.Lock()
+        self.replicas: List = []
+        self.version = -1
+        self.inflight: Dict[int, int] = {}
+        self.rng = random.Random(0)
+        self.long_poll = None
+
+    def ensure_long_poll(self) -> None:
+        if self.long_poll is not None:
+            return
+        import weakref
+
+        from ray_tpu.serve.long_poll import LongPollClient
+
+        ref = weakref.ref(self)
+
+        def on_update(snapshot, version):
+            state = ref()
+            if state is None:
+                return
+            with state.lock:
+                state.replicas = snapshot["replicas"]
+                state.version = version
+                state.inflight = {i: 0
+                                  for i in range(len(state.replicas))}
+
+        client = LongPollClient(
+            self.controller,
+            {f"replicas::{self.deployment_name}": on_update})
+        self.long_poll = client
+        # stop the listener thread when the handle family is collected
+        weakref.finalize(self, LongPollClient.stop, client)
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller,
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__", _state=None):
         self.deployment_name = deployment_name
         self._controller = controller
         self._method_name = method_name
-        self._lock = threading.Lock()
-        self._replicas: List = []
-        self._version = -1
-        self._inflight: Dict[int, int] = {}
-        self._rng = random.Random(0)
+        self._state = _state or _HandleState(deployment_name, controller)
+        self._children: Dict[str, "DeploymentHandle"] = {}
 
-    # composition: handle.other_method.remote(...)
+    # back-compat views onto the shared state
+    @property
+    def _lock(self):
+        return self._state.lock
+
+    @property
+    def _replicas(self):
+        return self._state.replicas
+
+    @property
+    def _version(self):
+        return self._state.version
+
+    @property
+    def _inflight(self):
+        return self._state.inflight
+
+    def __getstate__(self):
+        return {"deployment_name": self.deployment_name,
+                "_controller": self._controller,
+                "_method_name": self._method_name}
+
+    def __setstate__(self, d):
+        self.deployment_name = d["deployment_name"]
+        self._controller = d["_controller"]
+        self._method_name = d["_method_name"]
+        self._state = _HandleState(self.deployment_name, self._controller)
+        self._children = {}
+
+    # composition: handle.other_method.remote(...) — cached, sharing
+    # the router state (one listener for the whole family)
     def __getattr__(self, name: str) -> "DeploymentHandle":
-        if name.startswith("_"):
+        if name.startswith("_") or name in ("deployment_name",):
             raise AttributeError(name)
-        h = DeploymentHandle(self.deployment_name, self._controller, name)
-        h._replicas = self._replicas
-        h._version = self._version
-        return h
+        cached = self._children.get(name)
+        if cached is None:
+            cached = DeploymentHandle(self.deployment_name,
+                                      self._controller, name,
+                                      _state=self._state)
+            self._children[name] = cached
+        return cached
 
     def options(self, method_name: str) -> "DeploymentHandle":
         return self.__getattr__(method_name)
 
     def _refresh(self, force: bool = False) -> None:
-        with self._lock:
-            stale = force or not self._replicas
+        state = self._state
+        with state.lock:
+            stale = force or not state.replicas
         if not stale:
             return
         info = ray_tpu.get(self._controller.get_replicas.remote(
             self.deployment_name))
-        with self._lock:
-            self._replicas = info["replicas"]
-            self._version = info["version"]
-            self._inflight = {i: 0 for i in range(len(self._replicas))}
+        with state.lock:
+            state.replicas = info["replicas"]
+            state.version = info["version"]
+            state.inflight = {i: 0 for i in range(len(state.replicas))}
 
     def _pick(self) -> int:
         """Power-of-two-choices on local in-flight counts."""
-        n = len(self._replicas)
+        state = self._state
+        n = len(state.replicas)
         if n == 1:
             return 0
-        a, b = self._rng.sample(range(n), 2)
-        return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) \
-            else b
+        a, b = state.rng.sample(range(n), 2)
+        return (a if state.inflight.get(a, 0) <= state.inflight.get(b, 0)
+                else b)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        self._refresh()
+        state = self._state
+        state.ensure_long_poll()
+        self._refresh()  # fallback for the gap before the first push
         last_err = None
         for _ in range(3):
-            with self._lock:
-                if not self._replicas:
+            with state.lock:
+                if not state.replicas:
                     raise RuntimeError(
                         f"no replicas for {self.deployment_name}")
                 idx = self._pick()
-                replica = self._replicas[idx]
-                self._inflight[idx] = self._inflight.get(idx, 0) + 1
+                replica = state.replicas[idx]
+                state.inflight[idx] = state.inflight.get(idx, 0) + 1
             try:
                 ref = replica.handle_request.remote(
                     self._method_name, args, kwargs)
@@ -101,14 +179,16 @@ class DeploymentHandle:
             f"routing to {self.deployment_name} failed: {last_err!r}")
 
     def _attach_decrement(self, resp: DeploymentResponse, idx: int) -> None:
+        state = self._state
+
         def waiter():
             try:
                 ray_tpu.get(resp._ref)
             except Exception:
                 pass
-            with self._lock:
-                self._inflight[idx] = max(
-                    0, self._inflight.get(idx, 0) - 1)
+            with state.lock:
+                state.inflight[idx] = max(
+                    0, state.inflight.get(idx, 0) - 1)
         threading.Thread(target=waiter, daemon=True).start()
 
     def __repr__(self):
