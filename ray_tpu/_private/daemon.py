@@ -430,6 +430,7 @@ class DaemonService:
                 wp.release_worker(client)
                 conn.reply(rid, outcome="err", blob=blob)
                 return
+            client.actor_since = time.time()
             router = self.runtime.process_router
             with router._lock:
                 router._actor_workers[spec.actor_id] = client

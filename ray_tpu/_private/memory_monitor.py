@@ -57,11 +57,27 @@ def system_memory_limit() -> int:
     return 1 << 62
 
 
+def _cgroup_reclaimable() -> int:
+    """inactive file-backed pages: the kernel reclaims these before
+    OOMing, so they must not count as pressure (the reference monitor
+    subtracts cache/available for the same reason)."""
+    for path in ("/sys/fs/cgroup/memory.stat",
+                 "/sys/fs/cgroup/memory/memory.stat"):
+        try:
+            for line in open(path):
+                if line.startswith("inactive_file "):
+                    return int(line.split()[1])
+        except (OSError, ValueError):
+            continue
+    return 0
+
+
 def _cgroup_current() -> Optional[int]:
     for path in ("/sys/fs/cgroup/memory.current",
                  "/sys/fs/cgroup/memory/memory.usage_in_bytes"):
         try:
-            return int(open(path).read().strip())
+            used = int(open(path).read().strip())
+            return max(used - _cgroup_reclaimable(), 0)
         except (OSError, ValueError):
             continue
     return None
@@ -197,7 +213,8 @@ class MemoryMonitor:
                                     < info.max_restarts))
             out.append(_Candidate(
                 client.proc.pid, "actor", actor_id=actor_id,
-                retriable=restartable, started_at=client.calls,
+                retriable=restartable,
+                started_at=getattr(client, "actor_since", 0.0),
                 owner_key=getattr(info, "class_name", "") or ""))
         return out
 

@@ -111,6 +111,7 @@ class ActorExecutor:
                             **(concurrency_groups or {})}.items():
             self._groups[name] = {"heap": [], "limit": max(1, int(limit))}
         self._cond = threading.Condition()
+        self._push_seq = 0
         self._dead = False
         self.death_cause: Optional[str] = None
         self._threads: List[threading.Thread] = []
@@ -143,8 +144,11 @@ class ActorExecutor:
         with self._cond:
             if self._dead:
                 return False
+            # tiebreaker: seqnos from DIFFERENT submitter processes can
+            # collide, and TaskSpec is not orderable
+            self._push_seq += 1
             heapq.heappush(self._groups[self._group_of(spec)]["heap"],
-                           (spec.seqno, spec))
+                           (spec.seqno, self._push_seq, spec))
             self.num_pending += 1
             self._cond.notify_all()
         return True
@@ -157,7 +161,7 @@ class ActorExecutor:
             self._dead = True
             self.death_cause = cause
             pending = [spec for g in self._groups.values()
-                       for _, spec in g["heap"]]
+                       for _, _, spec in g["heap"]]
             for g in self._groups.values():
                 g["heap"].clear()
             self.num_pending = 0
@@ -176,7 +180,7 @@ class ActorExecutor:
                 self._cond.wait()
             if self._dead:
                 return None
-            _, spec = heapq.heappop(heap)
+            _, _, spec = heapq.heappop(heap)
             self.num_pending -= 1
             return spec
 
@@ -187,7 +191,7 @@ class ActorExecutor:
             while not self._dead:
                 for g in self._groups.values():
                     if g["heap"]:
-                        _, spec = heapq.heappop(g["heap"])
+                        _, _, spec = heapq.heappop(g["heap"])
                         self.num_pending -= 1
                         return spec
                 self._cond.wait()

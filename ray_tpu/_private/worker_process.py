@@ -43,6 +43,7 @@ import itertools
 import os
 import queue
 import threading
+import time
 import traceback
 import weakref
 from typing import Any, Dict, List, Optional, Tuple
@@ -547,8 +548,36 @@ def _mp_context():
                     os.environ.pop(k, None)
                 else:
                     os.environ[k] = v
+        import atexit
+
+        atexit.register(_shutdown_worker_plane)
         _MP_CTX = ctx
         return ctx
+
+
+def _shutdown_worker_plane() -> None:
+    """Interpreter-exit hook: kill idle pooled workers and release the
+    private forkserver. Without this, worker/template processes keep the
+    multiprocessing resource-tracker pipe open and the tracker's __del__
+    during final GC blocks interpreter exit (observed with grpc loaded,
+    whose import makes shutdown GC collect the tracker)."""
+    _POOL_CLOSED.set()
+    deadline = time.monotonic() + 3.0
+    while _PRESTARTING[0] > 0 and time.monotonic() < deadline:
+        time.sleep(0.02)   # let racing spawns land so drain catches them
+    try:
+        drain_pool()
+    except Exception:
+        pass
+    fs = _OUR_FORKSERVER
+    if fs is not None:
+        try:
+            fd = getattr(fs, "_forkserver_alive_fd", None)
+            if fd is not None:
+                os.close(fd)
+                fs._forkserver_alive_fd = None
+        except OSError:
+            pass
 
 
 _START_LOCK = threading.Lock()
@@ -1034,6 +1063,7 @@ class WorkerClient:
 _POOL_LOCK = threading.Lock()
 _IDLE: List[WorkerClient] = []
 _PRESTARTING = [0]
+_POOL_CLOSED = threading.Event()   # interpreter exiting: no new spawns
 
 
 def _pool_target() -> int:
@@ -1091,9 +1121,12 @@ def release_worker(w: WorkerClient) -> None:
 
 def _maybe_prestart_async() -> None:
     """Keep the idle pool warm in the background (reference: PrestartWorkers)."""
+    if _POOL_CLOSED.is_set():
+        return
+
     def fill():
         try:
-            while True:
+            while not _POOL_CLOSED.is_set():
                 with _POOL_LOCK:
                     deficit = _pool_target() - len(_IDLE) - _PRESTARTING[0]
                     if deficit <= 0:
@@ -1105,7 +1138,8 @@ def _maybe_prestart_async() -> None:
                     with _POOL_LOCK:
                         _PRESTARTING[0] -= 1
                 with _POOL_LOCK:
-                    if len(_IDLE) < _pool_target():
+                    if (len(_IDLE) < _pool_target()
+                            and not _POOL_CLOSED.is_set()):
                         _IDLE.append(w)
                     else:
                         w.kill()
@@ -1284,6 +1318,8 @@ class ProcessRouter:
             client.actor_id = None
             release_worker(client)  # init failed cleanly; process reusable
             raise value
+        import time as _time
+        client.actor_since = _time.time()
         with self._lock:
             self._actor_workers[spec.actor_id] = client
         actor_id = spec.actor_id

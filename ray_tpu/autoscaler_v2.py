@@ -177,16 +177,19 @@ def gcs_autoscaler_state(runtime) -> Dict[str, Any]:
     GcsAutoscalerStateManager): pending demand + per-node shape, derived
     from GCS-visible state rather than runtime internals."""
     demand: Dict[str, float] = {}
+    max_chunk: Dict[str, float] = {}   # largest single task/bundle ask
     for node in runtime.nodes():
         with node._pending_lock:
             for k, v in node._pending_demand.items():
                 if k.startswith("_pg_"):
                     k = k.split("_", 4)[-1]
                 demand[k] = demand.get(k, 0.0) + v
+                max_chunk[k] = max(max_chunk.get(k, 0.0), v)
     for pg in list(getattr(runtime.pg_manager, "_pending", [])):
         for bundle in pg.bundles:
             for k, v in bundle.resources.items():
                 demand[k] = demand.get(k, 0.0) + v
+                max_chunk[k] = max(max_chunk.get(k, 0.0), v)
     nodes = []
     for info in runtime.gcs.alive_nodes():
         node = runtime.get_node(info.node_id)
@@ -198,7 +201,8 @@ def gcs_autoscaler_state(runtime) -> Dict[str, Any]:
                       "available": node.ledger.available(),
                       "total": dict(node.ledger.total),
                       "has_actors": bool(node.actors)})
-    return {"pending_demand": demand, "nodes": nodes}
+    return {"pending_demand": demand, "max_chunk_demand": max_chunk,
+            "nodes": nodes}
 
 
 class Reconciler:
@@ -216,20 +220,21 @@ class Reconciler:
         self.stats = {"reconciles": 0, "launched": 0, "terminated": 0}
 
     # -- helpers ----------------------------------------------------------
-    def _pick_node_type(self, unmet: Dict[str, float]) -> Optional[str]:
-        """Smallest slice type covering the unmet demand (TPU demand can
-        only be satisfied in whole slices)."""
+    def _pick_node_type(self, unmet: Dict[str, float],
+                        max_chunk: Dict[str, float]) -> Optional[str]:
+        """Smallest slice type that could host the LARGEST single
+        task/bundle demand for each unmet resource (TPU comes in whole
+        slices; a type smaller than the biggest bundle would launch
+        nodes the bundle can never fit on)."""
         best = None
         for node_type, shape in self.provider.node_types.items():
-            if all(shape.get(k, 0.0) >= min(v, shape.get(k, 0.0) or 0)
-                   and (k not in unmet or shape.get(k, 0.0) > 0)
-                   for k, v in unmet.items()):
-                covers = all(shape.get(k, 0.0) > 0 for k in unmet)
-                if not covers:
-                    continue
-                size = sum(shape.values())
-                if best is None or size < best[0]:
-                    best = (size, node_type)
+            if not all(shape.get(k, 0.0) >= max(max_chunk.get(k, 0.0),
+                                                1e-9)
+                       for k in unmet):
+                continue
+            size = sum(shape.values())
+            if best is None or size < best[0]:
+                best = (size, node_type)
         return best[1] if best else None
 
     # -- the pass ---------------------------------------------------------
@@ -278,7 +283,8 @@ class Reconciler:
                                  InstanceStatus.ALLOCATED)
         if unmet and not pending_supply \
                 and len(im.active()) < self.max_instances:
-            node_type = self._pick_node_type(unmet)
+            node_type = self._pick_node_type(
+                unmet, state.get("max_chunk_demand", {}))
             if node_type is not None:
                 shape = self.provider.node_types[node_type]
                 count = max(math.ceil(v / shape[k])

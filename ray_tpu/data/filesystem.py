@@ -103,17 +103,20 @@ def resolve_filesystem(path: str) -> "tuple[FileSystem, str]":
 
 
 def expand_paths(paths: Paths, suffix: str = "") -> List[str]:
-    """Expand files/dirs/globs into a sorted file list (scheme-aware)."""
+    """Expand files/dirs/globs into a sorted file list (scheme-aware).
+    Results KEEP their URI scheme so downstream readers resolve to the
+    same filesystem."""
     if isinstance(paths, str):
         paths = [paths]
     out: List[str] = []
     for p in paths:
+        scheme = p.split("://", 1)[0] + "://" if "://" in p else ""
         fs, local = resolve_filesystem(p)
         if fs.exists(local) and fs.isdir(local):
-            out.extend(f for f in fs.listdir(local)
+            out.extend(scheme + f for f in fs.listdir(local)
                        if not suffix or f.endswith(suffix))
         elif "*" in local:
-            out.extend(fs.glob(local))
+            out.extend(scheme + f for f in fs.glob(local))
         else:
-            out.append(local)
+            out.append(p)
     return out

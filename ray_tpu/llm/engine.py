@@ -102,19 +102,26 @@ class ContinuousBatchingEngine:
             params, tokens[:, None], cache, offsets)
         return logits[:, 0], cache
 
-    def _prefill_impl(self, params, tokens, length):
-        """tokens [1, Tb]; returns last-valid-token logits + tiny cache."""
-        small = self.model.init_kv_cache(1, self.max_seq)
+    def _prefill_impl(self, params, tokens, lengths):
+        """BATCHED prefill: tokens [N, Tb], lengths [N]; returns each
+        request's last-valid-token logits [N, V] + a BUCKET-SIZED cache
+        [L, N, Tb, Hkv, D] (never max_seq — admission writes only the
+        bucket rows)."""
+        N, Tb = tokens.shape
+        small = self.model.init_kv_cache(N, Tb)
         logits, small = self.model.forward_step(
-            params, tokens, small, jnp.zeros((1,), jnp.int32))
-        last = logits[0, length - 1]
+            params, tokens, small, jnp.zeros((N,), jnp.int32))
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
         return last, small
 
-    def _insert_impl(self, cache, small, slot):
-        k = jax.lax.dynamic_update_slice(
-            cache["k"], small["k"], (0, slot, 0, 0, 0))
-        v = jax.lax.dynamic_update_slice(
-            cache["v"], small["v"], (0, slot, 0, 0, 0))
+    def _insert_impl(self, cache, small, slots):
+        """Scatter a bucket-sized prefill cache [L, N, Tb, ...] into the
+        slot cache [L, max_slots, max_seq, ...] at ``slots`` [N] — a
+        per-slot dynamic update of Tb rows, NOT a rebuild of max_seq."""
+        Tb = small["k"].shape[2]
+        k = cache["k"].at[:, slots, :Tb].set(small["k"])
+        v = cache["v"].at[:, slots, :Tb].set(small["v"])
         return {"k": k, "v": v}
 
     def _sample_impl(self, logits, temps, top_ks, key):
@@ -161,13 +168,19 @@ class ContinuousBatchingEngine:
         return None
 
     def _admit(self) -> None:
-        for slot in range(self.max_slots):
-            if self.slots[slot] is not None:
-                continue
+        """Admit as many waiting requests as there are free slots. All
+        admissions sharing a bucket prefill in ONE batched forward (the
+        reference engine's batched prefill), then one batched scatter
+        into the slot cache and one batched sample."""
+        free = [i for i, r in enumerate(self.slots) if r is None]
+        if not free:
+            return
+        by_bucket: Dict[int, List] = {}
+        while free:
             try:
                 req = self.waiting.get_nowait()
             except queue.Empty:
-                return
+                break
             n = len(req.prompt)
             bucket = self._bucket_for(n)
             if bucket is None or n >= self.max_seq:
@@ -175,18 +188,47 @@ class ContinuousBatchingEngine:
                 req.done.set()
                 req.stream.put(None)
                 continue
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, :n] = req.prompt
+            by_bucket.setdefault(bucket, []).append((free.pop(0), req))
+        for bucket, group in by_bucket.items():
+            # pad the group to the next power of two so each bucket has
+            # O(log max_slots) jit specializations, not one per N (a
+            # fresh XLA compile on the admission hot path would stall
+            # every in-flight decode); padded slot ids point past
+            # max_slots, which jax scatter DROPS.
+            n_pad = 1
+            while n_pad < len(group):
+                n_pad *= 2
+            n_pad = min(n_pad, self.max_slots)
+            slots = np.full(n_pad, self.max_slots, np.int32)
+            lengths = np.ones(n_pad, np.int32)
+            toks = np.zeros((n_pad, bucket), np.int32)
+            for row, (slot, req) in enumerate(group):
+                slots[row] = slot
+                lengths[row] = len(req.prompt)
+                toks[row, :len(req.prompt)] = req.prompt
             last_logits, small = self._prefill(
-                self.params, jnp.asarray(toks), n)
-            self.cache = self._insert(self.cache, small, slot)
+                self.params, jnp.asarray(toks), jnp.asarray(lengths))
+            self.cache = self._insert(self.cache, small,
+                                      jnp.asarray(slots))
             self.stats["prefills"] += 1
-            # sample the first generated token right out of prefill
-            tok = self._sample_one(last_logits, req)
-            req.first_token_at = time.perf_counter()
-            self.slots[slot] = req
-            self.offsets[slot] = n
-            self._emit(slot, int(tok))
+            # sample every first generated token in one batch (padded
+            # rows sampled too, then discarded)
+            self._rng_key, sub = jax.random.split(self._rng_key)
+            temps_np = np.zeros(n_pad, np.float32)
+            top_ks_np = np.zeros(n_pad, np.int32)
+            for row, (_, req) in enumerate(group):
+                temps_np[row] = req.sampling.temperature
+                top_ks_np[row] = req.sampling.top_k
+            temps = jnp.asarray(temps_np)
+            top_ks = jnp.asarray(top_ks_np)
+            toks_out = np.asarray(
+                self._sample(last_logits, temps, top_ks, sub))
+            now = time.perf_counter()
+            for row, (slot, req) in enumerate(group):
+                req.first_token_at = now
+                self.slots[slot] = req
+                self.offsets[slot] = lengths[row]
+                self._emit(slot, int(toks_out[row]))
 
     def _sample_one(self, logits_1d, req: Request):
         self._rng_key, sub = jax.random.split(self._rng_key)
@@ -250,10 +292,11 @@ class ContinuousBatchingEngine:
             raise ValueError(f"prompt of {n} tokens exceeds buckets")
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :n] = prompt_tokens
-        last_logits, small = self._prefill(self.params, jnp.asarray(toks), n)
+        last_logits, small = self._prefill(
+            self.params, jnp.asarray(toks), jnp.asarray([n], np.int32))
         kv = {"k": np.asarray(small["k"]), "v": np.asarray(small["v"])}
         self.stats["prefills"] += 1
-        return kv, np.asarray(last_logits), n
+        return kv, np.asarray(last_logits[0]), n
 
     def submit_prefilled(self, prompt_tokens: List[int], kv: Dict,
                          last_logits, sampling: Optional[SamplingParams]
@@ -267,7 +310,8 @@ class ContinuousBatchingEngine:
                 return None
             slot = free[0]
             small = {"k": jnp.asarray(kv["k"]), "v": jnp.asarray(kv["v"])}
-            self.cache = self._insert(self.cache, small, slot)
+            self.cache = self._insert(self.cache, small,
+                                      jnp.asarray([slot], np.int32))
             tok = self._sample_one(jnp.asarray(last_logits), req)
             req.first_token_at = time.perf_counter()
             self.slots[slot] = req

@@ -50,12 +50,20 @@ class LlamaConfig:
     # "ulysses" = all-to-all head/seq swap (needs n_heads % sp == 0,
     # local full-sequence attention so any local kernel applies).
     attention_impl: str = "ring"
+    # KV-cache decode attention: "xla" masked fallback or the "pallas"
+    # ragged kernel (skips KV blocks past each slot's length —
+    # ops/decode_attention.py).
+    decode_attention: str = "xla"
 
     def __post_init__(self):
         if self.attention_impl not in ("ring", "ulysses"):
             raise ValueError(
                 f"attention_impl must be 'ring' or 'ulysses', "
                 f"got {self.attention_impl!r}")
+        if self.decode_attention not in ("xla", "pallas"):
+            raise ValueError(
+                f"decode_attention must be 'xla' or 'pallas', "
+                f"got {self.decode_attention!r}")
 
     @property
     def head_dim(self) -> int:
@@ -323,17 +331,25 @@ class LlamaModel:
             # scatter new k/v into the cache at each slot's write offsets
             k_cache = k_cache.at[batch_idx, q_pos].set(k_new)
             v_cache = v_cache.at[batch_idx, q_pos].set(v_new)
-            # attend over cache positions <= own position
-            from ray_tpu.ops.attention import NEG_INF, _repeat_kv
-            kk = _repeat_kv(k_cache, cfg.n_heads)
-            vv = _repeat_kv(v_cache, cfg.n_heads)
-            s = jnp.einsum("bthd,bshd->bhts", q, kk,
-                           preferred_element_type=jnp.float32)
-            s = s * (cfg.head_dim ** -0.5)
-            mask = (jnp.arange(S)[None, None, :] <= q_pos[:, :, None])
-            s = jnp.where(mask[:, None], s, NEG_INF)
-            p = jax.nn.softmax(s, axis=-1)
-            o = jnp.einsum("bhts,bshd->bthd", p.astype(dt), vv)
+            if T == 1 and cfg.decode_attention == "pallas":
+                # single-token decode: ragged kernel skips KV blocks past
+                # each slot's live length
+                from ray_tpu.ops.decode_attention import \
+                    ragged_decode_attention_pallas
+                o = ragged_decode_attention_pallas(
+                    q[:, 0], k_cache, v_cache, q_pos[:, 0] + 1)[:, None]
+            else:
+                # attend over cache positions <= own position
+                from ray_tpu.ops.attention import NEG_INF, _repeat_kv
+                kk = _repeat_kv(k_cache, cfg.n_heads)
+                vv = _repeat_kv(v_cache, cfg.n_heads)
+                s = jnp.einsum("bthd,bshd->bhts", q, kk,
+                               preferred_element_type=jnp.float32)
+                s = s * (cfg.head_dim ** -0.5)
+                mask = (jnp.arange(S)[None, None, :] <= q_pos[:, :, None])
+                s = jnp.where(mask[:, None], s, NEG_INF)
+                p = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("bhts,bshd->bthd", p.astype(dt), vv)
             o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
             x = x + o
             h = rms_norm(x, layer["mlp_norm"], eps=cfg.norm_eps)

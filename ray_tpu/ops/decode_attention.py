@@ -1,0 +1,156 @@
+"""Ragged decode attention: one query token vs a length-bounded KV cache.
+
+Reference capability: vLLM's paged-attention CUDA kernel (outside the
+reference tree) — decode must NOT pay for the full ``max_seq`` cache when
+a slot has only ``length`` tokens. TPU-native design:
+
+- ``ragged_decode_attention`` — dispatcher (XLA masked fallback or the
+  Pallas kernel).
+- ``ragged_decode_attention_pallas`` — flash-style online-softmax over
+  KV blocks, grid (batch, kv_block). Lengths ride SCALAR PREFETCH
+  (``pltpu.PrefetchScalarGridSpec``): the KV BlockSpec index map clamps
+  block indices past a slot's length to the last valid block, so skipped
+  iterations issue NO new DMA (same window re-used), and ``pl.when``
+  skips their compute — the per-step cost tracks ``ceil(length/BK)``
+  blocks, not ``max_seq``. Accumulation lives in f32 VMEM scratch across
+  the sequentially-iterated kv-block dimension.
+
+Shapes: q [B, H, D]; k/v [B, S, Hkv, D]; lengths [B]. GQA KV heads are
+repeated up front.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import NEG_INF, _repeat_kv
+
+
+def ragged_decode_attention_reference(q, k, v, lengths, *,
+                                      scale: Optional[float] = None):
+    """Masked XLA fallback: attends over all S with positions >= length
+    masked out. [B,H,D] x [B,S,Hkv,D] -> [B,H,D]."""
+    head_dim = q.shape[-1]
+    scale = scale if scale is not None else head_dim ** -0.5
+    k = _repeat_kv(k, q.shape[1])
+    v = _repeat_kv(v, q.shape[1])
+    s = jnp.einsum("bhd,bshd->bhs", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    mask = jnp.arange(k.shape[1])[None, :] < lengths[:, None]   # [B,S]
+    s = jnp.where(mask[:, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhs,bshd->bhd", p.astype(v.dtype), v)
+
+
+def _decode_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, block_k: int, scale: float,
+                   num_kb: int):
+    import jax.experimental.pallas as pl
+
+    b = pl.program_id(0)
+    kb = pl.program_id(1)
+    length = lens_ref[b]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    start = kb * block_k
+
+    @pl.when(start < length)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)               # [H, D]
+        k = k_ref[0].astype(jnp.float32)               # [BK, H, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.einsum("hd,khd->hk", q, k) * scale     # [H, BK]
+        idx = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(idx < length, s, NEG_INF)
+        m_prev = m_ref[:, :1]                          # [H, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                         # [H, BK]
+        l_new = alpha * l_prev + jnp.sum(p, -1, keepdims=True)
+        acc_ref[...] = (acc_ref[...] * alpha
+                        + jnp.einsum("hk,khd->hd", p, v))
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kb == num_kb - 1)
+    def _finish():
+        denom = l_ref[:, :1]
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_k", "scale", "interpret"))
+def ragged_decode_attention_pallas(q, k, v, lengths, *,
+                                   block_k: int = 128,
+                                   scale: Optional[float] = None,
+                                   interpret: bool = False):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    head_dim = q.shape[-1]
+    scale = scale if scale is not None else head_dim ** -0.5
+    k = _repeat_kv(k, q.shape[1])
+    v = _repeat_kv(v, q.shape[1])
+    B, H, D = q.shape
+    S = k.shape[1]
+    bk = min(block_k, S)
+    num_kb = pl.cdiv(S, bk)
+    if S % bk != 0:
+        pad = num_kb * bk - S
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    lengths = lengths.astype(jnp.int32)
+
+    def kv_map(b, kb, lens):
+        # past-length blocks CLAMP to the last valid block: the window
+        # doesn't move, so the skipped iteration costs no new DMA
+        last_valid = jnp.maximum(
+            (lens[b] + bk - 1) // bk - 1, 0)
+        return (b, jnp.minimum(kb, last_valid), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, num_kb),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda b, kb, lens: (b, 0, 0)),
+            pl.BlockSpec((1, bk, H, D), kv_map),
+            pl.BlockSpec((1, bk, H, D), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, kb, lens: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, block_k=bk, scale=scale,
+                          num_kb=num_kb),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=interpret,
+    )(lengths, q, k, v)
+    return out
+
+
+def ragged_decode_attention(q, k, v, lengths, *, impl: str = "xla",
+                            scale: Optional[float] = None,
+                            interpret: bool = False):
+    if impl == "pallas":
+        return ragged_decode_attention_pallas(q, k, v, lengths,
+                                              scale=scale,
+                                              interpret=interpret)
+    return ragged_decode_attention_reference(q, k, v, lengths,
+                                             scale=scale)

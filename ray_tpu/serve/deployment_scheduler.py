@@ -107,9 +107,22 @@ class DeploymentScheduler:
         count, node_hex = min(candidates)
         if self._is_blocked(node_hex):
             return None
-        # absorbable: other nodes exist and host replicas already
         others = [n for n in nodes if n.node_id.hex() != node_hex]
-        return node_hex if others else None
+        if not others:
+            return None
+        # Availability gate: moving the victim's replicas must not shrink
+        # any deployment's node-span below min(2, current span) — SPREAD
+        # placement exists for fault tolerance; compaction must not
+        # quietly collapse a 2-node deployment onto one node.
+        with self._lock:
+            for deployment, placed in self._placements.items():
+                spans = set(placed.values())
+                if node_hex not in spans:
+                    continue
+                span_after = len(spans - {node_hex})
+                if span_after < min(2, len(spans)):
+                    return None
+        return node_hex
 
     def replicas_on(self, node_hex: str) -> List:
         with self._lock:

@@ -50,8 +50,10 @@ class _HandleState:
         self.long_poll = None
 
     def ensure_long_poll(self) -> None:
-        if self.long_poll is not None:
-            return
+        with self.lock:
+            if self.long_poll is not None:
+                return
+            self.long_poll = True  # claim under the lock; replaced below
         import weakref
 
         from ray_tpu.serve.long_poll import LongPollClient

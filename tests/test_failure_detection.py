@@ -135,3 +135,35 @@ def test_serve_controller_crash_recovery(ray_start_regular):
     # state preserved => the SAME replica was adopted, not restarted
     assert result == 2
     serve.shutdown()
+
+
+def test_serve_grpc_proxy(ray_start_regular):
+    """gRPC ingress plane (reference: serve/_private/proxy.py gRPCProxy):
+    a real grpc.Server routing to deployment handles."""
+    from ray_tpu import serve
+    from ray_tpu.serve.grpc_proxy import (GrpcServeClient,
+                                          start_grpc_proxy,
+                                          stop_grpc_proxy)
+
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+        def shout(self, s):
+            return s.upper()
+
+    serve.run(Doubler.bind())
+    port = start_grpc_proxy()
+    client = GrpcServeClient(f"127.0.0.1:{port}")
+    try:
+        assert client.healthz()
+        assert client.predict(21) == 42
+        assert client.predict("abc", method="shout") == "ABC"
+        assert "default" in client.list_applications()
+        with pytest.raises(RuntimeError):
+            client.predict(1, application="missing")
+    finally:
+        client.close()
+        stop_grpc_proxy()
+        serve.shutdown()

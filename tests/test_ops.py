@@ -267,3 +267,41 @@ def test_moe_alltoall_matches_einsum_dispatch():
                                rtol=5e-3, atol=5e-4)
     np.testing.assert_allclose(float(aux_a), float(aux_b),
                                rtol=5e-2, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# Ragged decode attention (paged-attention role for KV-cache serving)
+# ---------------------------------------------------------------------------
+
+def test_ragged_decode_attention_matches_reference():
+    from ray_tpu.ops.decode_attention import (
+        ragged_decode_attention_pallas, ragged_decode_attention_reference)
+
+    rng = np.random.default_rng(7)
+    B, S, H, Hkv, D = 4, 256, 8, 2, 32
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, Hkv, D)), jnp.float32)
+    lengths = jnp.asarray([1, 100, 200, 256], jnp.int32)
+    ref = ragged_decode_attention_reference(q, k, v, lengths)
+    out = ragged_decode_attention_pallas(q, k, v, lengths, block_k=64,
+                                         interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ragged_decode_attention_unpadded_lengths():
+    from ray_tpu.ops.decode_attention import (
+        ragged_decode_attention_pallas, ragged_decode_attention_reference)
+
+    rng = np.random.default_rng(8)
+    B, S, H, D = 2, 96, 4, 16   # S not a multiple of block_k
+    q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    lengths = jnp.asarray([37, 96], jnp.int32)
+    ref = ragged_decode_attention_reference(q, k, v, lengths)
+    out = ragged_decode_attention_pallas(q, k, v, lengths, block_k=64,
+                                         interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
