@@ -1318,8 +1318,7 @@ class ProcessRouter:
             client.actor_id = None
             release_worker(client)  # init failed cleanly; process reusable
             raise value
-        import time as _time
-        client.actor_since = _time.time()
+        client.actor_since = time.time()
         with self._lock:
             self._actor_workers[spec.actor_id] = client
         actor_id = spec.actor_id

@@ -177,14 +177,24 @@ def gcs_autoscaler_state(runtime) -> Dict[str, Any]:
     GcsAutoscalerStateManager): pending demand + per-node shape, derived
     from GCS-visible state rather than runtime internals."""
     demand: Dict[str, float] = {}
-    max_chunk: Dict[str, float] = {}   # largest single task/bundle ask
+    max_chunk: Dict[str, float] = {}   # largest SINGLE task/bundle ask
     for node in runtime.nodes():
         with node._pending_lock:
             for k, v in node._pending_demand.items():
                 if k.startswith("_pg_"):
                     k = k.split("_", 4)[-1]
                 demand[k] = demand.get(k, 0.0) + v
-                max_chunk[k] = max(max_chunk.get(k, 0.0), v)
+    # per-task chunk sizes come from the queued specs, NOT the per-node
+    # aggregate (10 one-chip tasks must not demand a 10-chip slice)
+    with runtime._tasks_lock:
+        queued = [t.spec for t in runtime._tasks.values()
+                  if t.state in ("PENDING_ARGS_AVAIL",
+                                 "PENDING_NODE_ASSIGNMENT")]
+    for spec in queued:
+        for k, v in (spec.resources or {}).items():
+            if k.startswith("_pg_"):
+                k = k.split("_", 4)[-1]
+            max_chunk[k] = max(max_chunk.get(k, 0.0), v)
     for pg in list(getattr(runtime.pg_manager, "_pending", [])):
         for bundle in pg.bundles:
             for k, v in bundle.resources.items():

@@ -46,8 +46,28 @@ class _DashboardHandler(BaseHTTPRequestHandler):
         from ray_tpu.util.metrics import prometheus_text
 
         path = self.path.split("?")[0].rstrip("/")
+        query = {}
+        if "?" in self.path:
+            from urllib.parse import parse_qsl
+            query = dict(parse_qsl(self.path.split("?", 1)[1]))
         try:
-            if path == "/metrics":
+            if path in ("", "/"):
+                from ray_tpu.dashboard.ui import INDEX_HTML
+                body = INDEX_HTML.encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/api/profile/cpu":
+                from ray_tpu.util.profiling import sample_cpu_profile
+                self._json(sample_cpu_profile(
+                    duration_s=min(float(query.get("duration", 5)), 30)))
+            elif path == "/api/profile/memory":
+                from ray_tpu.util.profiling import memory_snapshot
+                self._json(memory_snapshot())
+            elif path == "/metrics":
                 self._text(prometheus_text())
             elif path == "/api/nodes":
                 self._json(state_api.list_nodes())
@@ -70,11 +90,13 @@ class _DashboardHandler(BaseHTTPRequestHandler):
                     "stats": dict(rt.stats),
                     "task_summary": state_api.summarize_tasks(),
                 })
-            elif path in ("", "/", "/api"):
+            elif path == "/api":
                 self._json({"endpoints": [
                     "/api/nodes", "/api/tasks", "/api/actors",
                     "/api/placement_groups", "/api/objects",
-                    "/api/cluster_status", "/api/timeline", "/metrics"]})
+                    "/api/cluster_status", "/api/timeline",
+                    "/api/profile/cpu", "/api/profile/memory",
+                    "/metrics", "/"]})
             else:
                 self._json({"error": f"unknown path {path}"}, 404)
         except Exception as e:

@@ -1,0 +1,146 @@
+"""Dashboard web UI: one self-contained HTML page over the REST API.
+
+Reference: ``dashboard/client/src`` is a 196-file React app; this build
+serves the same operational views (cluster overview, nodes, tasks,
+actors, placement groups, live profiling) as a single vanilla-JS page —
+no build chain, served straight from the head process.
+"""
+
+INDEX_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  :root { color-scheme: dark; }
+  body { font: 13px/1.5 -apple-system, 'Segoe UI', Roboto, sans-serif;
+         margin: 0; background: #111417; color: #e6e6e6; }
+  header { padding: 10px 20px; background: #1a2026;
+           border-bottom: 1px solid #2c343c; display: flex;
+           align-items: baseline; gap: 16px; }
+  header h1 { font-size: 16px; margin: 0; color: #7dd3fc; }
+  nav button { background: none; border: none; color: #9ca3af;
+               padding: 6px 10px; cursor: pointer; font-size: 13px; }
+  nav button.active { color: #7dd3fc;
+                      border-bottom: 2px solid #7dd3fc; }
+  main { padding: 16px 20px; }
+  table { border-collapse: collapse; width: 100%; margin-top: 8px; }
+  th, td { text-align: left; padding: 4px 10px;
+           border-bottom: 1px solid #232a31; font-size: 12px; }
+  th { color: #9ca3af; font-weight: 500; }
+  .cards { display: flex; gap: 14px; flex-wrap: wrap; }
+  .card { background: #1a2026; border: 1px solid #2c343c;
+          border-radius: 8px; padding: 12px 16px; min-width: 160px; }
+  .card .v { font-size: 20px; color: #7dd3fc; }
+  .card .k { color: #9ca3af; font-size: 11px;
+             text-transform: uppercase; letter-spacing: .05em; }
+  pre { background: #0c0f12; padding: 10px; border-radius: 6px;
+        overflow: auto; max-height: 480px; font-size: 11px; }
+  .ok { color: #4ade80; } .bad { color: #f87171; }
+  button.act { background: #1f2937; color: #e6e6e6;
+               border: 1px solid #374151; border-radius: 6px;
+               padding: 5px 12px; cursor: pointer; }
+</style>
+</head>
+<body>
+<header>
+  <h1>ray_tpu</h1>
+  <nav id="nav"></nav>
+  <span id="ts" style="margin-left:auto;color:#6b7280"></span>
+</header>
+<main id="main">loading…</main>
+<script>
+const TABS = ["overview","nodes","tasks","actors","placement groups",
+              "profiling"];
+let tab = "overview";
+const $ = (h) => { const d = document.createElement("div");
+                   d.innerHTML = h; return d; };
+const fmt = (o) => JSON.stringify(o);
+
+function nav() {
+  const n = document.getElementById("nav"); n.innerHTML = "";
+  for (const t of TABS) {
+    const b = document.createElement("button");
+    b.textContent = t; if (t === tab) b.className = "active";
+    b.onclick = () => { tab = t; render(); };
+    n.appendChild(b);
+  }
+}
+
+async function j(path) { return (await fetch(path)).json(); }
+
+function table(rows, cols) {
+  if (!rows || !rows.length) return "<p style='color:#6b7280'>none</p>";
+  let h = "<table><tr>" + cols.map(c=>`<th>${c}</th>`).join("") + "</tr>";
+  for (const r of rows)
+    h += "<tr>" + cols.map(c=>`<td>${
+      typeof r[c]==="object" ? fmt(r[c]) : (r[c] ?? "")}</td>`).join("")
+      + "</tr>";
+  return h + "</table>";
+}
+
+async function render() {
+  nav();
+  const m = document.getElementById("main");
+  document.getElementById("ts").textContent =
+      new Date().toLocaleTimeString();
+  try {
+    if (tab === "overview") {
+      const s = await j("/api/cluster_status");
+      const card = (k,v) =>
+        `<div class="card"><div class="v">${v}</div>` +
+        `<div class="k">${k}</div></div>`;
+      m.innerHTML = "<div class='cards'>"
+        + card("cluster CPUs", s.cluster_resources.CPU ?? 0)
+        + card("available CPUs", s.available_resources.CPU ?? 0)
+        + card("cluster TPUs", s.cluster_resources.TPU ?? 0)
+        + card("tasks finished", s.stats.tasks_finished)
+        + card("tasks retried", s.stats.tasks_retried)
+        + card("actor restarts", s.stats.actor_restarts)
+        + "</div><h3>task summary</h3><pre>"
+        + JSON.stringify(s.task_summary, null, 2) + "</pre>";
+    } else if (tab === "nodes") {
+      m.innerHTML = table(await j("/api/nodes"),
+        ["node_id","alive","resources","available"]);
+    } else if (tab === "tasks") {
+      const t = await j("/api/tasks");
+      m.innerHTML = table(t.slice(-200).reverse(),
+        ["task_id","name","state","node_id"]);
+    } else if (tab === "actors") {
+      m.innerHTML = table(await j("/api/actors"),
+        ["actor_id","class_name","state","name","num_restarts"]);
+    } else if (tab === "placement groups") {
+      m.innerHTML = table(await j("/api/placement_groups"),
+        ["placement_group_id","name","strategy","state","bundles"]);
+    } else if (tab === "profiling") {
+      m.innerHTML = `
+        <button class="act" id="cpu">sample CPU (3s)</button>
+        <button class="act" id="mem">memory snapshot</button>
+        <pre id="out">pick one…</pre>`;
+      document.getElementById("cpu").onclick = async () => {
+        document.getElementById("out").textContent = "sampling 3s…";
+        const p = await j("/api/profile/cpu?duration=3");
+        document.getElementById("out").textContent =
+          `samples: ${p.samples}\\n\\nTOP FRAMES\\n` +
+          p.top.map(t=>`${String(t.pct).padStart(5)}%  ${t.frame}`)
+               .join("\\n") +
+          "\\n\\nCOLLAPSED STACKS (flamegraph format)\\n" +
+          p.collapsed.slice(0, 80).join("\\n");
+      };
+      document.getElementById("mem").onclick = async () => {
+        const p = await j("/api/profile/memory");
+        document.getElementById("out").textContent =
+          JSON.stringify(p, null, 2);
+      };
+      return; // no auto-refresh while profiling
+    }
+  } catch (e) {
+    m.innerHTML = `<p class="bad">dashboard error: ${e}</p>`;
+  }
+}
+render();
+setInterval(() => { if (tab !== "profiling") render(); }, 3000);
+</script>
+</body>
+</html>
+"""

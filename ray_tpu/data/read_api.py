@@ -135,9 +135,13 @@ def read_images(paths, *, size=None, mode: str = "RGB") -> Dataset:
             {"image": _np.ascontiguousarray(arr[None, ...]),
              "path": _np.asarray([f])})
 
+    def _is_file(f):
+        from ray_tpu.data.filesystem import resolve_filesystem
+        fs, local = resolve_filesystem(f)
+        return fs.exists(local) and not fs.isdir(local)
+
     files = [f for f in _expand_paths(paths, "")
-             if f.lower().endswith(_IMAGE_SUFFIXES)
-             and os.path.isfile(f)]
+             if f.lower().endswith(_IMAGE_SUFFIXES) and _is_file(f)]
     tasks = [lambda f=f: reader(f) for f in files]
     return Dataset(L.Read("read_images", [], read_tasks=tasks))
 

@@ -27,6 +27,10 @@ class AlgorithmConfig:
     train_iterations_per_call: int = 1
     learner_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
     seed: int = 0
+    # connector FACTORIES (each runner needs its own stateful pipeline;
+    # reference: rllib/connectors/)
+    env_to_module_connector: Any = None
+    module_to_env_connector: Any = None
 
     # fluent API (reference AlgorithmConfig.environment/.env_runners/...)
     def environment(self, env) -> "AlgorithmConfig":
@@ -45,6 +49,13 @@ class AlgorithmConfig:
         self.learner_kwargs.update(kwargs)
         return self
 
+    def connectors(self, env_to_module=None, module_to_env=None
+                   ) -> "AlgorithmConfig":
+        """Factories returning a Connector/ConnectorPipeline per runner."""
+        self.env_to_module_connector = env_to_module
+        self.module_to_env_connector = module_to_env
+        return self
+
     def build(self) -> "Algorithm":
         return Algorithm(self)
 
@@ -55,6 +66,12 @@ class Algorithm:
         probe = make_env(config.env, seed=0)
         obs_dim = probe.obs_dim
         n_actions = probe.n_actions
+
+        if config.env_to_module_connector is not None:
+            # the policy sees CONNECTED observations; size it accordingly
+            probe_pipeline = config.env_to_module_connector()
+            obs_dim = int(np.asarray(
+                probe_pipeline(probe.reset(seed=0)[0])).shape[-1])
 
         if config.algo.upper() == "PPO":
             from ray_tpu.rl.ppo import ActorCriticPolicy, PPOLearner
@@ -81,9 +98,17 @@ class Algorithm:
         if isinstance(env_spec, str) and env_spec in ENV_REGISTRY:
             env_spec = ENV_REGISTRY[env_spec]
         runner_cls = ray_tpu.remote(EnvRunner)
+        def _runner_kwargs(i):
+            kw = {"seed": config.seed + 1 + i}
+            if config.env_to_module_connector is not None:
+                kw["env_to_module"] = config.env_to_module_connector()
+            if config.module_to_env_connector is not None:
+                kw["module_to_env"] = config.module_to_env_connector()
+            return kw
+
         self.runners = [
             runner_cls.remote(env_spec, policy_factory,
-                              seed=config.seed + 1 + i)
+                              **_runner_kwargs(i))
             for i in range(config.num_env_runners)]
         self._sync_weights()
         self.iteration = 0

@@ -82,13 +82,26 @@ def make_env(name_or_creator, seed: int = 0):
 class EnvRunner:
     """Actor: collects rollouts with the current policy weights."""
 
-    def __init__(self, env_spec, policy_factory, seed: int = 0):
+    def __init__(self, env_spec, policy_factory, seed: int = 0,
+                 env_to_module=None, module_to_env=None):
+        """``env_to_module``/``module_to_env``: optional connector
+        pipelines (reference: rllib/connectors/) — observations pass
+        through env_to_module before the policy; actions through
+        module_to_env before the env."""
         self.env = make_env(env_spec, seed=seed)
         self.policy = policy_factory()
         self.seed = seed
+        self.env_to_module = env_to_module
+        self.module_to_env = module_to_env
         self._obs, _ = self.env.reset(seed=seed)
         self._episode_return = 0.0
         self.completed_returns: List[float] = []
+
+    def _pre(self, obs):
+        return self.env_to_module(obs) if self.env_to_module else obs
+
+    def _post(self, action):
+        return self.module_to_env(action) if self.module_to_env else action
 
     def set_weights(self, weights) -> None:
         self.policy.set_weights(weights)
@@ -97,9 +110,11 @@ class EnvRunner:
         """Collect num_steps transitions (episodes auto-reset)."""
         obs_buf, act_buf, rew_buf, done_buf, logp_buf = [], [], [], [], []
         for _ in range(num_steps):
-            action, logp = self.policy.act(self._obs)
-            nobs, rew, term, trunc, _ = self.env.step(action)
-            obs_buf.append(self._obs)
+            module_obs = self._pre(self._obs)
+            action, logp = self.policy.act(module_obs)
+            nobs, rew, term, trunc, _ = self.env.step(
+                self._post(action))
+            obs_buf.append(module_obs)
             act_buf.append(action)
             rew_buf.append(rew)
             done_buf.append(term or trunc)
@@ -109,9 +124,11 @@ class EnvRunner:
                 self.completed_returns.append(self._episode_return)
                 self._episode_return = 0.0
                 self._obs, _ = self.env.reset()
+                if self.env_to_module is not None:
+                    self.env_to_module.reset()
             else:
                 self._obs = nobs
-        obs_buf.append(self._obs)   # bootstrap observation
+        obs_buf.append(self._pre(self._obs))   # bootstrap observation
         return {
             "obs": np.asarray(obs_buf[:-1], np.float32),
             "next_obs_last": np.asarray(obs_buf[-1], np.float32),

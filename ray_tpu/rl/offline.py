@@ -1,0 +1,206 @@
+"""Offline RL: experience datasets, behavior cloning, offline DQN (CQL).
+
+Reference: ``rllib/offline/`` — sample writers/readers over datasets and
+offline training without an environment. TPU-first shape: experiences
+live in ``ray_tpu.data`` datasets (arrow blocks, streaming shards), the
+learners are jitted jax programs batched over the MXU:
+
+- :func:`write_experiences` / :func:`read_experiences` — dataset IO for
+  EnvRunner sample batches (the JsonWriter/JsonReader role, on parquet).
+- :class:`BCLearner` — behavior cloning (cross-entropy on logged
+  actions).
+- :class:`OfflineDQNLearner` — double-DQN TD learning on logged
+  transitions plus a CQL conservative penalty (logsumexp Q minus logged
+  Q) so values of out-of-distribution actions stay bounded.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# dataset IO
+# ---------------------------------------------------------------------------
+
+def write_experiences(batches: List[Dict[str, np.ndarray]],
+                      path: str) -> int:
+    """Persist EnvRunner sample batches as parquet; returns row count.
+    Transitions are flattened to (obs, action, reward, done, next_obs)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ray_tpu.data.block import block_from_batch
+
+    rows = 0
+    tables = []
+    for batch in batches:
+        obs = np.asarray(batch["obs"], np.float32)
+        nxt = np.concatenate(
+            [obs[1:], np.asarray(batch["next_obs_last"],
+                                 np.float32)[None]], axis=0)
+        tables.append(block_from_batch({
+            "obs": obs,
+            "next_obs": nxt,
+            "actions": np.asarray(batch["actions"], np.int64),
+            "rewards": np.asarray(batch["rewards"], np.float32),
+            "dones": np.asarray(batch["dones"], np.bool_),
+        }))
+        rows += len(batch["rewards"])
+    pq.write_table(pa.concat_tables(tables), path)
+    return rows
+
+
+def read_experiences(paths) -> "Any":
+    """Experience dataset (ray_tpu.data.Dataset over parquet shards)."""
+    from ray_tpu.data import read_parquet
+
+    return read_parquet(paths)
+
+
+def iter_transition_batches(ds, batch_size: int = 256,
+                            epochs: int = 1) -> Iterator[Dict]:
+    for _ in range(epochs):
+        for batch in ds.iter_batches(batch_size=batch_size):
+            yield batch
+
+
+# ---------------------------------------------------------------------------
+# behavior cloning
+# ---------------------------------------------------------------------------
+
+def _mlp_init(rng, sizes):
+    params = []
+    for i, (m, n) in enumerate(zip(sizes[:-1], sizes[1:])):
+        k = jax.random.fold_in(rng, i)
+        params.append({
+            "w": jax.random.normal(k, (m, n), jnp.float32) * (m ** -0.5),
+            "b": jnp.zeros((n,), jnp.float32)})
+    return params
+
+
+def _mlp_apply(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.tanh(x)
+    return x
+
+
+class BCLearner:
+    """Behavior cloning: cross-entropy on the logged actions."""
+
+    def __init__(self, obs_dim: int, n_actions: int, *,
+                 hidden: int = 64, lr: float = 1e-3, seed: int = 0):
+        self.n_actions = n_actions
+        self.params = _mlp_init(jax.random.key(seed),
+                                (obs_dim, hidden, hidden, n_actions))
+        self.lr = lr
+        self._step = jax.jit(self._step_impl)
+
+    def _step_impl(self, params, obs, actions):
+        def loss_fn(p):
+            logits = _mlp_apply(p, obs)
+            logp = jax.nn.log_softmax(logits)
+            nll = -jnp.take_along_axis(
+                logp, actions[:, None], axis=-1).mean()
+            return nll
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params = jax.tree.map(lambda p, g: p - self.lr * g, params, grads)
+        return params, loss
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        obs = jnp.asarray(batch["obs"], jnp.float32)
+        actions = jnp.asarray(batch["actions"], jnp.int32)
+        self.params, loss = self._step(self.params, obs, actions)
+        return {"bc_loss": float(loss)}
+
+    def act(self, obs) -> int:
+        logits = _mlp_apply(self.params,
+                            jnp.asarray(obs, jnp.float32)[None])
+        return int(jnp.argmax(logits, axis=-1)[0])
+
+    def evaluate_accuracy(self, batch: Dict[str, np.ndarray]) -> float:
+        logits = _mlp_apply(self.params,
+                            jnp.asarray(batch["obs"], jnp.float32))
+        pred = jnp.argmax(logits, axis=-1)
+        return float((pred == jnp.asarray(batch["actions"])).mean())
+
+
+# ---------------------------------------------------------------------------
+# offline (conservative) DQN
+# ---------------------------------------------------------------------------
+
+class OfflineDQNLearner:
+    """Double-DQN TD on logged transitions + CQL penalty."""
+
+    def __init__(self, obs_dim: int, n_actions: int, *,
+                 hidden: int = 64, lr: float = 1e-3, gamma: float = 0.99,
+                 cql_alpha: float = 1.0, target_update_every: int = 100,
+                 seed: int = 0):
+        self.params = _mlp_init(jax.random.key(seed),
+                                (obs_dim, hidden, hidden, n_actions))
+        self.target = jax.tree.map(lambda x: x, self.params)
+        self.lr = lr
+        self.gamma = gamma
+        self.cql_alpha = cql_alpha
+        self.target_update_every = target_update_every
+        self._updates = 0
+        self._step = jax.jit(self._step_impl)
+
+    def _step_impl(self, params, target, obs, actions, rewards, dones,
+                   next_obs):
+        def loss_fn(p):
+            q = _mlp_apply(p, obs)                        # [B, A]
+            q_logged = jnp.take_along_axis(
+                q, actions[:, None], axis=-1)[:, 0]
+            # double DQN target: online argmax, target value
+            next_q_online = _mlp_apply(p, next_obs)
+            next_a = jnp.argmax(next_q_online, axis=-1)
+            next_q_target = jnp.take_along_axis(
+                _mlp_apply(target, next_obs), next_a[:, None],
+                axis=-1)[:, 0]
+            td_target = rewards + self.gamma * next_q_target * (
+                1.0 - dones)
+            td = jnp.mean((q_logged
+                           - jax.lax.stop_gradient(td_target)) ** 2)
+            # CQL: push down out-of-distribution action values
+            cql = jnp.mean(jax.scipy.special.logsumexp(q, axis=-1)
+                           - q_logged)
+            return td + self.cql_alpha * cql, (td, cql)
+        (loss, (td, cql)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        params = jax.tree.map(lambda p, g: p - self.lr * g, params, grads)
+        return params, loss, td, cql
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        self.params, loss, td, cql = self._step(
+            self.params, self.target,
+            jnp.asarray(batch["obs"], jnp.float32),
+            jnp.asarray(batch["actions"], jnp.int32),
+            jnp.asarray(batch["rewards"], jnp.float32),
+            jnp.asarray(batch["dones"], jnp.float32),
+            jnp.asarray(batch["next_obs"], jnp.float32))
+        self._updates += 1
+        if self._updates % self.target_update_every == 0:
+            self.target = jax.tree.map(lambda x: x, self.params)
+        return {"loss": float(loss), "td_loss": float(td),
+                "cql_penalty": float(cql)}
+
+    def act(self, obs) -> int:
+        q = _mlp_apply(self.params, jnp.asarray(obs, jnp.float32)[None])
+        return int(jnp.argmax(q, axis=-1)[0])
+
+
+def train_offline(ds, learner, *, batch_size: int = 256,
+                  epochs: int = 1) -> Dict[str, float]:
+    """Drive a learner over an experience dataset; returns last metrics."""
+    metrics: Dict[str, float] = {}
+    for batch in iter_transition_batches(ds, batch_size, epochs):
+        metrics = learner.update(batch)
+    return metrics

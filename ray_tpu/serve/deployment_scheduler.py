@@ -120,7 +120,9 @@ class DeploymentScheduler:
                 if node_hex not in spans:
                     continue
                 span_after = len(spans - {node_hex})
-                if span_after < min(2, len(spans)):
+                # only a MULTI-node deployment loses availability by the
+                # move; a single-node deployment just relocates
+                if len(spans) >= 2 and span_after < 2:
                     return None
         return node_hex
 

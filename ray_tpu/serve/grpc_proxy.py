@@ -157,15 +157,20 @@ def start_grpc_proxy(port: int = 0) -> int:
             hlock = threading.Lock()
 
             def get_handle(app_name: Optional[str]):
-                # one handle (and thus ONE long-poll listener) per app,
-                # not per request
+                # one handle (and thus ONE long-poll listener) per app —
+                # invalidated when the app's ingress deployment changes
+                # (delete + redeploy must not route through a stale
+                # handle)
                 name = app_name or "default"
+                ingress = serve_api._apps.get(name)
+                if ingress is None:
+                    raise KeyError(name)
                 with hlock:
-                    h = handles.get(name)
-                    if h is None:
-                        h = serve_api.get_app_handle(name)
-                        handles[name] = h
-                    return h
+                    entry = handles.get(name)
+                    if entry is None or entry[0] != ingress:
+                        entry = (ingress, serve_api.get_app_handle(name))
+                        handles[name] = entry
+                    return entry[1]
 
             def list_apps():
                 return dict(serve_api._apps)

@@ -70,9 +70,14 @@ class _HandleState:
                 state.inflight = {i: 0
                                   for i in range(len(state.replicas))}
 
-        client = LongPollClient(
-            self.controller,
-            {f"replicas::{self.deployment_name}": on_update})
+        try:
+            client = LongPollClient(
+                self.controller,
+                {f"replicas::{self.deployment_name}": on_update})
+        except Exception:
+            with self.lock:
+                self.long_poll = None   # release the claim: retry later
+            raise
         self.long_poll = client
         # stop the listener thread when the handle family is collected
         weakref.finalize(self, LongPollClient.stop, client)

@@ -29,6 +29,9 @@ class TuneConfig:
     num_samples: int = 1
     max_concurrent_trials: Optional[int] = None
     scheduler: Optional[TrialScheduler] = None
+    # Searcher (tune/search.py: BasicVariantGenerator, HaltonSearcher,
+    # TPESearcher); None = grid/random variant generation.
+    search_alg: Optional[Any] = None
     seed: int = 0
 
 
@@ -145,16 +148,32 @@ class Tuner:
 
     def fit(self) -> ResultGrid:
         scheduler = self.cfg.scheduler or FIFOScheduler()
-        variants = generate_variants(self.param_space,
-                                     self.cfg.num_samples, self.cfg.seed)
-        trials = [Trial(v) for v in variants]
+        searcher = self.cfg.search_alg
+        if searcher is not None:
+            searcher.set_search_space(self.param_space)
+            trials: List[Trial] = []
+            to_create = self.cfg.num_samples
+        else:
+            variants = generate_variants(
+                self.param_space, self.cfg.num_samples, self.cfg.seed)
+            trials = [Trial(v) for v in variants]
+            to_create = 0
         limit = self.cfg.max_concurrent_trials or max(
             1, int(ray_tpu.cluster_resources().get("CPU", 4)))
         actor_cls = ray_tpu.remote(_TrialActor)
 
         pending = list(trials)
         running: List[Trial] = []
-        while pending or running:
+        while pending or running or to_create > 0:
+            # searcher-driven trials are created lazily as slots free, so
+            # adaptive searchers (TPE) see completed results first
+            while to_create > 0 and len(running) + len(pending) < limit:
+                trial_id = f"trial-{self.cfg.num_samples - to_create}"
+                trial = Trial(searcher.suggest(trial_id))
+                trial.search_id = trial_id
+                trials.append(trial)
+                pending.append(trial)
+                to_create -= 1
             while pending and len(running) < limit:
                 trial = pending.pop(0)
                 trial.actor = actor_cls.options(max_concurrency=2).remote()
@@ -183,6 +202,12 @@ class Tuner:
                 if trial.run_ref in done_set:
                     self._finalize(trial, scheduler)
                     running.remove(trial)
+                    if searcher is not None:
+                        value = trial.last_result.get(self.cfg.metric)
+                        if value is not None and self.cfg.mode == "max":
+                            value = -float(value)
+                        searcher.on_trial_complete(
+                            getattr(trial, "search_id", ""), value)
         return ResultGrid(trials=trials, metric=self.cfg.metric,
                           mode=self.cfg.mode)
 

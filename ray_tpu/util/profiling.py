@@ -1,0 +1,110 @@
+"""On-demand profiling: stack sampler (py-spy role) + tracemalloc.
+
+Reference: ``dashboard/modules/reporter/profile_manager.py:82`` shells
+out to py-spy (CPU flamegraph) and memray (heap). Neither tool is in
+this image, so both capabilities are in-process and stdlib-only:
+
+- :func:`sample_cpu_profile` — a sampling profiler over
+  ``sys._current_frames()``: every thread's stack is sampled on an
+  interval for a duration and aggregated into collapsed-stack lines
+  (the flamegraph.pl / speedscope input format), with per-thread totals.
+  Unlike cProfile it sees ALL threads and adds no per-call overhead.
+- :func:`memory_snapshot` — tracemalloc top allocations (started lazily
+  on first use), the memray-lite view.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import Counter
+from typing import Dict, List, Optional
+
+
+def _collapse(frame, thread_name: str) -> str:
+    stack: List[str] = []
+    f = frame
+    while f is not None:
+        code = f.f_code
+        stack.append(f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                     f"{code.co_name}")
+        f = f.f_back
+    stack.reverse()
+    return thread_name + ";" + ";".join(stack)
+
+
+def sample_cpu_profile(duration_s: float = 5.0,
+                       interval_s: float = 0.005,
+                       top: int = 60) -> Dict:
+    """Sample every thread's stack for ``duration_s``; returns
+    {"collapsed": [...], "top": [...], "samples": N}."""
+    counts: Counter = Counter()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    samples = 0
+    deadline = time.monotonic() + duration_s
+    me = threading.get_ident()
+    while time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue
+            counts[_collapse(frame,
+                             names.get(ident, f"thread-{ident}"))] += 1
+        samples += 1
+        names = {t.ident: t.name for t in threading.enumerate()}
+        time.sleep(interval_s)
+
+    collapsed = [f"{stack} {n}"
+                 for stack, n in counts.most_common()]
+    # leaf-frame hot spots; pct is of ALL thread-samples (a stack is
+    # recorded per thread per tick, so the denominator is the total
+    # number of recorded stacks, not ticks)
+    leaf: Counter = Counter()
+    for stack, n in counts.items():
+        leaf[stack.rsplit(";", 1)[-1]] += n
+    total = max(sum(counts.values()), 1)
+    return {
+        "samples": samples,
+        "thread_samples": total,
+        "collapsed": collapsed[:1000],
+        "top": [{"frame": fr, "samples": n,
+                 "pct": round(100.0 * n / total, 1)}
+                for fr, n in leaf.most_common(top)],
+    }
+
+
+_tracemalloc_started = False
+
+
+def memory_snapshot(top: int = 40,
+                    group_by: str = "lineno") -> Dict:
+    """tracemalloc top allocation sites (starts tracing on first call —
+    earlier allocations are invisible until then, like attaching
+    memray)."""
+    global _tracemalloc_started
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(16)
+        _tracemalloc_started = True
+        return {"started": True,
+                "note": "tracemalloc just started; call again after the "
+                        "workload runs to see allocations"}
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics(group_by)
+    total = sum(s.size for s in stats)
+    return {
+        "total_traced_bytes": total,
+        "top": [{
+            "site": str(s.traceback[0]) if s.traceback else "?",
+            "size_bytes": s.size,
+            "count": s.count,
+        } for s in stats[:top]],
+    }
+
+
+def stop_memory_tracing() -> None:
+    import tracemalloc
+
+    if tracemalloc.is_tracing():
+        tracemalloc.stop()

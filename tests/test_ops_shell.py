@@ -277,3 +277,48 @@ def test_autoscaler_v2_gke_provider_is_explicit_stub():
     provider = GkeTpuProvider(project="p", zone="z", cluster="c")
     with _pytest.raises(NotImplementedError, match="zero-egress|GKE|API"):
         provider.launch("v5e-4")
+
+
+def test_dashboard_web_ui_and_profiling(ray_start_regular):
+    """The dashboard serves an HTML UI at / and on-demand profiling
+    endpoints (py-spy/memray role, stdlib sampling — SURVEY §5.1)."""
+    import json
+    import threading
+    import time
+    import urllib.request
+
+    from ray_tpu.dashboard.server import start_dashboard, stop_dashboard
+
+    host, port = start_dashboard(port=0)
+    base = f"http://{host}:{port}"
+    try:
+        html = urllib.request.urlopen(f"{base}/").read().decode()
+        assert "<html>" in html and "ray_tpu" in html
+
+        # keep a thread busy so the sampler sees a stack
+        stop = threading.Event()
+
+        def burn():
+            while not stop.is_set():
+                sum(i * i for i in range(2000))
+
+        t = threading.Thread(target=burn, daemon=True, name="burner")
+        t.start()
+        prof = json.loads(urllib.request.urlopen(
+            f"{base}/api/profile/cpu?duration=0.5").read())
+        stop.set()
+        assert prof["samples"] > 10
+        assert any("burn" in row["frame"] or "burner" in stack
+                   for row in prof["top"]
+                   for stack in [""]) or any(
+                       "burner" in line for line in prof["collapsed"])
+
+        mem1 = json.loads(urllib.request.urlopen(
+            f"{base}/api/profile/memory").read())
+        blob = [bytearray(1024 * 1024) for _ in range(4)]
+        mem2 = json.loads(urllib.request.urlopen(
+            f"{base}/api/profile/memory").read())
+        assert mem2.get("total_traced_bytes", 0) > 0
+        del blob
+    finally:
+        stop_dashboard()

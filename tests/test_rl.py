@@ -87,3 +87,76 @@ def test_custom_env_registry(ray_start_regular):
         assert m["num_episodes"] > 0
     finally:
         algo.stop()
+
+
+# ---------------------------------------------------------------------------
+# Connectors + offline RL (reference: rllib/connectors/, rllib/offline/)
+# ---------------------------------------------------------------------------
+
+def test_connector_pipeline_shapes_and_state():
+    from ray_tpu.rl.connectors import (ConnectorPipeline, FrameStack,
+                                       MeanStdObservationNormalizer,
+                                       ObservationClipper)
+
+    pipe = ConnectorPipeline([MeanStdObservationNormalizer(),
+                              FrameStack(3), ObservationClipper()])
+    out1 = pipe(np.ones(4, np.float32))
+    assert out1.shape == (12,)              # 3x frame stack
+    assert pipe.output_multiplier == 3
+    pipe(np.ones(4) * 2)
+    pipe.reset()                            # episode boundary clears stack
+    out2 = pipe(np.zeros(4, np.float32))
+    assert out2.shape == (12,)
+    assert np.all(out2[:8] == 0)            # stack restarted with zeros
+
+
+def test_algorithm_with_connectors(ray_start_regular):
+    from ray_tpu.rl import AlgorithmConfig
+    from ray_tpu.rl.connectors import (ConnectorPipeline, FrameStack,
+                                       MeanStdObservationNormalizer)
+
+    algo = (AlgorithmConfig()
+            .environment("CartPole-v1")
+            .env_runners(1, rollout_fragment_length=64)
+            .connectors(env_to_module=lambda: ConnectorPipeline(
+                [MeanStdObservationNormalizer(), FrameStack(2)]))
+            ).build()
+    metrics = algo.train()
+    loss_keys = [k for k in metrics if "loss" in k]
+    assert loss_keys and all(np.isfinite(metrics[k]) for k in loss_keys)
+    algo.stop()
+
+
+def test_offline_bc_and_cql(ray_start_regular, tmp_path):
+    """Collect experiences online, write to a dataset, train BC and
+    offline (CQL) DQN from the dataset with no env in the loop."""
+    from ray_tpu.rl.env import CartPoleEnv, EnvRunner
+    from ray_tpu.rl.offline import (BCLearner, OfflineDQNLearner,
+                                    read_experiences, train_offline,
+                                    write_experiences)
+    from ray_tpu.rl.ppo import ActorCriticPolicy
+
+    runner = EnvRunner(CartPoleEnv,
+                       lambda: ActorCriticPolicy(4, 2, seed=0), seed=0)
+    batches = [runner.sample(128) for _ in range(2)]
+    path = str(tmp_path / "exp.parquet")
+    rows = write_experiences(batches, path)
+    assert rows == 256
+
+    ds = read_experiences(path)
+    assert ds.count() == 256
+
+    bc = BCLearner(4, 2, seed=0, lr=3e-3)
+    first = next(iter(ds.iter_batches(batch_size=256)))
+    before = bc.evaluate_accuracy(first)
+    metrics = train_offline(ds, bc, batch_size=64, epochs=10)
+    assert np.isfinite(metrics["bc_loss"])
+    after = bc.evaluate_accuracy(first)
+    # training fits the logged behavior better than the init did
+    assert after >= before and after > 0.45
+
+    cql = OfflineDQNLearner(4, 2, seed=0, cql_alpha=1.0)
+    metrics = train_offline(ds, cql, batch_size=64, epochs=2)
+    assert np.isfinite(metrics["loss"])
+    assert metrics["cql_penalty"] >= 0.0
+    assert cql.act(np.zeros(4, np.float32)) in (0, 1)

@@ -120,3 +120,44 @@ def test_tuner_over_jax_trainer(ray_start_regular, tmp_path):
     best = results.get_best_result()
     assert best.config["lr"] == 0.3
     assert best.metrics["loss"] == 0.0
+
+
+def test_tpe_searcher_converges(ray_start_regular):
+    """TPE beats pure exploration on a smooth 1-d objective: after the
+    startup phase, suggestions concentrate near the optimum."""
+    from ray_tpu import tune as rtune
+    from ray_tpu.tune.search import TPESearcher
+
+    def objective(config):
+        rtune.report({"loss": (config["x"] - 0.7) ** 2})
+
+    results = Tuner(
+        objective,
+        param_space={"x": rtune.uniform(0.0, 1.0)},
+        tune_config=TuneConfig(metric="loss", mode="min", num_samples=24,
+                               max_concurrent_trials=2,
+                               search_alg=TPESearcher(n_startup=6,
+                                                      seed=0))).fit()
+    best = results.get_best_result()
+    assert abs(best.config["x"] - 0.7) < 0.15
+    # later trials should cluster nearer the optimum than startup ones
+    xs = [t.config["x"] for t in results.trials]
+    startup_err = sum(abs(x - 0.7) for x in xs[:6]) / 6
+    later_err = sum(abs(x - 0.7) for x in xs[-6:]) / 6
+    assert later_err <= startup_err + 0.05
+
+
+def test_halton_searcher_covers_space(ray_start_regular):
+    from ray_tpu import tune as rtune
+    from ray_tpu.tune.search import HaltonSearcher
+
+    def objective(config):
+        rtune.report({"loss": config["x"]})
+
+    results = Tuner(
+        objective, param_space={"x": rtune.uniform(0.0, 1.0)},
+        tune_config=TuneConfig(num_samples=8, max_concurrent_trials=1,
+                               search_alg=HaltonSearcher())).fit()
+    # low-discrepancy: all four quartiles visited within 8 points
+    quartiles = {min(int(t.config["x"] * 4), 3) for t in results.trials}
+    assert {0, 1, 2, 3} <= quartiles
