@@ -1,0 +1,145 @@
+"""Multi-host coordination: jax.distributed + pod topology env plumbing.
+
+Reference capability: Ray Train's rendezvous role (``train/torch/config.py``
+sets up the process group; ``_private/accelerators/tpu.py`` reads pod
+topology env vars). TPU-native shape (SURVEY §5.8): within a slice, the
+collectives are XLA-over-ICI and need no runtime help; ACROSS hosts the
+only control-plane requirement is the jax coordination service —
+``jax.distributed.initialize(coordinator, num_processes, process_id)`` —
+after which every jitted program sees the global device set and pjit
+shardings span hosts (DCN axes included).
+
+This module resolves the rendezvous from (in priority order):
+1. explicit arguments,
+2. ray_tpu cluster metadata (head KV rendezvous — daemons elect host 0),
+3. TPU pod environment (``TPU_WORKER_HOSTNAMES`` / ``TPU_WORKER_ID``,
+   the GKE/TPU-VM contract),
+and is idempotent. Single-process calls are a no-op (the common CI path).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+_initialized = False
+
+COORDINATOR_PORT = 8476
+
+
+def pod_topology_from_env() -> Optional[Tuple[str, int, int]]:
+    """(coordinator_address, num_processes, process_id) from the TPU pod
+    env contract, or None when not on a pod."""
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES")
+    worker_id = os.environ.get("TPU_WORKER_ID")
+    if not hostnames or worker_id is None:
+        return None
+    hosts = [h.strip() for h in hostnames.split(",") if h.strip()]
+    if len(hosts) <= 1:
+        return None
+    return (f"{hosts[0]}:{COORDINATOR_PORT}", len(hosts), int(worker_id))
+
+
+def _routable_ip() -> str:
+    """This host's routable interface IP. gethostbyname(hostname) often
+    resolves to loopback (127.0.1.1 in /etc/hosts); the UDP-connect trick
+    asks the kernel which interface would route outward — no packet is
+    sent."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))
+        return s.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        s.close()
+
+
+def rendezvous_via_kv(kv, num_processes: int, process_id: int,
+                      run_id: str = "default") -> Tuple[str, int, int]:
+    """Elect host 0's address through the cluster KV (the reference's
+    internal-KV NCCLUniqueID exchange, SURVEY §5.8 plane 3). ``run_id``
+    namespaces the key so a re-formed cluster or a second concurrent job
+    never reads a stale coordinator from an earlier run."""
+    key = f"multihost::{run_id}::coordinator".encode()
+    if process_id == 0:
+        addr = f"{_routable_ip()}:{COORDINATOR_PORT}"
+        kv.kv_put(key, addr.encode())
+        return addr, num_processes, 0
+    import time
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        raw = kv.kv_get(key)
+        if raw:
+            return raw.decode(), num_processes, process_id
+        time.sleep(0.2)
+    raise TimeoutError("coordinator address never published to the KV")
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> bool:
+    """Bring up the jax coordination service for this host. Returns True
+    when a MULTI-host runtime was initialized (False = single host, which
+    needs nothing). Idempotent."""
+    global _initialized
+    if _initialized:
+        return True
+
+    if coordinator_address is not None and (num_processes is None
+                                            or process_id is None):
+        raise ValueError(
+            "an explicit coordinator_address also needs num_processes "
+            "and process_id")
+    if coordinator_address is None:
+        topo = pod_topology_from_env()
+        if topo is not None:
+            coordinator_address, num_processes, process_id = topo
+        elif num_processes and num_processes > 1 \
+                and process_id is not None:
+            # resolution priority 2: elect through the cluster KV
+            from ray_tpu._private import worker
+
+            rt = worker.global_runtime()
+            if rt is None:
+                return False
+            coordinator_address, num_processes, process_id = \
+                rendezvous_via_kv(rt.gcs, num_processes, process_id,
+                                  run_id=rt.namespace)
+        else:
+            return False
+    if num_processes is None or num_processes <= 1:
+        return False
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    _initialized = True
+    return True
+
+
+def multihost_mesh(spec, *, devices=None):
+    """Build a global mesh spanning every host's devices; call AFTER
+    initialize_multihost. Per-host data loading should shard by
+    ``jax.process_index()``."""
+    import jax
+
+    from ray_tpu.parallel.mesh import build_mesh
+
+    return build_mesh(spec, devices if devices is not None
+                      else jax.devices())
+
+
+def process_shard(n: int) -> Tuple[int, int]:
+    """(start, stop) rows of an n-row global batch for THIS host."""
+    import jax
+
+    per = n // max(jax.process_count(), 1)
+    start = jax.process_index() * per
+    return start, start + per

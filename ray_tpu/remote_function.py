@@ -23,8 +23,29 @@ class ObjectRefGenerator:
     """
 
     def __init__(self, task_id: TaskID):
+        import threading
+
         self._task_id = task_id
         self._index = 0
+        # multiple threads may share one generator (fan-out consumers);
+        # index claims must be atomic or items are delivered twice, and
+        # a claim that errors (timeout/transient RPC) returns to the
+        # hole set so ANOTHER consumer re-claims it — exactly-once even
+        # when consumers fail interleaved
+        self._lock = threading.Lock()
+        self._holes: set = set()
+
+    def __getstate__(self):
+        return {"_task_id": self._task_id, "_index": self._index,
+                "_holes": set(self._holes)}
+
+    def __setstate__(self, d):
+        import threading
+
+        self._task_id = d["_task_id"]
+        self._index = d["_index"]
+        self._holes = set(d.get("_holes", ()))
+        self._lock = threading.Lock()
 
     def __iter__(self) -> "ObjectRefGenerator":
         return self
@@ -32,9 +53,19 @@ class ObjectRefGenerator:
     def __next__(self) -> ObjectRef:
         rt = worker.global_worker()
         state = rt.generator_state(self._task_id)
-        ref = state.next_ref(self._index)
-        self._index += 1
-        return ref
+        with self._lock:
+            if self._holes:
+                index = min(self._holes)
+                self._holes.discard(index)
+            else:
+                index = self._index
+                self._index += 1
+        try:
+            return state.next_ref(index)
+        except BaseException:
+            with self._lock:
+                self._holes.add(index)
+            raise
 
     def __aiter__(self):
         return self

