@@ -144,7 +144,10 @@ def main() -> int:
 
     serve_mode = os.environ.get("BENCH_SERVE") == "1"
     attempts = int(os.environ.get("BENCH_ATTEMPTS", "2"))
-    timeout = int(os.environ.get("BENCH_TIMEOUT", "1200"))
+    # 900s covers first-compile (~40s) + 20 timed steps with margin; a
+    # HUNG tunnel otherwise burns attempts x timeout before the CPU
+    # fallback can emit the line
+    timeout = int(os.environ.get("BENCH_TIMEOUT", "900"))
     cmd = [sys.executable, os.path.abspath(__file__), "--child"]
 
     def try_once(env, t) -> tuple[str | None, str, bool]:
@@ -194,7 +197,7 @@ def main() -> int:
     env = os.environ.copy()
     env["BENCH_FORCE_CPU"] = "1"
     env["BENCH_ERROR"] = f"tpu backend unavailable: {err}"[:500]
-    line, cpu_err, _ = try_once(env, max(600, timeout))
+    line, cpu_err, _ = try_once(env, 420)  # tiny debug config: fast
     if line is not None:
         print(line)
         return 0
