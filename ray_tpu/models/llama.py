@@ -45,10 +45,12 @@ class LlamaConfig:
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
     remat: bool = True
-    # Context-parallel scheme when the sp mesh axis is >1 (SURVEY §5.7):
-    # "ring" = ppermute K/V rotation (any head count, O(S/sp) memory);
-    # "ulysses" = all-to-all head/seq swap (needs n_heads % sp == 0,
-    # local full-sequence attention so any local kernel applies).
+    # Attention implementation (SURVEY §5.7):
+    # "ring" = ppermute K/V rotation CP (any head count, O(S/sp) memory);
+    # "ulysses" = all-to-all head/seq swap CP (needs n_heads % sp == 0,
+    # local full-sequence attention so any local kernel applies);
+    # "flash" = single-device Pallas flash kernel (ops/attention.py) —
+    # the MFU path for sp==1 (bench default); interpret-mode on CPU.
     attention_impl: str = "ring"
     # KV-cache decode attention: "xla" masked fallback or the "pallas"
     # ragged kernel (skips KV blocks past each slot's length —
@@ -56,9 +58,9 @@ class LlamaConfig:
     decode_attention: str = "xla"
 
     def __post_init__(self):
-        if self.attention_impl not in ("ring", "ulysses"):
+        if self.attention_impl not in ("ring", "ulysses", "flash"):
             raise ValueError(
-                f"attention_impl must be 'ring' or 'ulysses', "
+                f"attention_impl must be 'ring', 'ulysses' or 'flash', "
                 f"got {self.attention_impl!r}")
         if self.decode_attention not in ("xla", "pallas"):
             raise ValueError(
@@ -88,10 +90,14 @@ class LlamaConfig:
 
     @staticmethod
     def bench_400m(max_seq_len: int = 2048) -> "LlamaConfig":
-        """~440M params: sized so f32 params+adam+grads fit a 16GB chip."""
+        """~440M params: sized so f32 params+adam+grads fit a 16GB chip.
+
+        head_dim=128 (MXU tile width) so the Pallas flash kernel — the
+        bench default — tiles cleanly onto the systolic array.
+        """
         return LlamaConfig(vocab_size=32_000, dim=1024, n_layers=24,
-                           n_heads=16, n_kv_heads=8, ffn_dim=4096,
-                           max_seq_len=max_seq_len)
+                           n_heads=8, n_kv_heads=4, ffn_dim=4096,
+                           max_seq_len=max_seq_len, attention_impl="flash")
 
     @staticmethod
     def debug(vocab_size: int = 256, max_seq_len: int = 128) -> "LlamaConfig":
@@ -135,6 +141,10 @@ class LlamaModel:
         self.mesh = mesh
         self.rules = rules
         self._sp = 1 if mesh is None else mesh.shape.get("sp", 1)
+        if self._sp > 1 and cfg.attention_impl == "flash":
+            raise ValueError(
+                "attention_impl='flash' is a single-device kernel; with an "
+                "sp>1 mesh use 'ring' or 'ulysses' context parallelism")
         self._angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
                                         theta=cfg.rope_theta)
 
@@ -245,8 +255,12 @@ class LlamaModel:
                                                  causal=True)
             from ray_tpu.ops.ring_attention import ring_attention_sharded
             return ring_attention_sharded(q, k, v, self.mesh, causal=True)
+        # sp==1: "flash" forces the Pallas kernel (interpret-mode off-TPU);
+        # otherwise the dispatcher auto-selects by platform/shape.
+        use_flash = (True if (self.cfg.attention_impl == "flash"
+                              and positions is None) else None)
         return attention(q, k, v, causal=True, positions_q=positions,
-                         positions_k=positions)
+                         positions_k=positions, use_flash=use_flash)
 
     def _block(self, x, layer: Params, positions):
         cfg = self.cfg

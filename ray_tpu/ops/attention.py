@@ -145,6 +145,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         cols = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = cols < seq_k  # tail block: don't attend to padding keys
+        # Zero padded V rows: their p weights are exp(NEG_INF)≈0, but
+        # 0 * <uninitialized> is NaN when the pad is NaN (interpret mode),
+        # and garbage-dependent on hardware — make the product exact 0.
+        kvalid = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0) < seq_k
+        v = jnp.where(kvalid, v, 0)
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -170,8 +176,21 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
-def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int):
+def _interpret_default() -> bool:
+    """Pallas kernels only compile for TPU; elsewhere (CPU test meshes)
+    run the SAME kernel under the Pallas interpreter so tests exercise the
+    real kernel logic."""
+    try:
+        return jax.devices()[0].platform != "tpu"
+    except Exception:  # pragma: no cover - no backend at all
+        return True
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "block_q", "block_k",
+                                    "interpret"))
+def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -215,26 +234,31 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int):
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
     )(qt, kt, vt)
     return out.reshape(batch, num_heads, seq_q, head_dim).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
-                    block_k: int = 128):
+                    block_k: int = 128, interpret: bool | None = None):
     """Pallas TPU flash attention. O(S) memory forward; backward recomputes
     blockwise (remat scan), so training memory stays O(S·block) too."""
+    if interpret is None:
+        interpret = _interpret_default()
     return _flash_forward(q, k, v, causal=causal, block_q=block_q,
-                          block_k=block_k)
+                          block_k=block_k, interpret=interpret)
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+    if interpret is None:
+        interpret = _interpret_default()
     out = _flash_forward(q, k, v, causal=causal, block_q=block_q,
-                         block_k=block_k)
+                         block_k=block_k, interpret=interpret)
     return out, (q, k, v)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, res, g):
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, g):
     q, k, v = res
     _, vjp = jax.vjp(
         lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal),

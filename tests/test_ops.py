@@ -305,3 +305,87 @@ def test_ragged_decode_attention_unpadded_lengths():
                                          interpret=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention (interpret mode: real kernel logic on CPU)
+# ---------------------------------------------------------------------------
+
+def test_flash_attention_matches_reference():
+    from ray_tpu.ops.attention import flash_attention
+
+    rng = np.random.default_rng(0)
+    B, S, H, Hkv, D = 2, 256, 4, 2, 32
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, Hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, Hkv, D)), jnp.float32)
+    for causal in (True, False):
+        out = flash_attention(q, k, v, causal, 128, 128, True)
+        expect = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_ragged_seq():
+    """Sequence not a multiple of the k block: tail-block masking."""
+    from ray_tpu.ops.attention import flash_attention
+
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.normal(size=(1, 192, 2, 16)), jnp.float32)
+    out = flash_attention(q, q, q, True, 128, 128, True)
+    expect = reference_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_gradients_match_reference():
+    from ray_tpu.ops.attention import flash_attention
+
+    rng = np.random.default_rng(2)
+    B, S, H, D = 1, 128, 2, 16
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+
+    def f_flash(q, k, v):
+        return (flash_attention(q, k, v, True, 64, 64, True) ** 2).sum()
+
+    def f_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=True) ** 2).sum()
+
+    g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-3, atol=5e-4)
+
+
+def test_llama_flash_impl_matches_ring_default():
+    """attention_impl='flash' (sp==1) must produce the same loss as the
+    reference/blockwise path the other impls use on one device."""
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg_kw = dict(vocab_size=128, dim=64, n_layers=2, n_heads=4,
+                  n_kv_heads=2, ffn_dim=128, max_seq_len=128, remat=False)
+    m_ring = LlamaModel(LlamaConfig(attention_impl="ring", **cfg_kw))
+    m_flash = LlamaModel(LlamaConfig(attention_impl="flash", **cfg_kw))
+    params = m_ring.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 128, (2, 128)), jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    l_ring = m_ring.loss(params, tokens, targets)
+    l_flash = m_flash.loss(params, tokens, targets)
+    np.testing.assert_allclose(float(l_ring), float(l_flash),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_llama_flash_rejects_sp_mesh():
+    from jax.sharding import Mesh
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel
+
+    devs = np.array(jax.devices()[:2]).reshape(2)
+    mesh = Mesh(devs, ("sp",))
+    cfg = LlamaConfig.debug()
+    cfg = dataclasses.replace(cfg, attention_impl="flash")
+    with pytest.raises(ValueError, match="flash"):
+        LlamaModel(cfg, mesh=mesh)
