@@ -143,6 +143,14 @@ class DaemonHandle:
             cb = self.on_actor_worker_died
             if cb is not None:
                 cb(msg["actor_id"], msg["cause"])
+        elif method == "worker_log":
+            # cross-process worker line surfaced on the driver
+            # (reference: print_worker_logs)
+            import sys
+
+            out = sys.stderr if msg.get("stream") == "err" else sys.stdout
+            print(f"(worker node={msg.get('node', '?')} "
+                  f"pid={msg.get('pid')}) {msg.get('line')}", file=out)
 
     def mark_dead(self) -> None:
         self.dead = True
@@ -334,8 +342,14 @@ class DaemonHandle:
         except DaemonCrashed:
             pass
 
-    def pull_object(self, oid: bytes, from_addr: Tuple[str, int]) -> bool:
-        out = self._call("pull_object", oid=oid, from_addr=list(from_addr))
+    def pull_object(self, oid: bytes,
+                    from_addr: Optional[Tuple[str, int]] = None,
+                    priority: int = 2) -> bool:
+        """priority: 0=get, 1=wait, 2=task-args (pull_manager.h:38-51).
+        ``from_addr=None`` resolves via the owner's object directory."""
+        out = self._call("pull_object", oid=oid,
+                         from_addr=list(from_addr) if from_addr else [],
+                         priority=priority)
         return out.get("ok", False)
 
     # -- lifecycle --------------------------------------------------------
@@ -440,6 +454,11 @@ class RemoteStore:
             entry = self._meta.get(object_id)
         return entry[1] if entry else 0
 
+    def has_daemon_key(self, daemon_key: bytes) -> bool:
+        """Directory support: does this node hold the given store key?"""
+        with self._lock:
+            return any(k == daemon_key for k, _ in self._meta.values())
+
     def used_bytes(self) -> int:
         with self._lock:
             return sum(n for _, n in self._meta.values())
@@ -500,21 +519,41 @@ class OwnerService:
 
 
 class ClusterBackend:
-    """Spawns + tracks the head and daemon processes for one driver."""
+    """Spawns + tracks the head and daemon processes for one driver.
+
+    Head fault tolerance: the head persists KV/pubsub to sqlite in the
+    session dir; a supervisor thread here respawns a crashed head on the
+    SAME port with the same state file, daemons re-register themselves
+    (daemon.py grace loop), and the driver's HeadClient re-dials — so a
+    head SIGKILL is a blip, not a lost cluster (reference:
+    ``gcs/store_client/redis_store_client.h`` + raylet resync).
+    """
+
+    HEAD_RECONNECT_S = 20.0
 
     def __init__(self, runtime, num_daemons: int,
                  resources_per_daemon: Dict[str, float],
                  object_store_bytes: int = 256 * 1024 * 1024):
+        import tempfile
         object_store_bytes = max(object_store_bytes, 1 << 20)
         self.runtime = runtime
         self.arenas = ArenaCache()
-        self.head_proc, head_port = _spawn("ray_tpu._private.head", [])
-        self.head = HeadClient(("127.0.0.1", head_port))
+        self.session_dir = tempfile.mkdtemp(prefix="ray_tpu_session_")
+        self._head_state = os.path.join(self.session_dir, "head_state.db")
+        self.head_proc, self._head_port = _spawn(
+            "ray_tpu._private.head", ["--state-path", self._head_state])
+        self.head = HeadClient(("127.0.0.1", self._head_port),
+                               reconnect_window=self.HEAD_RECONNECT_S)
+        self._shutting_down = False
+        self._supervisor = threading.Thread(
+            target=self._supervise_head, daemon=True, name="head-supervisor")
+        self._supervisor.start()
         self.owner_server = Server(OwnerService(runtime)).start()
         self.daemons: Dict[NodeID, DaemonHandle] = {}
         self._lock = threading.Lock()
         import json
 
+        head_port = self._head_port
         for _ in range(num_daemons):
             node_id = NodeID.from_random()
             proc, port = _spawn("ray_tpu._private.daemon", [
@@ -531,6 +570,26 @@ class ClusterBackend:
             with self._lock:
                 self.daemons[node_id] = handle
         self.head.subscribe("node", self._on_node_event)
+
+    def _supervise_head(self) -> None:
+        """Respawn a crashed head on the same port with the same state."""
+        while not self._shutting_down:
+            time.sleep(0.25)
+            if self._shutting_down or self.head_proc.poll() is None:
+                continue
+            try:
+                proc, _ = _spawn(
+                    "ray_tpu._private.head",
+                    ["--state-path", self._head_state,
+                     "--port", str(self._head_port)])
+            except (RuntimeError, OSError):
+                continue  # port may linger in TIME_WAIT; retry
+            if self._shutting_down:
+                # shutdown() won the race while we were spawning: don't
+                # leak a fresh head that nothing will ever terminate
+                proc.kill()
+                return
+            self.head_proc = proc
 
     def _make_actor_death_cb(self):
         def cb(actor_id_hex: str, cause: str) -> None:
@@ -550,8 +609,12 @@ class ClusterBackend:
         node_id = NodeID.from_hex(event["node_id"])
         with self._lock:
             handle = self.daemons.get(node_id)
-        if handle is None or handle.dead:
+        if handle is None:
             return
+        # Do NOT skip when handle.dead is already set: an in-flight RPC
+        # failure marks the handle dead without running the node-death
+        # flow, and losing that race must not lose the actor restarts —
+        # remove_node below is a no-op if the runtime already removed it.
         handle.mark_dead()
         # Route through the runtime's node-death flow (lost objects,
         # task retries, actor restarts).
@@ -570,6 +633,7 @@ class ClusterBackend:
             pass
 
     def shutdown(self) -> None:
+        self._shutting_down = True
         with self._lock:
             daemons = list(self.daemons.values())
             self.daemons.clear()
@@ -586,3 +650,6 @@ class ClusterBackend:
             self.head_proc.kill()
         self.owner_server.stop()
         self.arenas.close()
+        import shutil
+
+        shutil.rmtree(self.session_dir, ignore_errors=True)

@@ -61,9 +61,11 @@ declare("commit_bundle", "pg_id", "index")
 declare("cancel_bundle", "pg_id", "index")
 declare("put_object", "oid", "blob")
 declare("get_object", "oid", "prefer_shm")
+declare("object_meta", "oid")
+declare("get_object_chunk", "oid", "off", "size")
 declare("release_object", "oid")
 declare("free_objects", "oids")
-declare("pull_object", "oid", "from_addr")
+declare("pull_object", "oid", "from_addr", "priority")
 declare("daemon_ping")
 declare("daemon_stop")
 declare("daemon_stats")
@@ -140,6 +142,39 @@ class ObjectTable:
                 return True
         return self._shm is not None and self._shm.contains(oid)
 
+    def nbytes_of(self, oid: bytes) -> Optional[int]:
+        with self._lock:
+            blob = self._small.get(oid)
+        if blob is not None:
+            return len(blob)
+        if self._shm is not None:
+            try:
+                off, size = self._shm.get_ref(oid)
+                self._shm.release(oid)
+                return size
+            except KeyError:
+                return None
+        return None
+
+    def read_range(self, oid: bytes, off: int, size: int
+                   ) -> Optional[bytes]:
+        """One chunk of the object's bytes (inter-node chunked transfer,
+        reference ``object_buffer_pool.h``); pin held only per call."""
+        with self._lock:
+            blob = self._small.get(oid)
+        if blob is not None:
+            return blob[off:off + size]
+        if self._shm is not None:
+            try:
+                view = self._shm.get_view(oid)  # increfs
+                try:
+                    return bytes(view[off:off + size])
+                finally:
+                    self._shm.release(oid)
+            except KeyError:
+                return None
+        return None
+
     def delete(self, oid: bytes) -> None:
         with self._lock:
             self._small.pop(oid, None)
@@ -157,6 +192,139 @@ class ObjectTable:
     def close(self) -> None:
         if self._shm is not None:
             self._shm.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# pull manager: chunked, deduplicated, prioritized inter-node pulls
+# ---------------------------------------------------------------------------
+
+# Priorities mirror the reference's pull policy (``pull_manager.h:38-51``):
+# an explicit ray.get outranks wait(fetch_local) outranks task-arg staging.
+PULL_PRIORITY_GET = 0
+PULL_PRIORITY_WAIT = 1
+PULL_PRIORITY_TASK_ARGS = 2
+
+PULL_CHUNK = int(os.environ.get("RAY_TPU_PULL_CHUNK", str(4 << 20)))
+
+
+class _Pull:
+    __slots__ = ("oid", "from_addr", "priority", "event", "ok", "error",
+                 "missing")
+
+    def __init__(self, oid: bytes, from_addr, priority: int):
+        self.oid = oid
+        self.from_addr = from_addr
+        self.priority = priority
+        self.event = threading.Event()
+        self.ok = False
+        self.missing = False
+        self.error = ""
+
+
+class PullManager:
+    """Inter-node object transfer engine (reference:
+    ``object_manager.cc:247 Pull / :354 Push``, ``pull_manager.h``,
+    ``push_manager.h``, ``object_buffer_pool.h``):
+
+    - transfers move in ``PULL_CHUNK``-sized pieces assembled into one
+      preallocated buffer, so a 64 MiB object never rides one RPC frame;
+    - concurrent pulls of the same object deduplicate onto one in-flight
+      transfer (push-dedup role — the bytes cross the wire once);
+    - queued pulls are served strictly by priority (get > wait >
+      task-args), then FIFO;
+    - every step feeds stats counters (surfaced by ``daemon_stats``).
+    """
+
+    def __init__(self, objects: ObjectTable, peer_fn, num_workers: int = 2,
+                 chunk: int = PULL_CHUNK):
+        self.objects = objects
+        self._peer = peer_fn        # addr -> rpc.Client
+        self.chunk = chunk
+        self._cv = threading.Condition()
+        self._heap: list = []
+        self._seq = 0
+        self._inflight: Dict[bytes, _Pull] = {}
+        self.stats = {"pulls_started": 0, "pulls_deduped": 0,
+                      "pulls_failed": 0, "chunks_transferred": 0,
+                      "bytes_pulled": 0}
+        for i in range(num_workers):
+            threading.Thread(target=self._loop, daemon=True,
+                             name=f"pull-worker-{i}").start()
+
+    def request(self, oid: bytes, from_addr, priority: int) -> _Pull:
+        """Enqueue (or join) a pull; caller waits on the returned event."""
+        import heapq
+        with self._cv:
+            existing = self._inflight.get(oid)
+            if existing is not None:
+                self.stats["pulls_deduped"] += 1
+                return existing
+            pull = _Pull(oid, from_addr, priority)
+            self._inflight[oid] = pull
+            self.stats["pulls_started"] += 1
+            self._seq += 1
+            heapq.heappush(self._heap, (priority, self._seq, pull))
+            self._cv.notify()
+        return pull
+
+    def _loop(self) -> None:
+        import heapq
+        while True:
+            with self._cv:
+                while not self._heap:
+                    self._cv.wait()
+                _, _, pull = heapq.heappop(self._heap)
+            try:
+                self._transfer(pull)
+                pull.ok = True
+            except _PullMissing:
+                pull.missing = True
+                with self._cv:
+                    self.stats["pulls_failed"] += 1
+            except Exception as e:  # noqa: BLE001 — reported to waiter
+                pull.error = repr(e)
+                with self._cv:
+                    self.stats["pulls_failed"] += 1
+            finally:
+                with self._cv:
+                    self._inflight.pop(pull.oid, None)
+                pull.event.set()
+
+    def _transfer(self, pull: _Pull) -> None:
+        if self.objects.contains(pull.oid):
+            return  # a deduped predecessor already landed it
+        peer = self._peer(tuple(pull.from_addr))
+        meta = peer.call("object_meta", oid=pull.oid)
+        if meta.get("missing"):
+            raise _PullMissing()
+        size = meta["size"]
+        if size <= self.chunk:
+            out = peer.call("get_object", oid=pull.oid, prefer_shm=False)
+            if out.get("missing"):
+                raise _PullMissing()
+            blob = out["blob"]
+            with self._cv:
+                self.stats["chunks_transferred"] += 1
+                self.stats["bytes_pulled"] += len(blob)
+        else:
+            buf = bytearray(size)  # the transfer's reassembly buffer
+            for off in range(0, size, self.chunk):
+                want = min(self.chunk, size - off)
+                out = peer.call("get_object_chunk", oid=pull.oid,
+                                off=off, size=want)
+                part = out.get("blob")
+                if part is None:    # evicted mid-transfer
+                    raise _PullMissing()
+                buf[off:off + len(part)] = part
+                with self._cv:
+                    self.stats["chunks_transferred"] += 1
+                    self.stats["bytes_pulled"] += len(part)
+            blob = bytes(buf)
+        self.objects.put(pull.oid, blob)
+
+
+class _PullMissing(Exception):
+    pass
 
 
 # ---------------------------------------------------------------------------
@@ -218,6 +386,40 @@ class DaemonService:
         self._task_rids: Dict[str, Tuple[Any, str]] = {}
         self._bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self._peers: Dict[Tuple[str, int], Client] = {}
+        self.pulls = PullManager(self.objects, self._peer)
+        # Worker log capture: this daemon's workers write per-pid files;
+        # the monitor forwards new lines to the driver (worker_log push).
+        from ray_tpu._private import log_monitor as _lm
+        self._log_monitor = None
+        if _lm.log_to_driver_enabled():
+            self._log_monitor = _lm.LogMonitor(
+                _lm.session_log_dir(), self._forward_worker_log)
+
+    def _forward_worker_log(self, pid: int, stream: str,
+                            line: str) -> None:
+        self.notify_driver("worker_log", pid=pid, stream=stream,
+                           line=line, node=self.node_id.hex()[:8])
+
+    def _peer(self, addr: Tuple[str, int]) -> Client:
+        with self._lock:
+            peer = self._peers.get(addr)
+            if peer is None or peer.dead:
+                peer = self._peers[addr] = Client(addr)
+        return peer
+
+    def _locate_via_owner(self, oid: bytes):
+        """Owner-keyed object directory (reference:
+        ``ownership_object_directory.h``): ask the object's owner which
+        nodes hold a copy."""
+        if self.owner is None:
+            return []
+        out = self.owner.call(
+            "core_op", call="locate_object",
+            payload=cloudpickle.dumps({"oid": oid}), task=None,
+            timeout=30.0)
+        if not out.get("ok"):
+            return []
+        return cloudpickle.loads(out["value"])
 
     # -- wiring ----------------------------------------------------------
     def handle_hello_driver(self, conn, rid, msg):
@@ -535,19 +737,48 @@ class DaemonService:
 
     def handle_pull_object(self, conn, rid, msg):
         """Inter-node transfer: fetch from a peer daemon into the local
-        table (reference: ObjectManager::Pull / Push)."""
-        if self.objects.contains(msg["oid"]):
+        table via the PullManager (chunked + deduped + prioritized;
+        reference: ObjectManager::Pull/Push). ``from_addr`` is a location
+        hint; when absent (or stale) the owner's object directory is
+        consulted."""
+        oid = msg["oid"]
+        if self.objects.contains(oid):
             return {"ok": True, "already": True}
-        addr = tuple(msg["from_addr"])
-        with self._lock:
-            peer = self._peers.get(addr)
-            if peer is None or peer.dead:
-                peer = self._peers[addr] = Client(addr)
-        out = peer.call("get_object", oid=msg["oid"], prefer_shm=False)
-        if out.get("missing"):
-            return {"ok": False, "missing": True}
-        self.objects.put(msg["oid"], out["blob"])
-        return {"ok": True}
+        priority = int(msg.get("priority", PULL_PRIORITY_TASK_ARGS))
+        hint = [tuple(msg["from_addr"])] if msg["from_addr"] else []
+        last = {}
+        tried = set()
+        # Try the caller's hint first; fall back to the owner's object
+        # directory when there is no hint OR the hint went stale (peer
+        # evicted/died) — the directory lookup is lazy so the common
+        # hinted pull pays no extra owner round-trip.
+        for phase in range(2):
+            candidates = hint if phase == 0 else [
+                tuple(a) for a in self._locate_via_owner(oid)]
+            for addr in candidates:
+                if addr in tried:
+                    continue
+                tried.add(addr)
+                pull = self.pulls.request(oid, addr, priority)
+                if not pull.event.wait(timeout=120.0):
+                    return {"ok": False, "error": "pull timed out"}
+                if pull.ok:
+                    return {"ok": True}
+                last = ({"ok": False, "missing": True} if pull.missing
+                        else {"ok": False, "error": pull.error})
+        return last or {"ok": False, "missing": True}
+
+    def handle_object_meta(self, conn, rid, msg):
+        size = self.objects.nbytes_of(msg["oid"])
+        if size is None:
+            return {"missing": True}
+        return {"size": size}
+
+    def handle_get_object_chunk(self, conn, rid, msg):
+        blob = self.objects.read_range(msg["oid"], msg["off"], msg["size"])
+        if blob is None:
+            return {"missing": True}
+        return {"blob": blob}
 
     # -- misc -------------------------------------------------------------
     def handle_core_release(self, conn, rid, msg):
@@ -562,6 +793,7 @@ class DaemonService:
             running = len(self._task_rids)
         return {"leases": leases, "running": running,
                 "store_used": self.objects.used_bytes(),
+                "pull_stats": dict(self.pulls.stats),
                 "actors": len(
                     self.runtime.process_router._actor_workers)}
 
@@ -599,18 +831,49 @@ def main() -> None:
         os.close(args.announce_fd)
 
     head_host, head_port = args.head.rsplit(":", 1)
-    head = HeadClient((head_host, int(head_port)))
-    head.register_node(args.node_id, resources, json.loads(args.labels),
-                       server.addr)
+    head_addr = (head_host, int(head_port))
+    labels = json.loads(args.labels)
+    head = HeadClient(head_addr)
+    head.register_node(args.node_id, resources, labels, server.addr)
+
+    # Head-FT (reference: raylets resync after a GCS restart,
+    # gcs_init_data.h): on transport failure keep re-dialing the head for
+    # a grace window and re-register; only a head that stays down — or
+    # one that explicitly declares us dead — ends the session.
+    grace = float(os.environ.get("RAY_TPU_HEAD_GRACE_S", "20"))
+
+    def reconnect() -> "HeadClient | None":
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline:
+            try:
+                client = HeadClient(head_addr)
+                client.register_node(args.node_id, resources, labels,
+                                     server.addr)
+                return client
+            except (OSError, rpc.RpcError):
+                time.sleep(0.25)
+        return None
 
     while True:  # heartbeat loop; exit if the head declared us dead
         time.sleep(HEARTBEAT_S)
         try:
             out = head.heartbeat(args.node_id, resources)
         except rpc.RpcError:
-            os._exit(0)  # head gone: session over
+            head.close()
+            new_head = reconnect()
+            if new_head is None:
+                os._exit(0)  # head stayed down: session over
+            head = new_head
+            continue
         if out.get("dead"):
             os._exit(0)
+        if out.get("unknown"):
+            # Restarted head with empty membership: re-register.
+            try:
+                head.register_node(args.node_id, resources, labels,
+                                   server.addr)
+            except rpc.RpcError:
+                pass
 
 
 if __name__ == "__main__":

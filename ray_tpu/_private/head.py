@@ -21,14 +21,25 @@ Services:
 - InternalKV: kv_put / kv_get / kv_del / kv_keys (bytes in, bytes out).
 - Pubsub: subscribe(channel) parks the request (long-poll HOLD); publish
   completes every parked subscriber with the event batch.
+
+Fault tolerance (reference: GCS restart reload —
+``gcs/store_client/redis_store_client.h``, ``gcs_init_data.h``): with
+``--state-path`` the KV table and pubsub event logs are write-through
+persisted to sqlite, so a restarted head resumes with identical KV
+contents and valid pubsub cursors. Node membership is NOT persisted —
+live daemons re-register themselves on their next heartbeat (the
+raylet-resync model), which is the ground truth for liveness anyway.
 """
 
 from __future__ import annotations
 
 import argparse
+import sqlite3
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
+
+import msgpack
 
 from ray_tpu._private import rpc
 from ray_tpu._private.rpc import HOLD, Client, Connection, Server, declare
@@ -72,14 +83,64 @@ class _NodeEntry:
                 "reason": self.reason}
 
 
+class _HeadStore:
+    """Write-through sqlite persistence for head state (GCS-FT role)."""
+
+    def __init__(self, path: str):
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        # Writes happen under the HeadService lock: per-op fsync there
+        # would stall every head RPC (incl. heartbeats) behind disk.
+        # WAL + synchronous=NORMAL keeps commits memory-speed; the WAL
+        # still survives a head-process crash (the FT case we replay).
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS kv (key BLOB PRIMARY KEY, "
+            "value BLOB)")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS events (channel TEXT, idx INTEGER, "
+            "event BLOB, PRIMARY KEY(channel, idx))")
+        self._db.commit()
+
+    def load(self) -> Tuple[Dict[bytes, bytes], Dict[str, List[Any]]]:
+        kv = {bytes(k): bytes(v) for k, v in
+              self._db.execute("SELECT key, value FROM kv")}
+        events: Dict[str, List[Any]] = {}
+        for chan, idx, blob in self._db.execute(
+                "SELECT channel, idx, event FROM events "
+                "ORDER BY channel, idx"):
+            events.setdefault(chan, []).append(
+                msgpack.unpackb(blob, raw=False))
+        return kv, events
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._db.execute("INSERT OR REPLACE INTO kv VALUES (?, ?)",
+                         (key, value))
+        self._db.commit()
+
+    def delete(self, key: bytes) -> None:
+        self._db.execute("DELETE FROM kv WHERE key = ?", (key,))
+        self._db.commit()
+
+    def append_event(self, channel: str, idx: int, event: Any) -> None:
+        self._db.execute(
+            "INSERT OR REPLACE INTO events VALUES (?, ?, ?)",
+            (channel, idx, msgpack.packb(event, use_bin_type=True)))
+        self._db.commit()
+
+
 class HeadService:
-    def __init__(self):
+    def __init__(self, state_path: Optional[str] = None):
         self._lock = threading.Lock()
         self._nodes: Dict[str, _NodeEntry] = {}
         self._kv: Dict[bytes, bytes] = {}
         # pubsub: channel -> (event log, parked subscriber conns)
         self._events: Dict[str, List[Any]] = {}
         self._parked: Dict[str, List[Tuple[Connection, int, int]]] = {}
+        self._store: Optional[_HeadStore] = None
+        if state_path:
+            self._store = _HeadStore(state_path)
+            self._kv, self._events = self._store.load()
         self._stop = threading.Event()
         self._monitor = threading.Thread(target=self._health_loop,
                                          daemon=True, name="head-health")
@@ -161,6 +222,8 @@ class HeadService:
             if not msg["overwrite"] and key in self._kv:
                 return {"added": False}
             self._kv[key] = msg["value"]
+            if self._store is not None:
+                self._store.put(key, msg["value"])
         return {"added": True}
 
     def handle_kv_get(self, conn, rid, msg):
@@ -169,8 +232,11 @@ class HeadService:
         return {"value": value}
 
     def handle_kv_del(self, conn, rid, msg):
+        key = msg["ns"] + b":" + msg["key"]
         with self._lock:
-            self._kv.pop(msg["ns"] + b":" + msg["key"], None)
+            self._kv.pop(key, None)
+            if self._store is not None:
+                self._store.delete(key)
         return {"ok": True}
 
     def handle_kv_keys(self, conn, rid, msg):
@@ -198,6 +264,8 @@ class HeadService:
         with self._lock:
             log = self._events.setdefault(channel, [])
             log.append(event)
+            if self._store is not None:
+                self._store.append_event(channel, len(log) - 1, event)
             parked = self._parked.pop(channel, [])
             cursor = len(log)
         for conn, rid, start in parked:
@@ -216,48 +284,78 @@ class HeadService:
 
 
 class HeadClient:
-    """Typed client for head services, with a background subscriber."""
+    """Typed client for head services, with a background subscriber.
 
-    def __init__(self, addr: Tuple[str, int]):
+    ``reconnect_window`` > 0 makes every call transparently re-dial the
+    head for up to that many seconds on transport failure — the driver's
+    survival path across a head restart (reference: GCS client retries,
+    ``gcs/gcs_client``).
+    """
+
+    def __init__(self, addr: Tuple[str, int], reconnect_window: float = 0.0):
         self._client = Client(addr)
         self.addr = addr
+        self._reconnect_window = reconnect_window
+        self._dial_lock = threading.Lock()
         self._sub_stop = threading.Event()
         self._sub_threads: List[threading.Thread] = []
+
+    def _redial(self) -> None:
+        with self._dial_lock:
+            if not self._client.dead:
+                return
+            client = Client(self.addr)  # raises OSError while head is down
+            old, self._client = self._client, client
+            old.close()
+
+    def _call(self, method: str, timeout: Optional[float] = None, **kw):
+        if self._reconnect_window <= 0:
+            return self._client.call(method, timeout=timeout, **kw)
+        deadline = time.monotonic() + self._reconnect_window
+        while True:
+            try:
+                return self._client.call(method, timeout=timeout, **kw)
+            except rpc.RpcError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.25)
+                try:
+                    self._redial()
+                except OSError:
+                    pass
 
     # node info
     def register_node(self, node_id: str, resources: Dict[str, float],
                       labels: Dict[str, str], addr: Tuple[str, int]):
-        return self._client.call("register_node", node_id=node_id,
-                                 resources=resources, labels=labels,
-                                 addr=list(addr))
+        return self._call("register_node", node_id=node_id,
+                          resources=resources, labels=labels,
+                          addr=list(addr))
 
     def heartbeat(self, node_id: str, available: Dict[str, float]):
-        return self._client.call("heartbeat", node_id=node_id,
-                                 available=available, timeout=5.0)
+        return self._call("heartbeat", node_id=node_id,
+                          available=available, timeout=5.0)
 
     def list_nodes(self) -> List[Dict[str, Any]]:
-        return self._client.call("list_nodes")["nodes"]
+        return self._call("list_nodes")["nodes"]
 
     def mark_node_dead(self, node_id: str, reason: str) -> None:
-        self._client.call("mark_node_dead", node_id=node_id, reason=reason)
+        self._call("mark_node_dead", node_id=node_id, reason=reason)
 
     # kv
     def kv_put(self, key: bytes, value: bytes, overwrite: bool = True,
                namespace: bytes = b"") -> bool:
-        return self._client.call("kv_put", key=key, value=value,
-                                 overwrite=overwrite,
-                                 ns=namespace)["added"]
+        return self._call("kv_put", key=key, value=value,
+                          overwrite=overwrite, ns=namespace)["added"]
 
     def kv_get(self, key: bytes, namespace: bytes = b"") -> Optional[bytes]:
-        return self._client.call("kv_get", key=key, ns=namespace)["value"]
+        return self._call("kv_get", key=key, ns=namespace)["value"]
 
     def kv_del(self, key: bytes, namespace: bytes = b"") -> None:
-        self._client.call("kv_del", key=key, ns=namespace)
+        self._call("kv_del", key=key, ns=namespace)
 
     def kv_keys(self, prefix: bytes = b"",
                 namespace: bytes = b"") -> List[bytes]:
-        return self._client.call("kv_keys", prefix=prefix,
-                                 ns=namespace)["keys"]
+        return self._call("kv_keys", prefix=prefix, ns=namespace)["keys"]
 
     # pubsub
     def subscribe(self, channel: str, callback) -> None:
@@ -271,7 +369,21 @@ class HeadClient:
                     out = sub.call("subscribe", channel=channel,
                                    cursor=cursor, timeout=None)
                 except rpc.RpcError:
-                    return
+                    if self._reconnect_window <= 0:
+                        return
+                    # Head restart: re-dial and resume from our cursor
+                    # (the persisted event log keeps it valid).
+                    deadline = (time.monotonic()
+                                + self._reconnect_window)
+                    while not self._sub_stop.is_set():
+                        if time.monotonic() >= deadline:
+                            return
+                        try:
+                            sub = Client(self.addr, timeout=None)
+                            break
+                        except OSError:
+                            time.sleep(0.25)
+                    continue
                 cursor = out["cursor"]
                 for event in out["events"]:
                     try:
@@ -302,10 +414,13 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--state-path", default="",
+                        help="sqlite file for KV/pubsub persistence (FT)")
     parser.add_argument("--announce-fd", type=int, default=-1,
                         help="write the bound port here once listening")
     args = parser.parse_args()
-    server = Server(HeadService(), host=args.host, port=args.port).start()
+    server = Server(HeadService(state_path=args.state_path or None),
+                    host=args.host, port=args.port).start()
     if args.announce_fd >= 0:
         import os
 

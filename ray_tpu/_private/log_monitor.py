@@ -1,0 +1,173 @@
+"""Worker log capture + tail-to-driver.
+
+Reference capability: every worker's stdout/stderr goes to per-process
+files under the session dir and a log monitor tails new lines back to the
+driver, prefixed with the producing worker's identity
+(``python/ray/_private/log_monitor.py``, ``worker.py:2164
+print_worker_logs``). Here:
+
+- each worker process redirects fds 1/2 to
+  ``<log_dir>/worker-<pid>.{out,err}`` at boot (worker_process.py);
+- a ``LogMonitor`` thread in the host process (driver, or node daemon in
+  cluster mode) tails the directory and hands new lines to a sink;
+- the driver prints them as ``(worker pid=N) line``; daemons forward
+  lines over the wire (``worker_log`` push) so cross-process workers
+  surface on the driver too.
+
+Disable with ``RAY_TPU_LOG_TO_DRIVER=0`` (then worker output inherits the
+parent terminal as before).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+_FILE_RE = re.compile(r"worker-(\d+)\.(out|err)$")
+
+_session_dir: Optional[str] = None
+_session_lock = threading.Lock()
+
+
+def log_to_driver_enabled() -> bool:
+    return os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0"
+
+
+def session_log_dir(create: bool = True) -> Optional[str]:
+    """This process's worker-log directory (one per driver/daemon)."""
+    global _session_dir
+    with _session_lock:
+        if _session_dir is None and create:
+            _session_dir = os.environ.get("RAY_TPU_LOG_DIR") or \
+                tempfile.mkdtemp(prefix="ray_tpu_logs_")
+            os.makedirs(_session_dir, exist_ok=True)
+        return _session_dir
+
+
+def set_session_log_dir(path: str) -> None:
+    global _session_dir
+    os.makedirs(path, exist_ok=True)
+    with _session_lock:
+        _session_dir = path
+
+
+def reset_session_log_dir() -> None:
+    global _session_dir
+    with _session_lock:
+        _session_dir = None
+
+
+def redirect_process_output(log_dir: str) -> None:
+    """Point THIS process's fds 1/2 at per-pid log files (worker boot).
+    fd-level dup2 so C/extension writes land there too; line-buffered so
+    the monitor sees prints promptly."""
+    import sys
+
+    pid = os.getpid()
+    for stream, name in ((sys.stdout, "out"), (sys.stderr, "err")):
+        path = os.path.join(log_dir, f"worker-{pid}.{name}")
+        fd = os.open(path, os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+        try:
+            stream.flush()
+        except Exception:
+            pass
+        os.dup2(fd, stream.fileno())
+        os.close(fd)
+    try:
+        sys.stdout.reconfigure(line_buffering=True)
+        sys.stderr.reconfigure(line_buffering=True)
+    except Exception:
+        pass
+
+
+class LogMonitor:
+    """Tails ``worker-*.{out,err}`` files in a directory, delivering each
+    new complete line to ``sink(pid, stream, line)``."""
+
+    def __init__(self, log_dir: str,
+                 sink: Callable[[int, str, str], None],
+                 interval: float = 0.2, start_at_end: bool = False):
+        self.log_dir = log_dir
+        self.sink = sink
+        self.interval = interval
+        self._offsets: Dict[str, int] = {}
+        self._partial: Dict[str, str] = {}
+        if start_at_end:
+            # Skip lines from a previous runtime in this process (the
+            # worker pool and its log files outlive init/shutdown).
+            try:
+                for name in os.listdir(log_dir):
+                    if _FILE_RE.search(name):
+                        try:
+                            self._offsets[name] = os.path.getsize(
+                                os.path.join(log_dir, name))
+                        except OSError:
+                            pass
+            except OSError:
+                pass
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="log-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        """Stop and join: the loop's final drain runs on the monitor
+        thread, so callers never race it with their own poll_once()."""
+        self._stop.set()
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=5.0)
+
+    def poll_once(self) -> None:
+        """One scan (also used directly by tests for determinism)."""
+        try:
+            names = os.listdir(self.log_dir)
+        except OSError:
+            return
+        for name in names:
+            m = _FILE_RE.search(name)
+            if not m:
+                continue
+            pid, stream = int(m.group(1)), m.group(2)
+            path = os.path.join(self.log_dir, name)
+            off = self._offsets.get(name, 0)
+            try:
+                with open(path, "r", errors="replace") as f:
+                    f.seek(off)
+                    chunk = f.read()
+                    self._offsets[name] = f.tell()
+            except OSError:
+                continue
+            if not chunk:
+                continue
+            chunk = self._partial.pop(name, "") + chunk
+            lines = chunk.split("\n")
+            if lines and lines[-1]:
+                self._partial[name] = lines[-1]   # incomplete tail
+            for line in lines[:-1]:
+                if line:
+                    try:
+                        self.sink(pid, stream, line)
+                    except Exception:
+                        pass
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.poll_once()
+        self.poll_once()  # final drain
+
+
+def make_driver_printer(node_tag: str = ""
+                        ) -> Callable[[int, str, str], None]:
+    """The driver-side sink: reference ``print_worker_logs`` format."""
+    import sys
+
+    prefix = f"{node_tag}, " if node_tag else ""
+
+    def sink(pid: int, stream: str, line: str) -> None:
+        out = sys.stderr if stream == "err" else sys.stdout
+        print(f"(worker {prefix}pid={pid}) {line}", file=out)
+
+    return sink

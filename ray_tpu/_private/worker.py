@@ -144,6 +144,18 @@ class Runtime:
             "/tmp", "ray_tpu", f"session_{self.job_id.hex()}")
         os.makedirs(self.session_dir, exist_ok=True)
 
+        # Worker log capture + tail-to-driver (reference:
+        # _private/log_monitor.py + worker.py:2164 print_worker_logs).
+        # The log dir is process-stable (NOT per-session): pooled workers
+        # outlive init/shutdown cycles and must keep a valid log target;
+        # start_at_end skips any previous session's lines.
+        self._log_monitor = None
+        from ray_tpu._private import log_monitor as _lm
+        if _lm.log_to_driver_enabled():
+            self._log_monitor = _lm.LogMonitor(
+                _lm.session_log_dir(), _lm.make_driver_printer(),
+                start_at_end=True)
+
         self.gcs = GCS()
         self.scheduler = ClusterScheduler()
         self.futures = FutureTable()
@@ -1538,6 +1550,8 @@ class Runtime:
     def shutdown(self) -> None:
         self._shutdown = True
         self.memory_monitor.stop()
+        if self._log_monitor is not None:
+            self._log_monitor.stop()  # joins; loop does the final drain
         self.process_router.shutdown()
         if self.cluster_backend is not None:
             try:
