@@ -53,8 +53,7 @@ class LLMServer:
             target=self.engine.run_forever, args=(self._stop,), daemon=True)
         self._thread.start()
 
-    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
-        """OpenAI-completions-shaped request/response."""
+    def _parse(self, request: Dict[str, Any]):
         prompt = request.get("prompt", "")
         sampling = SamplingParams(
             max_tokens=int(request.get("max_tokens", 32)),
@@ -64,6 +63,33 @@ class LLMServer:
                 self.tokenizer, ByteTokenizer) else ())
         ids = (prompt if isinstance(prompt, list)
                else self.tokenizer.encode(prompt))
+        return ids, sampling
+
+    def stream(self, request: Dict[str, Any]):
+        """Streaming completions: one chunk per generated token as the
+        engine produces it (reference: ray.llm streaming through Serve;
+        the TTFT the serving bench measures is only real if the first
+        token can leave the replica before generation completes)."""
+        ids, sampling = self._parse(request)
+        req = self.engine.submit(ids, sampling)
+        index = 0
+        for tok in req.iter_tokens():
+            yield {"id": f"cmpl-{req.id}", "model": self.config.model_id,
+                   "delta": self.tokenizer.decode([tok]),
+                   "token_id": int(tok), "index": index}
+            index += 1
+        yield {"id": f"cmpl-{req.id}", "model": self.config.model_id,
+               "finish_reason": req.finish_reason, "done": True,
+               "usage": {"prompt_tokens": len(ids),
+                         "completion_tokens": len(req.output)},
+               "ttft_s": req.ttft_s}
+
+    def __call__(self, request: Dict[str, Any]):
+        """OpenAI-completions-shaped request/response; ``stream: true``
+        returns a generator (chunk-per-token through Serve streaming)."""
+        if isinstance(request, dict) and request.get("stream") is True:
+            return self.stream(request)
+        ids, sampling = self._parse(request)
         req = self.engine.submit(ids, sampling)
         req.done.wait(timeout=300)
         text = self.tokenizer.decode(req.output)

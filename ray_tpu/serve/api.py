@@ -114,6 +114,36 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _respond_stream(self, handle, payload) -> None:
+        """SSE response (reference: proxy.py:1009 streaming path): each
+        replica chunk is flushed as a ``data:`` event the moment it
+        arrives — the client reads chunk 1 while generation continues."""
+        try:
+            gen = handle.options(stream=True).remote(payload)
+        except Exception as e:
+            self._respond(500, {"error": repr(e)})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        try:
+            for chunk in gen:
+                self.wfile.write(
+                    f"data: {json.dumps(chunk)}\n\n".encode())
+                self.wfile.flush()
+            self.wfile.write(b"data: [DONE]\n\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away mid-stream
+        except Exception as e:
+            try:
+                self.wfile.write(
+                    f"data: {json.dumps({'error': repr(e)})}\n\n".encode())
+                self.wfile.flush()
+            except OSError:
+                pass
+
     def do_POST(self):
         handle = self._route()
         if handle is None:
@@ -125,6 +155,13 @@ class _ProxyHandler(BaseHTTPRequestHandler):
             payload = json.loads(raw) if raw else {}
         except json.JSONDecodeError:
             payload = raw.decode()
+        wants_stream = ("text/event-stream"
+                        in self.headers.get("Accept", "")
+                        or (isinstance(payload, dict)
+                            and payload.get("stream") is True))
+        if wants_stream:
+            self._respond_stream(handle, payload)
+            return
         try:
             result = handle.remote(payload).result(timeout=60)
             self._respond(200, result)

@@ -47,6 +47,34 @@ class Replica:
             with self._lock:
                 self._ongoing -= 1
 
+    def handle_request_streaming(self, method_name: str, args, kwargs):
+        """Generator variant (reference: replica.py:1028
+        ``handle_request_streaming``): invoked with
+        ``num_returns="streaming"`` so each yielded chunk is sealed as
+        its own object and reported to the caller as it is produced —
+        the consumer sees chunk 1 before the handler returns. A
+        non-generator result degrades to a single-chunk stream."""
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if method_name == "__call__":
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+            result = target(*args, **kwargs)
+            # Stream generators/iterators chunk-wise; any plain value —
+            # including iterables like ndarray/list — stays ONE chunk.
+            if inspect.isgenerator(result) or (
+                    hasattr(result, "__next__")
+                    and hasattr(result, "__iter__")):
+                yield from result
+            else:
+                yield result
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
     def metrics(self) -> Dict[str, float]:
         with self._lock:
             return {"ongoing": self._ongoing, "total": self._total,

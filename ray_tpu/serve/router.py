@@ -33,6 +33,44 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Iterator over a streaming deployment response (reference:
+    DeploymentResponseGenerator): yields each chunk as the replica
+    produces it — chunk 1 arrives before the handler returns."""
+
+    def __init__(self, ref_gen, on_done=None):
+        self._ref_gen = ref_gen
+        self._on_done = on_done
+        self._finished = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Any:
+        try:
+            ref = next(self._ref_gen)
+        except StopIteration:
+            self._finish()
+            raise
+        try:
+            return ray_tpu.get(ref)
+        except Exception:
+            self._finish()
+            raise
+
+    def _finish(self) -> None:
+        if not self._finished:
+            self._finished = True
+            if self._on_done is not None:
+                self._on_done()
+
+    def __del__(self):
+        try:
+            self._finish()
+        except Exception:
+            pass
+
+
 class _HandleState:
     """Router state SHARED by a handle and all its method views: one
     replica set, one in-flight table, and at most ONE long-poll listener
@@ -85,10 +123,12 @@ class _HandleState:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, controller,
-                 method_name: str = "__call__", _state=None):
+                 method_name: str = "__call__", _state=None,
+                 _stream: bool = False):
         self.deployment_name = deployment_name
         self._controller = controller
         self._method_name = method_name
+        self._stream = _stream
         self._state = _state or _HandleState(deployment_name, controller)
         self._children: Dict[str, "DeploymentHandle"] = {}
 
@@ -112,12 +152,14 @@ class DeploymentHandle:
     def __getstate__(self):
         return {"deployment_name": self.deployment_name,
                 "_controller": self._controller,
-                "_method_name": self._method_name}
+                "_method_name": self._method_name,
+                "_stream": self._stream}
 
     def __setstate__(self, d):
         self.deployment_name = d["deployment_name"]
         self._controller = d["_controller"]
         self._method_name = d["_method_name"]
+        self._stream = d.get("_stream", False)
         self._state = _HandleState(self.deployment_name, self._controller)
         self._children = {}
 
@@ -130,12 +172,22 @@ class DeploymentHandle:
         if cached is None:
             cached = DeploymentHandle(self.deployment_name,
                                       self._controller, name,
-                                      _state=self._state)
+                                      _state=self._state,
+                                      _stream=self._stream)
             self._children[name] = cached
         return cached
 
-    def options(self, method_name: str) -> "DeploymentHandle":
-        return self.__getattr__(method_name)
+    def options(self, method_name: Optional[str] = None, *,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
+        """``stream=True`` makes ``remote()`` return a
+        DeploymentResponseGenerator yielding chunks as the replica
+        produces them (reference: handle.options(stream=True))."""
+        out = self.__getattr__(method_name) if method_name else self
+        if stream is None or stream == out._stream:
+            return out
+        return DeploymentHandle(out.deployment_name, out._controller,
+                                out._method_name, _state=out._state,
+                                _stream=stream)
 
     def _refresh(self, force: bool = False) -> None:
         state = self._state
@@ -174,6 +226,18 @@ class DeploymentHandle:
                 replica = state.replicas[idx]
                 state.inflight[idx] = state.inflight.get(idx, 0) + 1
             try:
+                if self._stream:
+                    ref_gen = replica.handle_request_streaming.options(
+                        num_returns="streaming").remote(
+                        self._method_name, args, kwargs)
+
+                    def decrement(i=idx):
+                        with state.lock:
+                            state.inflight[i] = max(
+                                0, state.inflight.get(i, 0) - 1)
+
+                    return DeploymentResponseGenerator(
+                        iter(ref_gen), on_done=decrement)
                 ref = replica.handle_request.remote(
                     self._method_name, args, kwargs)
                 resp = DeploymentResponse(ref)

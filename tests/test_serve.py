@@ -221,3 +221,96 @@ def test_local_testing_mode():
 
     handle = serve.run(Model.bind(Pre.bind()), local_testing_mode=True)
     assert handle.remote(4).result() == 50
+
+
+# ---------------------------------------------------------------------------
+# Streaming responses (reference: replica.py:1028 handle_request_streaming,
+# proxy.py:1009 streaming proxy path)
+# ---------------------------------------------------------------------------
+
+def test_streaming_handle_first_chunk_before_completion(serve_cluster):
+    """The defining property of streaming: chunk 1 is consumable BEFORE
+    the replica's generator has finished producing."""
+
+    @serve.deployment
+    class Streamer:
+        def __call__(self, n):
+            for i in range(n):
+                time.sleep(0.2)
+                yield {"i": i}
+
+    handle = serve.run(Streamer.bind())
+    t0 = time.monotonic()
+    gen = handle.options(stream=True).remote(5)
+    first = next(iter(gen))
+    t_first = time.monotonic() - t0
+    rest = list(gen)
+    t_all = time.monotonic() - t0
+    assert first == {"i": 0}
+    assert [c["i"] for c in rest] == [1, 2, 3, 4]
+    # 5 chunks x 0.2s ~= 1.0s total; the first must arrive well before
+    assert t_first < t_all - 0.3, (t_first, t_all)
+
+
+def test_streaming_non_generator_degrades_to_single_chunk(serve_cluster):
+    @serve.deployment
+    def plain(x):
+        return {"just": x}
+
+    handle = serve.run(plain.bind())
+    chunks = list(handle.options(stream=True).remote("one"))
+    assert chunks == [{"just": "one"}]
+
+
+def test_http_proxy_sse_streaming(serve_cluster):
+    """SSE through the HTTP proxy: first data: event readable before the
+    generator completes (a real TTFT)."""
+
+    @serve.deployment
+    class SSEApp:
+        def __call__(self, payload):
+            n = int(payload.get("n", 3)) if isinstance(payload, dict) else 3
+            for i in range(n):
+                time.sleep(0.25)
+                yield {"chunk": i}
+
+    serve.run(SSEApp.bind())
+    port = serve.start_http_proxy(port=0)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=json.dumps({"n": 4}).encode(),
+        headers={"Content-Type": "application/json",
+                 "Accept": "text/event-stream"})
+    t0 = time.monotonic()
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        events = []
+        t_first = None
+        for raw in resp:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            if t_first is None:
+                t_first = time.monotonic() - t0
+            body = line[len("data: "):]
+            if body == "[DONE]":
+                break
+            events.append(json.loads(body))
+    t_all = time.monotonic() - t0
+    assert [e["chunk"] for e in events] == [0, 1, 2, 3]
+    assert t_first < t_all - 0.3, (t_first, t_all)
+
+
+def test_llm_serve_token_streaming(serve_cluster):
+    """LLM serving streams engine tokens chunk-by-chunk through Serve."""
+    from ray_tpu.llm.serving import LLMConfig, build_llm_app
+
+    app = build_llm_app(LLMConfig(max_slots=2, max_seq=128))
+    handle = serve.run(app)
+    gen = handle.options(stream=True).remote(
+        {"prompt": "hello", "max_tokens": 8, "stream": True})
+    chunks = list(gen)
+    assert chunks[-1].get("done") is True
+    deltas = [c for c in chunks if "delta" in c]
+    assert 1 <= len(deltas) <= 8
+    assert chunks[-1]["usage"]["completion_tokens"] == len(deltas)
