@@ -1,0 +1,97 @@
+"""One 'host' of a two-process multi-host training test.
+
+Spawned twice by ``tests/test_multihost.py`` — each process owns 4 virtual
+CPU devices and joins one 8-device global mesh through the jax
+coordination service (the single-machine stand-in for a v5p pod the
+environment allows; reference capability: multi-node fixture
+``python/ray/cluster_utils.py:135`` + Train rendezvous
+``train/torch/config.py:66``).
+
+Rendezvous resolution exercised end to end: the coordinator address is
+elected through the cluster HEAD's KV (``rendezvous_via_kv`` — the
+internal-KV NCCLUniqueID-exchange role), not passed on the command line.
+Each host then runs JaxTrainer.fit with the SAME SPMD train loop over the
+global mesh; the per-step collectives cross the process boundary.
+"""
+
+import argparse
+import json
+import sys
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--process-id", type=int, required=True)
+    parser.add_argument("--num-processes", type=int, default=2)
+    parser.add_argument("--head", required=True, help="host:port of head")
+    parser.add_argument("--coordinator-port", type=int, required=True)
+    parser.add_argument("--out", required=True)
+    args = parser.parse_args()
+
+    from ray_tpu._private.platform import force_cpu_platform
+    force_cpu_platform(n_devices=4)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu._private.head import HeadClient
+    from ray_tpu.parallel import multihost
+
+    # Elect the coordinator through the head KV (resolution path 2).
+    multihost.COORDINATOR_PORT = args.coordinator_port
+    host, port = args.head.rsplit(":", 1)
+    kv = HeadClient((host, int(port)))
+    coord, nprocs, pid = multihost.rendezvous_via_kv(
+        kv, args.num_processes, args.process_id, run_id="mh-test")
+    assert multihost.initialize_multihost(coord, nprocs, pid)
+
+    assert jax.process_count() == args.num_processes
+    assert jax.local_device_count() == 4
+    assert jax.device_count() == 4 * args.num_processes
+
+    import ray_tpu
+    ray_tpu.init(num_nodes=1, resources={"CPU": 4})
+    try:
+        from ray_tpu.train import JaxTrainer, ScalingConfig, RunConfig
+        from ray_tpu.train import session
+
+        def train_loop(config):
+            from ray_tpu.models.llama import LlamaConfig, LlamaModel
+            from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+            from ray_tpu.train.spmd import make_train_step
+
+            mesh = build_mesh(MeshSpec(dp=2, fsdp=4), jax.devices())
+            cfg = LlamaConfig.debug(vocab_size=128, max_seq_len=64)
+            model = LlamaModel(cfg, mesh=mesh)
+            ts = make_train_step(model, mesh=mesh)
+            params, opt = ts.init_fn(jax.random.key(0))
+            rng = np.random.default_rng(0)   # same data on every host
+            tokens = jnp.asarray(
+                rng.integers(0, 128, (4, 64)), jnp.int32)
+            targets = jnp.roll(tokens, -1, axis=1)
+            loss = None
+            for _ in range(2):
+                params, opt, metrics = ts.step_fn(params, opt,
+                                                  (tokens, targets))
+                loss = float(metrics["loss"])
+            session.report({"loss": loss})
+
+        result = JaxTrainer(
+            train_loop,
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                name=f"mh-host{args.process_id}")).fit()
+        if result.error:
+            raise RuntimeError(result.error)
+        with open(args.out, "w") as f:
+            json.dump({"process_id": args.process_id,
+                       "global_devices": jax.device_count(),
+                       "loss": result.metrics["loss"]}, f)
+    finally:
+        ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
