@@ -3,6 +3,15 @@
 
 Trials run as gang of actors polled by the controller event loop; the
 scheduler (FIFO/ASHA/PBT) acts on every intermediate `tune.report`.
+
+Experiment persistence / restore (reference: ``Tuner.restore`` +
+experiment-state snapshots): with a ``run_config`` the controller
+snapshots every trial's (config, status, results, checkpoint) to
+``<storage>/<name>/tuner_state.pkl`` after each event-loop step;
+``Tuner.restore(path, trainable)`` rebuilds the experiment — finished
+trials keep their results, unfinished ones re-run from their last
+reported checkpoint — so a killed experiment resumes with the trial
+count conserved.
 """
 
 from __future__ import annotations
@@ -10,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import math
+import os
 import time
 import traceback
 from typing import Any, Callable, Dict, List, Optional
@@ -47,10 +57,38 @@ class Trial:
         self.actor = None
         self.run_ref = None
         self.pbt_exploited = False
+        self.checkpoint: Optional[Checkpoint] = None
 
     @property
     def last_result(self) -> Dict[str, Any]:
         return self.results[-1] if self.results else {}
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"id": self.id, "config": self.config,
+                "status": self.status, "results": list(self.results),
+                "error": self.error, "checkpoint": self.checkpoint,
+                "search_id": getattr(self, "search_id", None)}
+
+    @staticmethod
+    def from_snapshot(d: Dict[str, Any]) -> "Trial":
+        t = Trial(d["config"])
+        t.id = d["id"]
+        t.error = d["error"]
+        t.checkpoint = d.get("checkpoint")
+        if d.get("search_id") is not None:
+            t.search_id = d["search_id"]
+        # Anything not finished re-runs (a RUNNING trial died with the
+        # experiment process).
+        if d["status"] in ("TERMINATED", "STOPPED", "ERROR"):
+            t.status = d["status"]
+            t.results = list(d["results"])
+        else:
+            # Re-running from the last checkpoint re-reports those steps:
+            # keep the checkpoint, drop the partial results so they are
+            # not double-counted in the resumed run.
+            t.status = "PENDING"
+            t.results = []
+        return t
 
 
 class _TrialActor:
@@ -60,9 +98,11 @@ class _TrialActor:
         self._buffer: List[Dict] = []
         self._stop = None
 
-    def run(self, fn: Callable, config: Dict[str, Any]) -> Optional[Dict]:
+    def run(self, fn: Callable, config: Dict[str, Any],
+            checkpoint: Optional[Checkpoint] = None) -> Optional[Dict]:
         ctx = TrainContext(world_rank=0, world_size=1,
-                           experiment_name="tune")
+                           experiment_name="tune",
+                           latest_checkpoint=checkpoint)
         ctx._report_cb = lambda e: self._buffer.append(e)
         self._stop = ctx._stop_event
         _set_context(ctx)
@@ -133,7 +173,8 @@ class TrialResult:
 class Tuner:
     def __init__(self, trainable: Callable, *,
                  param_space: Optional[Dict[str, Any]] = None,
-                 tune_config: Optional[TuneConfig] = None):
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config=None):
         # Train-on-Tune (reference: base_trainer.py:692 wraps a Trainer as
         # a one-trial Tune trainable): a JaxTrainer becomes a trainable
         # whose config overrides train_loop_config per trial.
@@ -145,11 +186,94 @@ class Tuner:
         self.trainable = trainable
         self.param_space = param_space or {}
         self.cfg = tune_config or TuneConfig()
+        self.run_config = run_config
+        self._restored_trials: Optional[List[Trial]] = None
+
+    # -- experiment persistence (reference: Tuner.restore) --------------
+    @property
+    def experiment_path(self) -> Optional[str]:
+        if self.run_config is None:
+            return None
+        return self.run_config.resolved_storage_path()
+
+    def _state_file(self) -> Optional[str]:
+        path = self.experiment_path
+        return os.path.join(path, "tuner_state.pkl") if path else None
+
+    def _save_state(self, trials: List[Trial]) -> None:
+        state_file = self._state_file()
+        if state_file is None:
+            return
+        # only snapshot when something actually changed (a long event
+        # loop otherwise rewrites identical state every ~0.1s tick)
+        sig = tuple((t.id, t.status, len(t.results)) for t in trials)
+        if sig == getattr(self, "_last_sig", None):
+            return
+        self._last_sig = sig
+        import cloudpickle
+
+        os.makedirs(os.path.dirname(state_file), exist_ok=True)
+        try:
+            searcher_blob = cloudpickle.dumps(self.cfg.search_alg)
+        except Exception:
+            searcher_blob = None
+        blob = cloudpickle.dumps({
+            "metric": self.cfg.metric, "mode": self.cfg.mode,
+            "num_samples": self.cfg.num_samples,
+            "searcher": searcher_blob,
+            "trials": [t.snapshot() for t in trials]})
+        tmp = state_file + ".tmp"
+        with open(tmp, "wb") as f:   # atomic: a crash never half-writes
+            f.write(blob)
+        os.replace(tmp, state_file)
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable) -> "Tuner":
+        """Rebuild a killed experiment from its state snapshots. Finished
+        trials keep their results; unfinished ones re-run from their last
+        reported checkpoint. ``trainable`` must be the same callable the
+        experiment was built with (functions don't round-trip through the
+        snapshot, same as the reference's restore contract)."""
+        import cloudpickle
+
+        from ray_tpu.train.config import RunConfig
+
+        state_file = os.path.join(path, "tuner_state.pkl")
+        with open(state_file, "rb") as f:
+            state = cloudpickle.loads(f.read())
+        searcher = None
+        if state.get("searcher"):
+            try:
+                # the pickled searcher carries its observations, so an
+                # adaptive search (TPE) resumes where it left off
+                searcher = cloudpickle.loads(state["searcher"])
+            except Exception:
+                searcher = None
+        base, name = os.path.dirname(path), os.path.basename(path)
+        tuner = cls(trainable,
+                    tune_config=TuneConfig(metric=state["metric"],
+                                           mode=state["mode"],
+                                           num_samples=state["num_samples"],
+                                           search_alg=searcher),
+                    run_config=RunConfig(name=name, storage_path=base))
+        tuner._restored_trials = [Trial.from_snapshot(s)
+                                  for s in state["trials"]]
+        return tuner
+
+    @staticmethod
+    def can_restore(path: str) -> bool:
+        return os.path.exists(os.path.join(path, "tuner_state.pkl"))
 
     def fit(self) -> ResultGrid:
         scheduler = self.cfg.scheduler or FIFOScheduler()
         searcher = self.cfg.search_alg
-        if searcher is not None:
+        if self._restored_trials is not None:
+            trials = self._restored_trials
+            # searcher experiments: trials that were never created before
+            # the kill still owe their samples (trial count conserved)
+            to_create = (max(0, self.cfg.num_samples - len(trials))
+                         if searcher is not None else 0)
+        elif searcher is not None:
             searcher.set_search_space(self.param_space)
             trials: List[Trial] = []
             to_create = self.cfg.num_samples
@@ -162,7 +286,7 @@ class Tuner:
             1, int(ray_tpu.cluster_resources().get("CPU", 4)))
         actor_cls = ray_tpu.remote(_TrialActor)
 
-        pending = list(trials)
+        pending = [t for t in trials if t.status == "PENDING"]
         running: List[Trial] = []
         while pending or running or to_create > 0:
             # searcher-driven trials are created lazily as slots free, so
@@ -178,7 +302,7 @@ class Tuner:
                 trial = pending.pop(0)
                 trial.actor = actor_cls.options(max_concurrency=2).remote()
                 trial.run_ref = trial.actor.run.remote(
-                    self.trainable, trial.config)
+                    self.trainable, trial.config, trial.checkpoint)
                 trial.status = "RUNNING"
                 running.append(trial)
 
@@ -191,6 +315,8 @@ class Tuner:
                     entries = []
                 for entry in entries:
                     trial.results.append(entry["metrics"])
+                    if entry.get("checkpoint") is not None:
+                        trial.checkpoint = entry["checkpoint"]
                     if scheduler.on_result(trial, entry["metrics"]) == STOP:
                         trial.actor.stop.remote()
                         trial.status = "STOPPED"
@@ -208,6 +334,8 @@ class Tuner:
                             value = -float(value)
                         searcher.on_trial_complete(
                             getattr(trial, "search_id", ""), value)
+            self._save_state(trials)  # crash-resume snapshot per step
+        self._save_state(trials)
         return ResultGrid(trials=trials, metric=self.cfg.metric,
                           mode=self.cfg.mode)
 

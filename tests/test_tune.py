@@ -161,3 +161,99 @@ def test_halton_searcher_covers_space(ray_start_regular):
     # low-discrepancy: all four quartiles visited within 8 points
     quartiles = {min(int(t.config["x"] * 4), 3) for t in results.trials}
     assert {0, 1, 2, 3} <= quartiles
+
+
+def _restorable_trainable(config):
+    import os
+    import time
+
+    time.sleep(config.get("sleep", 0.4))
+    return {"score": config["x"], "run_pid": os.getpid()}
+
+
+def test_tuner_experiment_restore(tmp_path):
+    """Kill an experiment mid-flight; Tuner.restore resumes it with the
+    trial count conserved and finished results preserved (reference:
+    Tuner.restore + experiment state snapshots)."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time
+
+    import cloudpickle
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    exp_base = str(tmp_path)
+    state_file = os.path.join(exp_base, "exp", "tuner_state.pkl")
+    script = f"""
+import sys
+sys.path.insert(0, {repo!r})
+from ray_tpu._private.platform import force_cpu_platform
+force_cpu_platform(8)
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import TuneConfig, Tuner
+from ray_tpu.train.config import RunConfig
+from tests.test_tune import _restorable_trainable
+ray_tpu.init(num_nodes=1, resources={{"CPU": 4}})
+Tuner(_restorable_trainable,
+      param_space={{"x": tune.grid_search([1, 2, 3, 4])}},
+      tune_config=TuneConfig(metric="score", mode="max",
+                             max_concurrent_trials=1),
+      run_config=RunConfig(name="exp", storage_path={exp_base!r})).fit()
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    proc = subprocess.Popen([sys.executable, "-c", script], env=env,
+                            start_new_session=True)
+
+    # wait until >=1 trial TERMINATED but the experiment is not done
+    def load_state():
+        try:
+            with open(state_file, "rb") as f:
+                return cloudpickle.loads(f.read())
+        except Exception:
+            return None
+
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
+        state = load_state()
+        if state is not None:
+            done = [t for t in state["trials"]
+                    if t["status"] == "TERMINATED"]
+            if 1 <= len(done) < 4:
+                break
+        if proc.poll() is not None:
+            break
+        time.sleep(0.05)
+    assert proc.poll() is None, "experiment finished before the kill"
+    os.killpg(proc.pid, signal.SIGKILL)
+    proc.wait(timeout=10)
+
+    state = load_state()
+    finished_before = {t["id"] for t in state["trials"]
+                       if t["status"] == "TERMINATED"}
+    assert finished_before, "no finished trial before the kill"
+
+    # restore in THIS process and finish the experiment
+    from ray_tpu.tune import Tuner
+    exp_path = os.path.join(exp_base, "exp")
+    assert Tuner.can_restore(exp_path)
+    ray_tpu.init(num_nodes=1, resources={"CPU": 4},
+                 ignore_reinit_error=True)
+    try:
+        results = Tuner.restore(exp_path, _restorable_trainable).fit()
+        # trial count conserved: 4 grid points, no duplicates
+        assert len(results) == 4
+        scores = sorted(r.metrics["score"] for r in results)
+        assert scores == [1, 2, 3, 4]
+        assert not results.errors
+        # trials finished before the kill kept their ORIGINAL results
+        # (run in the killed subprocess, not re-run here)
+        by_id = {r.trial.id: r for r in results}
+        for tid in finished_before:
+            assert by_id[tid].metrics["run_pid"] != os.getpid()
+    finally:
+        ray_tpu.shutdown()
