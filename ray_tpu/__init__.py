@@ -45,13 +45,20 @@ def init(num_nodes: int = 1,
          object_store_memory: int = 2 * 1024 ** 3,
          namespace: Optional[str] = None,
          ignore_reinit_error: bool = False,
+         _system_config: Optional[Dict[str, Any]] = None,
          **kwargs) -> "_worker.Runtime":
-    """Start the runtime with ``num_nodes`` virtual nodes on this host."""
+    """Start the runtime with ``num_nodes`` virtual nodes on this host
+    (or join a running cluster with ``address="host:port"``).
+
+    ``_system_config`` overrides flags from the central table
+    (``ray_tpu/_private/config.py``, the ray_config_def.h role)."""
     if _worker.global_runtime() is not None:
         if ignore_reinit_error:
             return _worker.global_runtime()
         raise RuntimeError("ray_tpu.init() called twice "
                            "(use ignore_reinit_error=True to allow)")
+    from ray_tpu._private.config import apply_system_config
+    apply_system_config(_system_config)
     return _worker.init_runtime(
         num_nodes=num_nodes, resources_per_node=resources,
         object_store_memory=object_store_memory, namespace=namespace,

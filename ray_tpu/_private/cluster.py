@@ -44,8 +44,13 @@ declare("core_op", "call", "payload", "task")
 INLINE_RESULT = 100 * 1024
 
 
-def _spawn(module: str, args: List[str]) -> Tuple[subprocess.Popen, int]:
-    """Spawn a python -m <module> child; returns (proc, announced_port)."""
+def _spawn(module: str, args: List[str],
+           output_path: Optional[str] = None
+           ) -> Tuple[subprocess.Popen, int]:
+    """Spawn a python -m <module> child; returns (proc, announced_port).
+    ``output_path`` redirects the child's stdout/stderr to a file —
+    REQUIRED when the spawning process's own stdout is a pipe a caller
+    waits on (`ray-tpu start`), else the child holds the pipe open."""
     r, w = os.pipe()
     env = dict(os.environ)
     repo_root = os.path.dirname(os.path.dirname(
@@ -55,9 +60,17 @@ def _spawn(module: str, args: List[str]) -> Tuple[subprocess.Popen, int]:
     # Control-plane processes never own the accelerator.
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
-    proc = subprocess.Popen(
-        [sys.executable, "-m", module, *args, "--announce-fd", str(w)],
-        pass_fds=(w,), env=env, start_new_session=True)
+    out = None
+    if output_path is not None:
+        out = open(output_path, "ab")
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", module, *args, "--announce-fd", str(w)],
+            pass_fds=(w,), env=env, start_new_session=True,
+            stdout=out, stderr=out)
+    finally:
+        if out is not None:
+            out.close()
     os.close(w)
     with os.fdopen(r) as f:
         line = f.readline().strip()
@@ -375,6 +388,12 @@ class DaemonHandle:
                 pass
         self.mark_dead()
 
+    def detach(self) -> None:
+        """Disconnect from a daemon we did not spawn (joined cluster):
+        close the connection, leave the process running."""
+        self.mark_dead()
+        self.client.close()
+
 
 def out_is_final(out) -> bool:
     return out is None or out.get("outcome") != "gen"
@@ -538,6 +557,8 @@ class ClusterBackend:
         object_store_bytes = max(object_store_bytes, 1 << 20)
         self.runtime = runtime
         self.arenas = ArenaCache()
+        self._owns_cluster = True   # we spawned head+daemons; we stop them
+        self.node_resources: Dict[NodeID, Dict[str, float]] = {}
         self.session_dir = tempfile.mkdtemp(prefix="ray_tpu_session_")
         self._head_state = os.path.join(self.session_dir, "head_state.db")
         self.head_proc, self._head_port = _spawn(
@@ -570,6 +591,54 @@ class ClusterBackend:
             with self._lock:
                 self.daemons[node_id] = handle
         self.head.subscribe("node", self._on_node_event)
+
+    @classmethod
+    def attach(cls, runtime, address: str) -> "ClusterBackend":
+        """Join an EXISTING cluster (`ray-tpu start`) as a new driver:
+        connect to its head, discover registered daemons, and speak the
+        same wire protocol — nothing is spawned and shutdown() leaves the
+        cluster running (reference: a second driver connecting to a
+        `ray start` cluster, scripts.py:676)."""
+        import tempfile
+
+        self = cls.__new__(cls)
+        self.runtime = runtime
+        self.arenas = ArenaCache()
+        self._owns_cluster = False
+        self.node_resources = {}
+        self.session_dir = tempfile.mkdtemp(prefix="ray_tpu_driver_")
+        self._head_state = None
+        host, port = address.rsplit(":", 1)
+        self._head_port = int(port)
+        self.head_proc = None
+        self.head = HeadClient((host, self._head_port),
+                               reconnect_window=cls.HEAD_RECONNECT_S)
+        self._shutting_down = False
+        self.owner_server = Server(OwnerService(runtime)).start()
+        self.daemons: Dict[NodeID, DaemonHandle] = {}
+        self._lock = threading.Lock()
+        for info in self.head.list_nodes():
+            if not info["alive"]:
+                continue
+            node_id = NodeID.from_hex(info["node_id"])
+            try:
+                handle = DaemonHandle(node_id, tuple(info["addr"]), None,
+                                      self.arenas)
+                handle.hello(self.owner_server.addr, runtime.job_id,
+                             runtime.namespace)
+            except (OSError, rpc.RpcError, DaemonCrashed):
+                # listed alive but actually unreachable (died inside the
+                # heartbeat window): skip it, don't fail the whole join
+                continue
+            handle.on_actor_worker_died = self._make_actor_death_cb()
+            with self._lock:
+                self.daemons[node_id] = handle
+            self.node_resources[node_id] = dict(info["resources"])
+        if not self.daemons:
+            raise RuntimeError(
+                f"cluster at {address} has no alive nodes to join")
+        self.head.subscribe("node", self._on_node_event)
+        return self
 
     def _supervise_head(self) -> None:
         """Respawn a crashed head on the same port with the same state."""
@@ -638,16 +707,21 @@ class ClusterBackend:
             daemons = list(self.daemons.values())
             self.daemons.clear()
         for handle in daemons:
-            handle.stop()
-        try:
-            self.head.stop_head()
-        except Exception:
-            pass
+            if self._owns_cluster:
+                handle.stop()
+            else:       # joined cluster: just disconnect, don't kill
+                handle.detach()
+        if self._owns_cluster:
+            try:
+                self.head.stop_head()
+            except Exception:
+                pass
         self.head.close()
-        try:
-            self.head_proc.wait(timeout=2.0)
-        except subprocess.TimeoutExpired:
-            self.head_proc.kill()
+        if self.head_proc is not None and self._owns_cluster:
+            try:
+                self.head_proc.wait(timeout=2.0)
+            except subprocess.TimeoutExpired:
+                self.head_proc.kill()
         self.owner_server.stop()
         self.arenas.close()
         import shutil

@@ -1,0 +1,165 @@
+"""Central config/flag system.
+
+Reference capability: the ``RAY_CONFIG(type, name, default)`` X-macro
+table (``src/ray/common/ray_config_def.h`` — 219 flags), overridable
+per-process via ``RAY_<name>`` env vars and via the ``_system_config``
+dict passed at ``ray.init`` (``includes/ray_config.pxi``).
+
+Here every tunable lives in ONE declared table. Resolution order per
+flag (highest wins):
+
+1. ``_system_config={...}`` passed to ``ray_tpu.init``
+2. ``RAY_TPU_<NAME>`` environment variable
+3. the declared default
+
+Usage::
+
+    from ray_tpu._private.config import cfg
+    cfg().heartbeat_s            # typed value
+    cfg().describe()             # full table with provenance
+
+Subsystems that must read a flag before ``init`` (module import time)
+use ``cfg()`` lazily so a later ``_system_config`` is still honored by
+anything reading through the accessor.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+_PREFIX = "RAY_TPU_"
+
+
+def _parse_bool(raw: str) -> bool:
+    return raw.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+@dataclass(frozen=True)
+class Flag:
+    name: str               # lower_snake; env var is RAY_TPU_<UPPER>
+    type: Callable
+    default: Any
+    doc: str
+
+    @property
+    def env_var(self) -> str:
+        return _PREFIX + self.name.upper()
+
+
+# ---------------------------------------------------------------------------
+# THE flag table (ray_config_def.h role). Add new tunables here, not as
+# ad-hoc os.environ reads.
+# ---------------------------------------------------------------------------
+
+FLAG_DEFS = [
+    # -- cluster topology / processes --
+    Flag("cluster", str, "", "execution topology: '' = in-process virtual "
+         "nodes, 'daemons' = head + node-daemon OS processes"),
+    Flag("process_pool_size", int, 0, "idle worker-process pool target "
+         "(0 = auto: min(4, max(2, cpus//2)))"),
+    Flag("head_grace_s", float, 20.0, "how long daemons/drivers re-dial a "
+         "crashed head before giving up (head FT window)"),
+    # -- health / heartbeats --
+    Flag("heartbeat_interval_s", float, 0.2, "daemon->head heartbeat period"),
+    Flag("node_dead_after_s", float, 1.5, "missed-heartbeat window before "
+         "the head declares a node dead"),
+    # -- object plane --
+    Flag("native_store", bool, True, "use the C++ shm arena for large "
+         "objects (False = pure-dict store)"),
+    Flag("pull_chunk", int, 4 << 20, "inter-daemon object transfer chunk "
+         "size in bytes (object_buffer_pool role)"),
+    Flag("inline_object_size", int, 100 * 1024, "values <= this inline in "
+         "the owner memory store (max_direct_call_object_size role)"),
+    # -- memory monitor / OOM defense --
+    Flag("memory_monitor", bool, True, "enable the host-memory monitor + "
+         "worker-killing policies"),
+    Flag("memory_monitor_interval", float, 1.0,
+         "memory monitor check period (seconds)"),
+    Flag("memory_usage_threshold", float, 0.95,
+         "fraction of the limit at which the killer engages"),
+    Flag("memory_limit_bytes", int, 0, "explicit memory limit "
+         "(0 = detect from cgroup/system)"),
+    Flag("worker_killing_policy", str, "retriable_fifo",
+         "'retriable_fifo' or 'group_by_owner'"),
+    # -- logs --
+    Flag("log_to_driver", bool, True, "capture worker stdout/stderr to "
+         "per-pid files and tail them to the driver"),
+    Flag("log_dir", str, "", "worker log directory override"),
+    # -- bench --
+    Flag("bench_total_deadline", int, 540, "bench.py total wall-clock "
+         "budget (seconds)"),
+]
+
+FLAGS: Dict[str, Flag] = {f.name: f for f in FLAG_DEFS}
+
+
+class Config:
+    """Resolved flag values; refreshed when _system_config changes."""
+
+    def __init__(self, system_config: Optional[Dict[str, Any]] = None):
+        self._system = dict(system_config or {})
+        unknown = set(self._system) - set(FLAGS)
+        if unknown:
+            raise ValueError(
+                f"unknown _system_config keys: {sorted(unknown)}; "
+                f"known flags: {sorted(FLAGS)}")
+        self._values: Dict[str, Any] = {}
+        self._provenance: Dict[str, str] = {}
+        for flag in FLAG_DEFS:
+            if flag.name in self._system:
+                raw: Any = self._system[flag.name]
+                source = "_system_config"
+            elif flag.env_var in os.environ:
+                raw = os.environ[flag.env_var]
+                source = f"env:{flag.env_var}"
+            else:
+                raw = flag.default
+                source = "default"
+            if flag.type is bool and isinstance(raw, str):
+                value: Any = _parse_bool(raw)
+            else:
+                value = flag.type(raw)
+            self._values[flag.name] = value
+            self._provenance[flag.name] = source
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._values[name]
+        except KeyError:
+            raise AttributeError(f"no flag named {name!r}") from None
+
+    def describe(self) -> Dict[str, Dict[str, Any]]:
+        return {name: {"value": self._values[name],
+                       "source": self._provenance[name],
+                       "doc": FLAGS[name].doc}
+                for name in self._values}
+
+
+_lock = threading.Lock()
+_config: Optional[Config] = None
+
+
+def cfg() -> Config:
+    global _config
+    with _lock:
+        if _config is None:
+            _config = Config()
+        return _config
+
+
+def apply_system_config(system_config: Optional[Dict[str, Any]]) -> Config:
+    """Install the per-init overrides (called from ray_tpu.init)."""
+    global _config
+    with _lock:
+        _config = Config(system_config)
+        return _config
+
+
+def reset() -> None:
+    """Drop cached values (shutdown path; env changes re-resolve)."""
+    global _config
+    with _lock:
+        _config = None

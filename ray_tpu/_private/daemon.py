@@ -41,7 +41,7 @@ from typing import Any, Dict, Optional, Tuple
 import cloudpickle
 
 from ray_tpu._private import rpc
-from ray_tpu._private.head import HeadClient, HEARTBEAT_S
+from ray_tpu._private.head import HeadClient, _hb_interval
 from ray_tpu._private.ids import ActorID, NodeID, TaskID
 from ray_tpu._private.rpc import Client, Connection, Server, declare
 
@@ -204,7 +204,9 @@ PULL_PRIORITY_GET = 0
 PULL_PRIORITY_WAIT = 1
 PULL_PRIORITY_TASK_ARGS = 2
 
-PULL_CHUNK = int(os.environ.get("RAY_TPU_PULL_CHUNK", str(4 << 20)))
+def _pull_chunk() -> int:
+    from ray_tpu._private.config import cfg
+    return cfg().pull_chunk
 
 
 class _Pull:
@@ -236,10 +238,10 @@ class PullManager:
     """
 
     def __init__(self, objects: ObjectTable, peer_fn, num_workers: int = 2,
-                 chunk: int = PULL_CHUNK):
+                 chunk: Optional[int] = None):
         self.objects = objects
         self._peer = peer_fn        # addr -> rpc.Client
-        self.chunk = chunk
+        self.chunk = chunk if chunk is not None else _pull_chunk()
         self._cv = threading.Condition()
         self._heap: list = []
         self._seq = 0
@@ -370,9 +372,13 @@ class DaemonRuntime:
 
 class DaemonService:
     def __init__(self, node_id_hex: str, resources: Dict[str, float],
-                 object_store_bytes: int):
+                 object_store_bytes: int, persist: bool = False):
         self.node_id = NodeID.from_hex(node_id_hex)
         self.resources = resources
+        # persist=True (cluster started via `ray-tpu start`): survive
+        # driver disconnects and serve the next driver; False (driver-
+        # spawned session): die with the driver.
+        self.persist = persist
         self.objects = ObjectTable(f"rtpu_{node_id_hex[:12]}",
                                    object_store_bytes)
         self.owner: Optional[Client] = None
@@ -447,11 +453,47 @@ class DaemonService:
 
     def on_disconnect(self, conn: Connection) -> None:
         if conn is self.driver_conn:
+            if self.persist:
+                # Shared cluster (`ray-tpu start`): drop the departed
+                # driver's state and wait for the next one.
+                self._reset_for_new_driver()
+                return
             # Driver gone: this daemon's work is orphaned; exit like a
             # raylet whose GCS/driver session ended.
             threading.Thread(target=lambda: (time.sleep(0.2),
                                              os._exit(0)),
                              daemon=True).start()
+
+    def _reset_for_new_driver(self) -> None:
+        """Tear down the departed driver's leases/actors so the next
+        driver starts clean (its objects stay until arena pressure —
+        known cross-driver growth, bounded by the arena capacity)."""
+        self.driver_conn = None
+        old_owner, self.owner = self.owner, None
+        if old_owner is not None:
+            try:
+                old_owner.close()
+            except OSError:
+                pass
+        with self._lock:
+            leases = list(self._leases.values())
+            self._leases.clear()
+            self._task_rids.clear()
+            self._bundles.clear()
+        for client in leases:   # leased mid-task: state unknown, kill
+            try:
+                client.kill(expected=True)
+            except Exception:
+                pass
+        router = self.runtime.process_router
+        with router._lock:
+            actors = dict(router._actor_workers)
+            router._actor_workers.clear()
+        for client in actors.values():
+            try:
+                client.kill(expected=True)
+            except Exception:
+                pass
 
     # -- worker lease protocol ------------------------------------------
     def handle_request_worker_lease(self, conn, rid, msg):
@@ -819,12 +861,15 @@ def main() -> None:
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--object-store-bytes", type=int,
                         default=256 * 1024 * 1024)
+    parser.add_argument("--persist", action="store_true",
+                        help="survive driver disconnects (shared cluster)")
     parser.add_argument("--announce-fd", type=int, default=-1)
     args = parser.parse_args()
 
     resources = json.loads(args.resources)
     service = DaemonService(args.node_id, resources,
-                            args.object_store_bytes)
+                            args.object_store_bytes,
+                            persist=args.persist)
     server = Server(service, host=args.host, port=0).start()
     if args.announce_fd >= 0:
         os.write(args.announce_fd, f"{server.addr[1]}\n".encode())
@@ -840,7 +885,8 @@ def main() -> None:
     # gcs_init_data.h): on transport failure keep re-dialing the head for
     # a grace window and re-register; only a head that stays down — or
     # one that explicitly declares us dead — ends the session.
-    grace = float(os.environ.get("RAY_TPU_HEAD_GRACE_S", "20"))
+    from ray_tpu._private.config import cfg
+    grace = cfg().head_grace_s
 
     def reconnect() -> "HeadClient | None":
         deadline = time.monotonic() + grace
@@ -855,7 +901,7 @@ def main() -> None:
         return None
 
     while True:  # heartbeat loop; exit if the head declared us dead
-        time.sleep(HEARTBEAT_S)
+        time.sleep(_hb_interval())
         try:
             out = head.heartbeat(args.node_id, resources)
         except rpc.RpcError:

@@ -44,8 +44,18 @@ import msgpack
 from ray_tpu._private import rpc
 from ray_tpu._private.rpc import HOLD, Client, Connection, Server, declare
 
+def _hb_interval() -> float:
+    from ray_tpu._private.config import cfg
+    return cfg().heartbeat_interval_s
+
+
+def _dead_after() -> float:
+    from ray_tpu._private.config import cfg
+    return cfg().node_dead_after_s
+
+
+# back-compat names (resolved through the central flag table)
 HEARTBEAT_S = 0.2
-DEAD_AFTER_S = 1.5
 
 declare("register_node", "node_id", "resources", "labels", "addr")
 declare("heartbeat", "node_id", "available")
@@ -196,12 +206,13 @@ class HeadService:
                                "reason": reason})
 
     def _health_loop(self) -> None:
-        while not self._stop.wait(HEARTBEAT_S):
+        while not self._stop.wait(_hb_interval()):
             now = time.monotonic()
             dead: List[str] = []
+            window = _dead_after()
             with self._lock:
                 for entry in self._nodes.values():
-                    if entry.alive and now - entry.last_beat > DEAD_AFTER_S:
+                    if entry.alive and now - entry.last_beat > window:
                         dead.append(entry.node_id)
             for node_id in dead:
                 self._mark_dead(node_id, "missed heartbeats")

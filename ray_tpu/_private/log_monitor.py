@@ -33,7 +33,8 @@ _session_lock = threading.Lock()
 
 
 def log_to_driver_enabled() -> bool:
-    return os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0"
+    from ray_tpu._private.config import cfg
+    return cfg().log_to_driver
 
 
 def session_log_dir(create: bool = True) -> Optional[str]:
@@ -41,7 +42,8 @@ def session_log_dir(create: bool = True) -> Optional[str]:
     global _session_dir
     with _session_lock:
         if _session_dir is None and create:
-            _session_dir = os.environ.get("RAY_TPU_LOG_DIR") or \
+            from ray_tpu._private.config import cfg
+            _session_dir = cfg().log_dir or \
                 tempfile.mkdtemp(prefix="ray_tpu_logs_")
             os.makedirs(_session_dir, exist_ok=True)
         return _session_dir

@@ -24,10 +24,15 @@ import threading
 import time
 from typing import Any, List, Optional
 
-CHECK_INTERVAL_S = float(os.environ.get("RAY_TPU_MEMORY_MONITOR_INTERVAL",
-                                        "1.0"))
-USAGE_THRESHOLD = float(os.environ.get("RAY_TPU_MEMORY_USAGE_THRESHOLD",
-                                       "0.95"))
+def _flag(name):
+    from ray_tpu._private.config import cfg
+    return getattr(cfg(), name)
+
+
+# kept as module names for back-compat; resolved through the central
+# flag table (ray_tpu/_private/config.py, ray_config_def.h role)
+CHECK_INTERVAL_S = None   # -> cfg().memory_monitor_interval
+USAGE_THRESHOLD = None    # -> cfg().memory_usage_threshold
 
 
 def _cgroup_limit() -> Optional[int]:
@@ -158,17 +163,18 @@ class MemoryMonitor:
     def __init__(self, runtime, limit_bytes: Optional[int] = None,
                  threshold: float = USAGE_THRESHOLD,
                  policy: Optional[Any] = None,
-                 interval_s: float = CHECK_INTERVAL_S):
+                 interval_s: Optional[float] = None):
         self.runtime = runtime
-        self.limit = limit_bytes or int(
-            os.environ.get("RAY_TPU_MEMORY_LIMIT_BYTES", "0")) or \
+        self.limit = limit_bytes or _flag("memory_limit_bytes") or \
             system_memory_limit()
-        self.threshold = threshold
+        self.threshold = threshold if threshold is not None \
+            else _flag("memory_usage_threshold")
         self.policy = policy or (
             GroupByOwnerPolicy()
-            if os.environ.get("RAY_TPU_WORKER_KILLING_POLICY")
-            == "group_by_owner" else RetriableFIFOPolicy())
-        self.interval_s = interval_s
+            if _flag("worker_killing_policy") == "group_by_owner"
+            else RetriableFIFOPolicy())
+        self.interval_s = (interval_s if interval_s is not None
+                           else _flag("memory_monitor_interval"))
         self.kills = 0
         self.oom_killed_tasks: set = set()
         self.oom_killed_actors: set = set()

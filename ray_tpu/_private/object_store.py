@@ -130,7 +130,8 @@ class LocalObjectStore:
         # Native C++ shm tier (plasma equivalent): holds large numpy
         # payloads as zero-copy mmap views. Optional — absent without g++.
         self._native = None
-        if os.environ.get("RAY_TPU_NATIVE_STORE", "1") != "0":
+        from ray_tpu._private.config import cfg
+        if cfg().native_store:
             try:
                 from ray_tpu.native_store import ShmObjectStore, available
                 if available():

@@ -136,7 +136,8 @@ class Runtime:
                  object_store_memory: int = 2 * 1024 ** 3,
                  namespace: Optional[str] = None,
                  session_dir: Optional[str] = None,
-                 cluster: Optional[str] = None):
+                 cluster: Optional[str] = None,
+                 address: Optional[str] = None):
         self.job_id = JobID.from_random()
         self.worker_id = WorkerID.from_random()
         self.namespace = namespace or self.job_id.hex()
@@ -209,15 +210,25 @@ class Runtime:
         # never a victim.
         from ray_tpu._private.memory_monitor import MemoryMonitor
         self.memory_monitor = MemoryMonitor(self)
-        if os.environ.get("RAY_TPU_MEMORY_MONITOR", "1") != "0":
+        from ray_tpu._private.config import cfg
+        if cfg().memory_monitor:
             self.memory_monitor.start()
 
         if resources_per_node is None:
             resources_per_node = self._detect_resources()
         self.cluster_backend = None
         if cluster is None:
-            cluster = os.environ.get("RAY_TPU_CLUSTER") or None
-        if cluster == "daemons":
+            cluster = cfg().cluster or None
+        if address:
+            # Join an EXISTING `ray-tpu start` cluster as a new driver
+            # (reference: ray.init(address=...) against a running GCS).
+            from ray_tpu._private.cluster import ClusterBackend
+            backend = ClusterBackend.attach(self, address)
+            self.cluster_backend = backend
+            for node_id, handle in backend.daemons.items():
+                self.add_remote_node(
+                    handle, dict(backend.node_resources[node_id]))
+        elif cluster == "daemons":
             # Real head + node-daemon OS processes behind the wire
             # protocol; every schedulable node is a daemon. In-process /
             # accelerator work still executes driver-side, on the
@@ -1643,6 +1654,8 @@ def init_runtime(**kwargs) -> Runtime:
 
 
 def shutdown_runtime() -> None:
+    from ray_tpu._private.config import reset as _cfg_reset
+    _cfg_reset()
     global _global_runtime
     with _global_lock:
         if _global_runtime is not None:

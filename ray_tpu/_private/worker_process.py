@@ -1091,8 +1091,9 @@ _POOL_CLOSED = threading.Event()   # interpreter exiting: no new spawns
 
 
 def _pool_target() -> int:
-    return int(os.environ.get("RAY_TPU_PROCESS_POOL_SIZE",
-                              str(min(4, max(2, (os.cpu_count() or 4) // 2)))))
+    from ray_tpu._private.config import cfg
+    n = cfg().process_pool_size
+    return n if n > 0 else min(4, max(2, (os.cpu_count() or 4) // 2))
 
 
 def _make_boot() -> Dict[str, Any]:

@@ -1,12 +1,23 @@
-"""`ray-tpu` CLI (reference: `python/ray/scripts/scripts.py` — status,
-memory, timeline, microbenchmark; `ray job` CLI in
-`dashboard/modules/job/cli.py`)."""
+"""`ray-tpu` CLI (reference: `python/ray/scripts/scripts.py` —
+start:676 / stop / status, memory, timeline, microbenchmark; `ray job`
+CLI in `dashboard/modules/job/cli.py`).
+
+Cluster lifecycle: ``ray-tpu start --head`` stands up a head + node
+daemons as persistent OS processes (daemons survive driver disconnects);
+any driver joins with ``ray_tpu.init(address="host:port")``; ``ray-tpu
+stop`` tears the cluster down. The address of the last locally started
+cluster is recorded in ``/tmp/ray_tpu/current_cluster.json`` so
+``stop``/``status`` work without arguments.
+"""
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+CLUSTER_FILE = "/tmp/ray_tpu/current_cluster.json"
 
 
 def _init_runtime(args):
@@ -79,6 +90,148 @@ def cmd_dashboard(args) -> int:
         return 0
 
 
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None)
+    if addr:
+        return addr
+    try:
+        with open(CLUSTER_FILE) as f:
+            return json.load(f)["address"]
+    except (OSError, KeyError, ValueError):
+        raise SystemExit(
+            "no --address given and no local cluster recorded "
+            f"({CLUSTER_FILE}); start one with `ray-tpu start --head`")
+
+
+def cmd_start(args) -> int:
+    """Stand up a persistent head + N node daemons (scripts.py:676)."""
+    if not args.head:
+        raise SystemExit("only --head mode is supported: pass --head "
+                         "(joining remote workers use the daemon module "
+                         "with --head host:port directly)")
+    from ray_tpu._private.cluster import _spawn
+    from ray_tpu._private.ids import NodeID
+
+    session = os.path.join("/tmp", "ray_tpu",
+                           f"cluster_{os.getpid()}")
+    os.makedirs(session, exist_ok=True)
+    head_args = ["--state-path", os.path.join(session, "head_state.db")]
+    if args.port:
+        head_args += ["--port", str(args.port)]
+    head_proc, head_port = _spawn(
+        "ray_tpu._private.head", head_args,
+        output_path=os.path.join(session, "head.log"))
+    address = f"127.0.0.1:{head_port}"
+
+    resources = args.resources or json.dumps(
+        {"CPU": float(os.cpu_count() or 4)})
+    daemon_pids = []
+    for _ in range(args.num_daemons):
+        proc, _port = _spawn("ray_tpu._private.daemon", [
+            "--head", address,
+            "--node-id", NodeID.from_random().hex(),
+            "--resources", resources,
+            "--object-store-bytes", str(args.object_store_bytes),
+            "--persist",
+        ], output_path=os.path.join(session, "daemon.log"))
+        daemon_pids.append(proc.pid)
+
+    os.makedirs(os.path.dirname(CLUSTER_FILE), exist_ok=True)
+    with open(CLUSTER_FILE, "w") as f:
+        json.dump({"address": address, "head_pid": head_proc.pid,
+                   "daemon_pids": daemon_pids, "session": session}, f)
+    print(f"ray_tpu cluster started at {address} "
+          f"({args.num_daemons} daemons)")
+    print(f'connect with: ray_tpu.init(address="{address}")')
+    if not args.block:
+        return 0
+    # --block: stay up and respawn a crashed head on the same port
+    import time
+    try:
+        while True:
+            time.sleep(0.5)
+            if head_proc.poll() is not None:
+                try:
+                    head_proc, _ = _spawn(
+                        "ray_tpu._private.head",
+                        ["--state-path",
+                         os.path.join(session, "head_state.db"),
+                         "--port", str(head_port)],
+                        output_path=os.path.join(session, "head.log"))
+                except (RuntimeError, OSError):
+                    continue
+                try:   # keep stop's pid fallback pointing at the LIVE head
+                    with open(CLUSTER_FILE) as f:
+                        rec = json.load(f)
+                    rec["head_pid"] = head_proc.pid
+                    with open(CLUSTER_FILE, "w") as f:
+                        json.dump(rec, f)
+                except (OSError, ValueError):
+                    pass
+    except KeyboardInterrupt:
+        return cmd_stop(args)
+
+
+def cmd_stop(args) -> int:
+    """Tear down the cluster recorded in the cluster file (or at
+    --address): stop every registered daemon, then the head."""
+    import signal
+
+    address = _resolve_address(args)
+    host, port = address.rsplit(":", 1)
+    from ray_tpu._private import rpc
+    from ray_tpu._private.head import HeadClient
+    from ray_tpu._private.rpc import Client
+
+    stopped = 0
+    try:
+        head = HeadClient((host, int(port)))
+        for info in head.list_nodes():
+            if not info["alive"]:
+                continue
+            try:
+                Client(tuple(info["addr"]), timeout=5.0).call(
+                    "daemon_stop", timeout=2.0)
+                stopped += 1
+            except (rpc.RpcError, OSError):
+                pass
+        head.stop_head()
+        head.close()
+    except (OSError, rpc.RpcError):
+        # head already gone: fall back to recorded pids
+        try:
+            with open(CLUSTER_FILE) as f:
+                rec = json.load(f)
+            for pid in [rec.get("head_pid"), *rec.get("daemon_pids", [])]:
+                if pid:
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except (OSError, ProcessLookupError):
+                        pass
+        except (OSError, ValueError):
+            pass
+    try:
+        os.unlink(CLUSTER_FILE)
+    except OSError:
+        pass
+    print(f"stopped cluster at {address} ({stopped} daemons)")
+    return 0
+
+
+def cmd_cluster_status(args) -> int:
+    """Membership of a running cluster (no runtime init)."""
+    address = _resolve_address(args)
+    host, port = address.rsplit(":", 1)
+    from ray_tpu._private.head import HeadClient
+
+    head = HeadClient((host, int(port)))
+    nodes = head.list_nodes()
+    head.close()
+    print(json.dumps({"address": address, "nodes": nodes}, indent=2,
+                     default=str))
+    return 0
+
+
 def cmd_job_submit(args) -> int:
     _init_runtime(args)
     from ray_tpu.job import JobSubmissionClient
@@ -96,6 +249,20 @@ def main(argv=None) -> int:
     parser.add_argument("--num-nodes", type=int, default=1)
     sub = parser.add_subparsers(dest="command", required=True)
 
+    p = sub.add_parser("start")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-daemons", type=int, default=2)
+    p.add_argument("--resources", default="",
+                   help="JSON resource map per daemon")
+    p.add_argument("--object-store-bytes", type=int,
+                   default=256 * 1024 * 1024)
+    p.add_argument("--block", action="store_true",
+                   help="stay attached; supervise the head")
+    p = sub.add_parser("stop")
+    p.add_argument("--address", default="")
+    p = sub.add_parser("cluster-status")
+    p.add_argument("--address", default="")
     sub.add_parser("status")
     sub.add_parser("summary")
     sub.add_parser("memory")
@@ -111,6 +278,8 @@ def main(argv=None) -> int:
 
     args = parser.parse_args(argv)
     handler = {
+        "start": cmd_start, "stop": cmd_stop,
+        "cluster-status": cmd_cluster_status,
         "status": cmd_status, "summary": cmd_summary,
         "memory": cmd_memory, "timeline": cmd_timeline,
         "microbenchmark": cmd_microbenchmark, "dashboard": cmd_dashboard,

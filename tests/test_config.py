@@ -1,0 +1,54 @@
+"""Central config/flag system (reference: ray_config_def.h RAY_CONFIG
+table + _system_config plumbing via ray.init)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import FLAGS, Config, cfg
+
+
+def test_flag_table_defaults():
+    c = Config()
+    assert c.pull_chunk == 4 << 20
+    assert c.memory_monitor is True
+    assert c.worker_killing_policy == "retriable_fifo"
+    d = c.describe()
+    assert set(d) == set(FLAGS)
+    assert all(v["source"] == "default" or v["source"].startswith("env:")
+               for v in d.values())
+
+
+def test_env_override_and_bool_parsing(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_PULL_CHUNK", str(1 << 20))
+    monkeypatch.setenv("RAY_TPU_MEMORY_MONITOR", "false")
+    c = Config()
+    assert c.pull_chunk == 1 << 20
+    assert c.memory_monitor is False
+    assert c.describe()["pull_chunk"]["source"] == "env:RAY_TPU_PULL_CHUNK"
+
+
+def test_system_config_wins_over_env(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_PULL_CHUNK", str(1 << 20))
+    c = Config({"pull_chunk": 2 << 20})
+    assert c.pull_chunk == 2 << 20
+    assert c.describe()["pull_chunk"]["source"] == "_system_config"
+
+
+def test_unknown_flag_rejected():
+    with pytest.raises(ValueError, match="unknown _system_config"):
+        Config({"not_a_flag": 1})
+
+
+def test_init_applies_and_shutdown_resets():
+    rt = ray_tpu.init(num_nodes=1, resources={"CPU": 2},
+                      _system_config={"pull_chunk": 1 << 20})
+    try:
+        assert cfg().pull_chunk == 1 << 20
+    finally:
+        ray_tpu.shutdown()
+    assert cfg().pull_chunk == 4 << 20
+
+
+def test_unknown_attr_raises():
+    with pytest.raises(AttributeError):
+        Config().definitely_not_a_flag
