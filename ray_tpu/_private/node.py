@@ -85,6 +85,21 @@ class ResourceLedger:
             self._cond.notify_all()
 
 
+class _DirectOp:
+    """Closure queued on an ActorExecutor by a compiled DAG.
+
+    ``on_dead(cause)`` is invoked when the actor dies with the op still
+    queued, so the DAG's channel fails promptly instead of timing out.
+    """
+
+    __slots__ = ("fn", "on_dead")
+
+    def __init__(self, fn: Callable[[Any], None],
+                 on_dead: Optional[Callable[[str], None]] = None):
+        self.fn = fn
+        self.on_dead = on_dead
+
+
 class ActorExecutor:
     """Executes one actor's tasks: FIFO by seqno, optional concurrency/async.
 
@@ -140,6 +155,26 @@ class ActorExecutor:
         name = getattr(spec, "concurrency_group", "") or ""
         return name if name in self._groups else ""
 
+    def submit_direct(self, fn: Callable[[Any], None],
+                      on_dead: Optional[Callable[[str], None]] = None
+                      ) -> bool:
+        """Compiled-graph channel op (reference: the per-actor exec loop
+        of ``compiled_dag_node.py:809``): run ``fn(instance)`` on this
+        actor's executor thread, FIFO-ordered with normal method calls,
+        WITHOUT the task-submission machinery (no TaskSpec, scheduler,
+        futures, or refcounting on the per-call path)."""
+        from ray_tpu._private.ids import next_seqno
+        with self._cond:
+            if self._dead or self.is_async:
+                return False
+            self._push_seq += 1
+            heapq.heappush(self._groups[""]["heap"],
+                           (next_seqno(), self._push_seq,
+                            _DirectOp(fn, on_dead)))
+            self.num_pending += 1
+            self._cond.notify_all()
+        return True
+
     def submit(self, spec: TaskSpec) -> bool:
         with self._cond:
             if self._dead:
@@ -160,12 +195,20 @@ class ActorExecutor:
                 return []
             self._dead = True
             self.death_cause = cause
-            pending = [spec for g in self._groups.values()
+            dropped = [spec for g in self._groups.values()
                        for _, _, spec in g["heap"]]
+            pending = [s for s in dropped if not isinstance(s, _DirectOp)]
+            direct_ops = [s for s in dropped if isinstance(s, _DirectOp)]
             for g in self._groups.values():
                 g["heap"].clear()
             self.num_pending = 0
             self._cond.notify_all()
+        for op in direct_ops:   # fail compiled-DAG channels promptly
+            if op.on_dead is not None:
+                try:
+                    op.on_dead(cause)
+                except Exception:
+                    pass
         if self._loop is not None:
             try:
                 self._loop.call_soon_threadsafe(self._loop.stop)
@@ -202,6 +245,12 @@ class ActorExecutor:
             spec = self._next(group)
             if spec is None:
                 return
+            if isinstance(spec, _DirectOp):
+                try:
+                    spec.fn(self.instance)
+                except Exception:   # op delivers errors via its channel
+                    pass
+                continue
             self._run_task(spec, self.instance)
 
     def _async_main(self) -> None:

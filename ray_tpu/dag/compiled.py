@@ -51,8 +51,50 @@ def _run_schedule(order: List[DAGNode], root: DAGNode,
     return resolved[root.id]
 
 
+class _Slot:
+    """One edge's value channel for one execution (the shm-mutable-object
+    role of ``experimental_mutable_object_manager.h:44`` collapsed to an
+    in-process slot: the compiled data plane never touches the object
+    store)."""
+
+    __slots__ = ("_event", "_value", "_error")
+
+    def __init__(self):
+        import threading
+
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+
+    def put(self, value) -> None:
+        self._value = value
+        self._event.set()
+
+    def put_error(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+
+    def get(self, timeout: float = 300.0):
+        if not self._event.wait(timeout):
+            raise TimeoutError("compiled DAG channel read timed out")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
 class CompiledDAG:
-    """Pre-computed schedule: execute() replays it without traversal."""
+    """Pre-computed schedule with pre-bound channels.
+
+    Reference: ``CompiledDAG`` (`compiled_dag_node.py:809`) — at compile
+    time the schedule and channels are fixed; per execute() nothing goes
+    through the scheduler. Here, when every compute node is a method on
+    an in-process sync actor, each node becomes a *direct op* queued on
+    its actor's executor thread: values flow actor→actor through
+    ``_Slot`` channels (plain objects, no object store), each actor's
+    executor pipelines its stage, and only the FINAL result is sealed
+    into an ObjectRef for the caller. DAGs with task nodes, async
+    actors, or daemon-remote actors fall back to the dynamic schedule.
+    """
 
     def __init__(self, root: DAGNode):
         self.root = root
@@ -63,11 +105,127 @@ class CompiledDAG:
         if n_inputs > 1:
             raise ValueError("compiled DAGs support a single InputNode")
         self._teardown = False
+        self._executors = self._bind_executors()
+
+    def _bind_executors(self):
+        """Channel mode iff every compute node is a sync in-process actor
+        method; returns {node_id: (executor, bound_method_name)}."""
+        from ray_tpu._private import worker
+
+        rt = worker.global_runtime()
+        if rt is None:
+            return None
+        bound = {}
+        for node in self.schedule:
+            if isinstance(node, (InputNode, MultiOutputNode)):
+                continue
+            if not isinstance(node, ClassMethodNode):
+                return None         # task node: dynamic fallback
+            actor_id = node.actor_handle._actor_id
+            if actor_id in rt._remote_actors:
+                return None         # daemon-hosted actor
+            # Actor creation is async; compile blocks until the actor is
+            # live (reference: experimental_compile waits on actors).
+            import time as _time
+
+            deadline = _time.monotonic() + 30.0
+            executor = None
+            while _time.monotonic() < deadline:
+                with rt._actor_lock:
+                    executor = rt._actor_executors.get(actor_id)
+                if executor is not None and executor.instance is not None:
+                    break
+                if actor_id in rt._remote_actors:
+                    return None
+                _time.sleep(0.01)
+            if (executor is None or executor.is_async
+                    or executor.instance is None):
+                return None
+            instance = executor.instance
+            from ray_tpu._private.worker_process import \
+                _ProcessActorInstance
+            if isinstance(instance, _ProcessActorInstance):
+                return None         # worker-process actor: fallback
+            bound[node.id] = executor
+        return bound or None
 
     def execute(self, *args):
         if self._teardown:
             raise RuntimeError("compiled DAG was torn down")
-        return _run_schedule(self.schedule, self.root, args)
+        if self._executors is None:
+            return _run_schedule(self.schedule, self.root, args)
+        return self._execute_channels(args)
+
+    def _execute_channels(self, args):
+        import threading
+
+        from ray_tpu import exceptions as exc
+        from ray_tpu._private import worker
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu._private.object_ref import ObjectRef
+
+        rt = worker.global_worker()
+        slots = {node.id: _Slot() for node in self.schedule}
+
+        def read(arg):
+            if isinstance(arg, DAGNode):
+                return slots[arg.id].get()
+            if isinstance(arg, ObjectRef):
+                # parity with the dynamic path: refs resolve to values
+                return rt.get([arg])[0]
+            return arg
+
+        for node in self.schedule:
+            if isinstance(node, InputNode):
+                if not args:
+                    raise ValueError("DAG has an InputNode but execute() "
+                                     "got no argument")
+                value = args[0]
+                if isinstance(value, ObjectRef):
+                    value = rt.get([value])[0]
+                slots[node.id].put(value)
+            elif isinstance(node, MultiOutputNode):
+                continue            # gathered by the finisher
+            else:
+                def op(instance, node=node):
+                    slot = slots[node.id]
+                    try:
+                        vals = [read(a) for a in node.args]
+                        kw = {k: read(v) for k, v in node.kwargs.items()}
+                        method = getattr(instance, node.method_name)
+                        slot.put(method(*vals, **kw))
+                    except BaseException as e:  # noqa: BLE001 — to slot
+                        slot.put_error(e)
+
+                def on_dead(cause, node=node):
+                    slots[node.id].put_error(exc.ActorDiedError(
+                        node.actor_handle._actor_id, cause))
+
+                if not self._executors[node.id].submit_direct(
+                        op, on_dead=on_dead):
+                    raise RuntimeError(
+                        "compiled DAG actor is dead; rebuild the DAG")
+
+        # The caller gets a normal ObjectRef; only the FINAL value is
+        # sealed into the store (reference: execute() returns a ref).
+        oid = ObjectID.from_random()
+        ref = ObjectRef(oid, owner_hex=rt.worker_id.hex(),
+                        task_name="compiled_dag")
+
+        def finish():
+            try:
+                if isinstance(self.root, MultiOutputNode):
+                    value = [slots[o.id].get() for o in self.root.args]
+                else:
+                    value = slots[self.root.id].get()
+                rt._store_value(oid, value)
+            except BaseException as e:  # noqa: BLE001 — shipped to ref
+                rt._store_value(oid, exc.TaskError(e, "compiled_dag"))
+            rt.futures.complete(oid)
+
+        threading.Thread(target=finish, daemon=True,
+                         name="compiled-dag-finish").start()
+        return ref
 
     def teardown(self) -> None:
         self._teardown = True
