@@ -73,3 +73,53 @@ def run_microbenchmarks(duration_s: float = 2.0) -> List[Dict]:
     if own:
         ray_tpu.shutdown()
     return results
+
+
+def queued_task_drain(n: int = 10_000) -> Dict:
+    """Scale envelope probe (reference: release/benchmarks/README.md:25-31
+    — 1M+ tasks queued on one node): submit ``n`` no-op tasks without
+    consuming, then drain them all."""
+    import ray_tpu
+
+    own = not ray_tpu.is_initialized()
+    if own:
+        ray_tpu.init(num_nodes=1, resources={"CPU": 8})
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n)]
+    t_submit = time.perf_counter() - t0
+    ray_tpu.get(refs)
+    t_total = time.perf_counter() - t0
+    if own:
+        ray_tpu.shutdown()
+    return {"name": f"queued_{n}_task_drain",
+            "n": n,
+            "submit_seconds": round(t_submit, 3),
+            "total_seconds": round(t_total, 3),
+            "submit_per_s": round(n / t_submit, 1),
+            "drain_per_s": round(n / t_total, 1)}
+
+
+def main() -> int:
+    """Emit one JSON line per benchmark for the current mode (set
+    RAY_TPU_CLUSTER=daemons for cluster mode); used by tools/gen_perf.py
+    to produce the committed PERF.md."""
+    import json
+    import os
+    import sys
+
+    duration = float(os.environ.get("PERF_DURATION_S", "2.0"))
+    drain_n = int(os.environ.get("PERF_DRAIN_N", "10000"))
+    for row in run_microbenchmarks(duration_s=duration):
+        print(json.dumps(row))
+        sys.stdout.flush()
+    print(json.dumps(queued_task_drain(drain_n)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

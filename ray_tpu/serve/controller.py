@@ -276,6 +276,14 @@ class ServeController:
 
     def _loop(self) -> None:
         while not self._stop.wait(self._tick_s):
+            # The runtime can shut down underneath this daemon thread
+            # (test teardown without serve.shutdown()): stop quietly
+            # instead of racing replica creation against teardown.
+            from ray_tpu._private import worker as _worker
+
+            rt = _worker.global_runtime()
+            if rt is None or getattr(rt, "_shutdown", False):
+                return
             try:
                 for name in list(self._state):
                     self._check_health(name)
@@ -284,6 +292,8 @@ class ServeController:
                 if self._compact_counter % 20 == 0:
                     self._maybe_compact()
             except Exception:
+                if _worker.global_runtime() is None:
+                    return  # teardown race, not a real failure
                 traceback.print_exc()
 
     def _maybe_compact(self) -> None:

