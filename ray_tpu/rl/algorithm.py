@@ -87,6 +87,21 @@ class Algorithm:
                                       **config.learner_kwargs)
             policy_factory = lambda: QPolicy(  # noqa: E731
                 obs_dim, n_actions, seed=config.seed)
+        elif config.algo.upper() == "IMPALA":
+            from ray_tpu.rl.impala import ImpalaLearner
+            from ray_tpu.rl.ppo import ActorCriticPolicy
+            self.learner = ImpalaLearner(obs_dim, n_actions,
+                                         seed=config.seed,
+                                         **config.learner_kwargs)
+            policy_factory = lambda: ActorCriticPolicy(  # noqa: E731
+                obs_dim, n_actions, seed=config.seed)
+        elif config.algo.upper() == "SAC":
+            from ray_tpu.rl.sac import SACLearner, SACPolicy
+            self.learner = SACLearner(obs_dim, n_actions,
+                                      seed=config.seed,
+                                      **config.learner_kwargs)
+            policy_factory = lambda: SACPolicy(  # noqa: E731
+                obs_dim, n_actions, seed=config.seed)
         else:
             raise ValueError(f"unknown algo {config.algo!r}")
 
@@ -112,14 +127,53 @@ class Algorithm:
             for i in range(config.num_env_runners)]
         self._sync_weights()
         self.iteration = 0
+        # IMPALA: one sample per runner stays permanently in flight
+        # (the async actor-learner queue); refs survive across train()
+        # calls.
+        self._in_flight: Dict[Any, Any] = {}
 
     def _sync_weights(self) -> None:
         w = ray_tpu.put(self.learner.get_weights())
         ray_tpu.get([r.set_weights.remote(w) for r in self.runners])
 
+    def _train_async(self) -> Dict[str, Any]:
+        """IMPALA iteration: process fragments AS THEY LAND (no barrier).
+        Each runner keeps one sample in flight; the learner updates per
+        fragment and pushes fresh weights only to the runner that just
+        delivered (reference: IMPALA's actor-learner queue — samplers
+        run on stale weights, V-trace corrects the lag)."""
+        cfg = self.config
+        if not self._in_flight:
+            self._in_flight = {
+                r.sample.remote(cfg.rollout_fragment_length): r
+                for r in self.runners}
+        metrics: Dict[str, Any] = {}
+        updates = cfg.train_iterations_per_call * len(self.runners)
+        for _ in range(updates):
+            done, _ = ray_tpu.wait(list(self._in_flight), num_returns=1)
+            runner = self._in_flight.pop(done[0])
+            rollout = ray_tpu.get(done[0])
+            metrics = self.learner.update([rollout])
+            runner.set_weights.remote(
+                ray_tpu.put(self.learner.get_weights()))
+            self._in_flight[
+                runner.sample.remote(cfg.rollout_fragment_length)] = runner
+        self.iteration += 1
+        returns = [x for r in self.runners
+                   for x in ray_tpu.get(r.episode_returns.remote())]
+        metrics.update({
+            "training_iteration": self.iteration,
+            "episode_return_mean": float(np.mean(returns))
+            if returns else float("nan"),
+            "num_episodes": len(returns),
+        })
+        return metrics
+
     def train(self) -> Dict[str, Any]:
         """One training iteration (reference Algorithm.step)."""
         cfg = self.config
+        if cfg.algo.upper() == "IMPALA":
+            return self._train_async()
         metrics: Dict[str, Any] = {}
         for _ in range(cfg.train_iterations_per_call):
             rollouts = ray_tpu.get([

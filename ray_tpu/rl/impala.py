@@ -1,0 +1,130 @@
+"""IMPALA learner: V-trace off-policy actor-critic.
+
+Reference capability: `rllib/algorithms/impala/` — an asynchronous
+actor-learner architecture where EnvRunners sample with STALE (behavior)
+weights and the learner corrects the off-policyness with V-trace
+(Espeholt et al. 2018). TPU-first shape: the V-trace recursion is a
+`lax.scan` inside one jitted update (no Python loop over timesteps), and
+the batch of runner fragments is vmapped.
+
+The async control loop lives in `rl/algorithm.py::Algorithm._train_async`
+(one in-flight sample per runner; learner updates as fragments land —
+the IMPALA queue, not the PPO barrier).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.ppo import ActorCriticPolicy, _mlp_apply
+
+
+def vtrace(behavior_logp, target_logp, rewards, discounts, values,
+           bootstrap_value, rho_bar: float = 1.0, c_bar: float = 1.0):
+    """V-trace targets + policy-gradient advantages for ONE trajectory
+    fragment ([T] arrays). Pure jax; differentiable inputs must be
+    stopped by the caller where the paper requires."""
+    rhos = jnp.exp(target_logp - behavior_logp)
+    clipped_rhos = jnp.minimum(rho_bar, rhos)
+    cs = jnp.minimum(c_bar, rhos)
+    values_next = jnp.concatenate([values[1:], bootstrap_value[None]])
+    deltas = clipped_rhos * (rewards + discounts * values_next - values)
+
+    def body(acc, xs):
+        delta, discount, c = xs
+        acc = delta + discount * c * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        body, jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, cs), reverse=True)
+    vs = vs_minus_v + values
+    vs_next = jnp.concatenate([vs[1:], bootstrap_value[None]])
+    pg_adv = clipped_rhos * (rewards + discounts * vs_next - values)
+    return vs, pg_adv
+
+
+class ImpalaLearner:
+    """Learner-group role (`rllib/core/learner/learner.py:108`) for the
+    IMPALA algorithm; shares the actor-critic network with PPO."""
+
+    def __init__(self, obs_dim: int, n_actions: int, *, hidden=(64, 64),
+                 lr: float = 6e-4, gamma: float = 0.99,
+                 vf_coef: float = 0.5, ent_coef: float = 0.01,
+                 rho_bar: float = 1.0, c_bar: float = 1.0,
+                 seed: int = 0):
+        self.policy = ActorCriticPolicy(obs_dim, n_actions, hidden, seed)
+        self.optimizer = optax.rmsprop(lr, decay=0.99, eps=0.1)
+        self.opt_state = self.optimizer.init(self.policy.params)
+        self.gamma = gamma
+        self.vf_coef = vf_coef
+        self.ent_coef = ent_coef
+        self.rho_bar = rho_bar
+        self.c_bar = c_bar
+        self._update = jax.jit(self._update_impl)
+        self.num_updates = 0
+
+    # -- jitted update ---------------------------------------------------
+    def _loss(self, params, batch):
+        logits = _mlp_apply(params["pi"], batch["obs"])        # [T, A]
+        logp_all = jax.nn.log_softmax(logits)
+        target_logp = jnp.take_along_axis(
+            logp_all, batch["actions"][:, None], axis=1)[:, 0]
+        values = _mlp_apply(params["vf"], batch["obs"])[:, 0]
+        bootstrap = _mlp_apply(params["vf"],
+                               batch["next_obs_last"][None])[0, 0]
+        discounts = self.gamma * (1.0 - batch["dones"])
+        vs, pg_adv = vtrace(batch["logp"], jax.lax.stop_gradient(
+            target_logp), batch["rewards"], discounts,
+            jax.lax.stop_gradient(values),
+            jax.lax.stop_gradient(bootstrap),
+            rho_bar=self.rho_bar, c_bar=self.c_bar)
+        pg_loss = -jnp.mean(target_logp * pg_adv)
+        vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+        entropy = -jnp.mean(
+            jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        loss = pg_loss + self.vf_coef * vf_loss - self.ent_coef * entropy
+        return loss, {"pg_loss": pg_loss, "vf_loss": vf_loss,
+                      "entropy": entropy}
+
+    def _update_impl(self, params, opt_state, batch):
+        (loss, aux), grads = jax.value_and_grad(
+            self._loss, has_aux=True)(params, batch)
+        grads = jax.tree.map(lambda g: jnp.clip(g, -40.0, 40.0), grads)
+        updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                   params)
+        params = optax.apply_updates(params, updates)
+        aux["loss"] = loss
+        return params, opt_state, aux
+
+    # -- host API ----------------------------------------------------------
+    def update(self, rollouts: List[Dict[str, np.ndarray]]
+               ) -> Dict[str, Any]:
+        metrics: Dict[str, Any] = {}
+        for r in rollouts:   # fragments arrive asynchronously; one pass each
+            batch = {
+                "obs": jnp.asarray(r["obs"]),
+                "next_obs_last": jnp.asarray(r["next_obs_last"]),
+                "actions": jnp.asarray(r["actions"]),
+                "rewards": jnp.asarray(r["rewards"]),
+                "dones": jnp.asarray(r["dones"], jnp.float32),
+                "logp": jnp.asarray(r["logp"]),
+            }
+            self.policy.params, self.opt_state, aux = self._update(
+                self.policy.params, self.opt_state, batch)
+            self.num_updates += 1
+            metrics = {k: float(v) for k, v in aux.items()}
+        self.policy._sync_np()
+        metrics["num_learner_updates"] = self.num_updates
+        return metrics
+
+    def get_weights(self):
+        return self.policy.params
+
+    def set_weights(self, params):
+        self.policy.set_weights(params)

@@ -1,0 +1,179 @@
+"""SAC learner (discrete actions).
+
+Reference capability: `rllib/algorithms/sac/` — soft actor-critic with
+twin Q networks, target networks, and automatic temperature tuning
+(Haarnoja et al. 2018; discrete variant per Christodoulou 2019: the
+expectation over actions is exact — a sum weighted by the categorical
+policy — no reparameterized sampling needed). Off-policy via the replay
+buffer shared with DQN. All three updates (twin-Q, policy, temperature)
+run inside one jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.dqn import ReplayBuffer
+from ray_tpu.rl.ppo import _mlp_apply, _mlp_init
+
+
+class SACPolicy:
+    """Categorical policy for rollouts (stochastic sampling; numpy)."""
+
+    def __init__(self, obs_dim: int, n_actions: int, hidden=(64, 64),
+                 seed: int = 0):
+        rng = jax.random.key(seed)
+        self.params = {"pi": _mlp_init(rng, [obs_dim, *hidden, n_actions])}
+        self._np_pi = None
+        self._rng = np.random.default_rng(seed)
+        self._sync_np()
+
+    def _sync_np(self):
+        self._np_pi = jax.tree.map(np.asarray, self.params["pi"])
+
+    def set_weights(self, payload):
+        self.params = {"pi": payload["pi"]}
+        self._sync_np()
+
+    def get_weights(self):
+        return self.params
+
+    def act(self, obs: np.ndarray) -> Tuple[int, float]:
+        x = obs
+        n = len(self._np_pi)
+        for i, layer in enumerate(self._np_pi):
+            x = x @ layer["w"] + layer["b"]
+            if i < n - 1:
+                x = np.tanh(x)
+        z = x - x.max()
+        p = np.exp(z)
+        p /= p.sum()
+        a = int(self._rng.choice(len(p), p=p))
+        return a, float(np.log(p[a] + 1e-9))
+
+
+class SACLearner:
+    def __init__(self, obs_dim: int, n_actions: int, *, hidden=(64, 64),
+                 lr: float = 3e-4, gamma: float = 0.99, tau: float = 0.01,
+                 target_entropy_scale: float = 0.7,
+                 buffer_capacity: int = 50_000, batch_size: int = 256,
+                 updates_per_call: int = 16, seed: int = 0):
+        rng = jax.random.key(seed)
+        kp, k1, k2 = jax.random.split(rng, 3)
+        sizes = [obs_dim, *hidden, n_actions]
+        self.policy = SACPolicy(obs_dim, n_actions, hidden, seed)
+        self.policy.params = {"pi": _mlp_init(kp, sizes)}
+        self.policy._sync_np()
+        self.q1 = _mlp_init(k1, sizes)
+        self.q2 = _mlp_init(k2, sizes)
+        self.q1_target = jax.tree.map(jnp.copy, self.q1)
+        self.q2_target = jax.tree.map(jnp.copy, self.q2)
+        self.log_alpha = jnp.zeros(())
+        # exact-expectation discrete SAC target: a fraction of max entropy
+        self.target_entropy = target_entropy_scale * float(
+            np.log(n_actions))
+        self.gamma = gamma
+        self.tau = tau
+        self.batch_size = batch_size
+        self.updates_per_call = updates_per_call
+        self.buffer = ReplayBuffer(buffer_capacity, obs_dim, seed=seed)
+        self.opt = optax.adam(lr)
+        self.opt_state = self.opt.init(
+            {"pi": self.policy.params["pi"], "q1": self.q1, "q2": self.q2,
+             "log_alpha": self.log_alpha})
+        self._step = jax.jit(self._step_impl)
+        self.num_updates = 0
+
+    # -- jitted one gradient step ---------------------------------------
+    def _loss(self, params, targets, batch):
+        obs, actions = batch["obs"], batch["actions"]
+        rewards, dones, next_obs = (batch["rewards"], batch["dones"],
+                                    batch["next_obs"])
+        alpha = jnp.exp(params["log_alpha"])
+
+        # target: soft state value of s' under the CURRENT policy
+        next_logits = _mlp_apply(params["pi"], next_obs)
+        next_logp = jax.nn.log_softmax(next_logits)
+        next_pi = jnp.exp(next_logp)
+        q1_t = _mlp_apply(targets["q1"], next_obs)
+        q2_t = _mlp_apply(targets["q2"], next_obs)
+        minq_t = jnp.minimum(q1_t, q2_t)
+        v_next = jnp.sum(next_pi * (minq_t
+                                    - jax.lax.stop_gradient(alpha)
+                                    * next_logp), axis=-1)
+        y = jax.lax.stop_gradient(
+            rewards + self.gamma * (1.0 - dones) * v_next)
+
+        q1 = _mlp_apply(params["q1"], obs)
+        q2 = _mlp_apply(params["q2"], obs)
+        q1_a = jnp.take_along_axis(q1, actions[:, None], axis=1)[:, 0]
+        q2_a = jnp.take_along_axis(q2, actions[:, None], axis=1)[:, 0]
+        q_loss = 0.5 * (jnp.mean((q1_a - y) ** 2)
+                        + jnp.mean((q2_a - y) ** 2))
+
+        # policy: exact expectation over the categorical support
+        logits = _mlp_apply(params["pi"], obs)
+        logp = jax.nn.log_softmax(logits)
+        pi = jnp.exp(logp)
+        minq = jax.lax.stop_gradient(jnp.minimum(q1, q2))
+        pi_loss = jnp.mean(jnp.sum(
+            pi * (jax.lax.stop_gradient(alpha) * logp - minq), axis=-1))
+
+        # temperature: drive policy entropy toward the target
+        entropy = -jnp.sum(pi * logp, axis=-1)
+        alpha_loss = jnp.mean(params["log_alpha"] * jax.lax.stop_gradient(
+            entropy - self.target_entropy))
+
+        loss = q_loss + pi_loss + alpha_loss
+        return loss, {"q_loss": q_loss, "pi_loss": pi_loss,
+                      "alpha": alpha, "entropy": jnp.mean(entropy)}
+
+    def _step_impl(self, params, targets, opt_state, batch):
+        (loss, aux), grads = jax.value_and_grad(
+            self._loss, has_aux=True)(params, targets, batch)
+        updates, opt_state = self.opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        targets = jax.tree.map(
+            lambda t, s: (1.0 - self.tau) * t + self.tau * s,
+            targets, {"q1": params["q1"], "q2": params["q2"]})
+        aux["loss"] = loss
+        return params, targets, opt_state, aux
+
+    # -- host API --------------------------------------------------------
+    def update(self, rollouts: List[Dict[str, np.ndarray]]
+               ) -> Dict[str, Any]:
+        for r in rollouts:
+            self.buffer.add_rollout(r)
+        if self.buffer.size < self.batch_size:
+            return {"buffer_size": self.buffer.size}
+        params = {"pi": self.policy.params["pi"], "q1": self.q1,
+                  "q2": self.q2, "log_alpha": self.log_alpha}
+        targets = {"q1": self.q1_target, "q2": self.q2_target}
+        aux = {}
+        for _ in range(self.updates_per_call):
+            batch = self.buffer.sample(self.batch_size)
+            jb = {k: jnp.asarray(v) for k, v in batch.items()}
+            jb["dones"] = jb["dones"].astype(jnp.float32)
+            params, targets, self.opt_state, aux = self._step(
+                params, targets, self.opt_state, jb)
+            self.num_updates += 1
+        self.policy.params = {"pi": params["pi"]}
+        self.policy._sync_np()
+        self.q1, self.q2 = params["q1"], params["q2"]
+        self.log_alpha = params["log_alpha"]
+        self.q1_target, self.q2_target = targets["q1"], targets["q2"]
+        out = {k: float(v) for k, v in aux.items()}
+        out["num_learner_updates"] = self.num_updates
+        out["buffer_size"] = self.buffer.size
+        return out
+
+    def get_weights(self):
+        return {"pi": self.policy.params["pi"]}
+
+    def set_weights(self, payload):
+        self.policy.set_weights(payload)

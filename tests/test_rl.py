@@ -160,3 +160,74 @@ def test_offline_bc_and_cql(ray_start_regular, tmp_path):
     assert np.isfinite(metrics["loss"])
     assert metrics["cql_penalty"] >= 0.0
     assert cql.act(np.zeros(4, np.float32)) in (0, 1)
+
+
+def test_vtrace_reduces_to_gae_like_targets_on_policy():
+    """With target==behavior (rho==c==1) V-trace vs equals the n-step
+    lambda=1 return bootstrapped from the value trail (paper identity)."""
+    import jax.numpy as jnp
+    from ray_tpu.rl.impala import vtrace
+
+    T = 6
+    rng = np.random.default_rng(0)
+    rewards = jnp.asarray(rng.normal(size=T), jnp.float32)
+    values = jnp.asarray(rng.normal(size=T), jnp.float32)
+    bootstrap = jnp.asarray(0.7, jnp.float32)
+    discounts = jnp.full((T,), 0.9, jnp.float32)
+    logp = jnp.zeros(T)
+    vs, pg_adv = vtrace(logp, logp, rewards, discounts, values, bootstrap)
+    # manual on-policy recursion
+    expect = np.zeros(T, np.float32)
+    acc = 0.0
+    vals = np.asarray(values)
+    rews = np.asarray(rewards)
+    for t in range(T - 1, -1, -1):
+        next_v = 0.7 if t == T - 1 else vals[t + 1]
+        delta = rews[t] + 0.9 * next_v - vals[t]
+        acc = delta + 0.9 * acc
+        expect[t] = acc + vals[t]
+    np.testing.assert_allclose(np.asarray(vs), expect, rtol=1e-5)
+    assert np.all(np.isfinite(np.asarray(pg_adv)))
+
+
+def test_impala_improves_on_cartpole(ray_start_regular):
+    from ray_tpu.rl import AlgorithmConfig
+
+    algo = (AlgorithmConfig(algo="IMPALA")
+            .environment("CartPole-v1")
+            .env_runners(2, rollout_fragment_length=256)
+            .training(lr=1e-3, ent_coef=0.01)
+            .build())
+    returns = []
+    for _ in range(12):
+        m = algo.train()
+        if not np.isnan(m["episode_return_mean"]):
+            returns.append(m["episode_return_mean"])
+    algo.stop()
+    assert returns, "no completed episodes"
+    assert m["num_learner_updates"] >= 24   # async updates really ran
+    # learning signal without single-sample flakiness: the trailing
+    # window clearly beats a random policy (~20) — and does not sit
+    # below the early window by more than noise
+    trailing = float(np.mean(returns[-3:]))
+    leading = float(np.mean(returns[:3]))
+    assert trailing > 35, (leading, trailing, returns)
+    assert trailing > leading * 0.7, (leading, trailing)
+
+
+def test_sac_runs_and_tunes_temperature(ray_start_regular):
+    from ray_tpu.rl import AlgorithmConfig
+
+    algo = (AlgorithmConfig(algo="SAC")
+            .environment("CartPole-v1")
+            .env_runners(1, rollout_fragment_length=256)
+            .training(batch_size=128, updates_per_call=8)
+            .build())
+    metrics = {}
+    for _ in range(4):
+        metrics = algo.train()
+    algo.stop()
+    assert metrics["num_learner_updates"] >= 16
+    assert np.isfinite(metrics["q_loss"])
+    assert metrics["alpha"] > 0       # temperature stayed positive
+    assert 0 < metrics["entropy"] <= np.log(2) + 1e-5
