@@ -88,6 +88,10 @@ FLAG_DEFS = [
     Flag("log_to_driver", bool, True, "capture worker stdout/stderr to "
          "per-pid files and tail them to the driver"),
     Flag("log_dir", str, "", "worker log directory override"),
+    # -- observability --
+    Flag("export_events", bool, False, "write structured task/actor/node/"
+         "job/train/PG lifecycle events as JSONL under the session dir "
+         "(export_*.proto role)"),
     # -- bench --
     Flag("bench_total_deadline", int, 540, "bench.py total wall-clock "
          "budget (seconds)"),

@@ -60,3 +60,36 @@ def get_export_logger() -> Optional[ExportEventLogger]:
 def reset_export_logger() -> None:
     global _logger
     _logger = None
+    _pending.clear()
+
+
+def export_enabled() -> bool:
+    from ray_tpu._private.config import cfg
+    return cfg().export_events
+
+
+# Events emitted during Runtime.__init__ (e.g. the first NODE ALIVE)
+# happen before the global runtime binds; buffer them until it does.
+_pending: list = []
+_PENDING_CAP = 1000
+
+
+def emit_export(event_type: str, **payload: Any) -> None:
+    """Emit one structured event if exporting is enabled (the
+    ``RAY_CONFIG enable_export_api_*`` role). Never raises: export is
+    observability, not control flow."""
+    try:
+        if not export_enabled():
+            return
+        logger = get_export_logger()
+        if logger is None:
+            if len(_pending) < _PENDING_CAP:
+                _pending.append((event_type, dict(payload),
+                                 time.time()))
+            return
+        while _pending:
+            etype, pl, ts = _pending.pop(0)
+            logger.emit(etype, {**pl, "timestamp": ts})
+        logger.emit(event_type, payload)
+    except Exception:
+        pass

@@ -94,6 +94,9 @@ class GCS:
 
     # -- nodes -------------------------------------------------------------
     def register_node(self, info: NodeInfo) -> None:
+        from ray_tpu._private.export_events import emit_export
+        emit_export("NODE", node_id=info.node_id.hex(), state="ALIVE",
+                    resources=dict(info.resources))
         with self._lock:
             self.nodes[info.node_id] = info
         self.pubsub.publish("node", ("added", info.node_id))
@@ -102,8 +105,10 @@ class GCS:
         with self._lock:
             info = self.nodes.get(node_id)
             if info is None or not info.alive:
-                return
+                return   # duplicate/unknown: no event, no publish
             info.alive = False
+        from ray_tpu._private.export_events import emit_export
+        emit_export("NODE", node_id=node_id.hex(), state="DEAD")
         self.pubsub.publish("node", ("removed", node_id))
 
     def alive_nodes(self) -> List[NodeInfo]:
@@ -164,6 +169,9 @@ class GCS:
                 info.death_cause = death_cause
             if state == ActorState.DEAD and info.name:
                 self._named_actors.pop((info.namespace, info.name), None)
+        from ray_tpu._private.export_events import emit_export
+        emit_export("ACTOR", actor_id=actor_id.hex(), state=str(state),
+                    death_cause=death_cause)
         self.pubsub.publish("actor", (actor_id, state))
 
     def get_actor_info(self, actor_id: ActorID) -> Optional[ActorInfo]:

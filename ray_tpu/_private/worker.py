@@ -962,6 +962,10 @@ class Runtime:
 
     def _on_task_done(self, spec: TaskSpec, state: str) -> None:
         self.stats["tasks_finished"] += 1
+        from ray_tpu._private.export_events import emit_export
+        emit_export("TASK", task_id=spec.task_id.hex(), name=spec.name,
+                    state=state, kind=str(spec.kind),
+                    job_id=self.job_id.hex())
         deps = spec.dependencies()
         if deps:
             self.refcounter.remove_submitted_task_refs(deps)
@@ -1655,7 +1659,9 @@ def init_runtime(**kwargs) -> Runtime:
 
 def shutdown_runtime() -> None:
     from ray_tpu._private.config import reset as _cfg_reset
+    from ray_tpu._private.export_events import reset_export_logger
     _cfg_reset()
+    reset_export_logger()  # next session binds its own dir
     global _global_runtime
     with _global_lock:
         if _global_runtime is not None:

@@ -57,6 +57,9 @@ class _JobSupervisor:
 
     def _run(self) -> None:
         self.info.status = JobStatus.RUNNING
+        from ray_tpu._private.export_events import emit_export
+        emit_export("JOB", job_id=self.info.job_id, state="RUNNING",
+                    entrypoint=self.info.entrypoint)
         self.info.start_time = time.time()
         try:
             self._proc = subprocess.Popen(
@@ -69,6 +72,9 @@ class _JobSupervisor:
             rc = self._proc.wait()
             self.info.returncode = rc
             if self.info.status != JobStatus.STOPPED:
+                from ray_tpu._private.export_events import emit_export
+                emit_export("JOB", job_id=self.info.job_id,
+                            state="SUCCEEDED" if rc == 0 else "FAILED")
                 self.info.status = (JobStatus.SUCCEEDED if rc == 0
                                     else JobStatus.FAILED)
         except Exception as e:

@@ -68,6 +68,10 @@ class JaxTrainer:
             raise RuntimeError(
                 f"multi-host initialization failed: {e}") from e
 
+        from ray_tpu._private.export_events import emit_export
+        emit_export("TRAIN_RUN", name=self.run_config.name or "train_run",
+                    state="RUNNING",
+                    num_workers=self.scaling.num_workers)
         path = self.run_config.resolved_storage_path()
         ckpt_cfg = self.run_config.checkpoint_config
         manager = CheckpointManager(
@@ -106,6 +110,9 @@ class JaxTrainer:
                 break
             # else: elastic retry — re-form the group from latest ckpt
 
+        emit_export("TRAIN_RUN", name=self.run_config.name or "train_run",
+                    state="ERRORED" if error else "FINISHED",
+                    error=error)
         return Result(metrics=last_metrics, checkpoint=latest, path=path,
                       metrics_history=history, error=error)
 

@@ -370,12 +370,18 @@ def placement_group(bundles: List[Dict[str, float]],
     """Create a placement group (async; use .ready()/.wait())."""
     from ray_tpu._private import worker
     rt = worker.global_worker()
-    return rt.pg_manager.create(bundles, strategy, name)
+    pg = rt.pg_manager.create(bundles, strategy, name)
+    from ray_tpu._private.export_events import emit_export
+    emit_export("PLACEMENT_GROUP", pg_id=pg.id.hex(), state="CREATED",
+                strategy=strategy, bundles=bundles)
+    return pg
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:
     from ray_tpu._private import worker
     worker.global_worker().pg_manager.remove(pg)
+    from ray_tpu._private.export_events import emit_export
+    emit_export("PLACEMENT_GROUP", pg_id=pg.id.hex(), state="REMOVED")
 
 
 def placement_group_table() -> Dict[str, Dict]:

@@ -1,0 +1,71 @@
+"""Export events (SURVEY #14: structured lifecycle events, reference
+export_*.proto + _private/event/export_event_logger.py)."""
+
+# ---------------------------------------------------------------------------
+# Export events (reference: export_*.proto + export_event_logger.py)
+# ---------------------------------------------------------------------------
+
+def test_export_events_lifecycle(tmp_path):
+    import ray_tpu
+    from ray_tpu._private.export_events import (get_export_logger,
+                                                reset_export_logger)
+
+    reset_export_logger()
+    rt = ray_tpu.init(num_nodes=1, resources={"CPU": 4},
+                      _system_config={"export_events": True})
+    try:
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return "pong"
+
+        assert ray_tpu.get(f.remote()) == 1
+        a = A.remote()
+        assert ray_tpu.get(a.ping.remote()) == "pong"
+        ray_tpu.kill(a)
+
+        from ray_tpu.util.placement_group import (placement_group,
+                                                  remove_placement_group)
+        pg = placement_group([{"CPU": 1}])
+        ray_tpu.get(pg.ready())
+        remove_placement_group(pg)
+
+        logger = get_export_logger()
+        tasks = logger.read("TASK")
+        assert any(e["state"] == "FINISHED" for e in tasks)
+        assert all("task_id" in e and "timestamp" in e for e in tasks)
+        actors = logger.read("ACTOR")
+        states = {e["state"] for e in actors}
+        assert any("ALIVE" in s for s in states)
+        assert any("DEAD" in s for s in states)
+        nodes = logger.read("NODE")
+        assert any(e["state"] == "ALIVE" for e in nodes)
+        pgs = logger.read("PLACEMENT_GROUP")
+        assert {e["state"] for e in pgs} >= {"CREATED", "REMOVED"}
+    finally:
+        ray_tpu.shutdown()
+        reset_export_logger()
+
+
+def test_export_events_disabled_by_default(tmp_path):
+    import ray_tpu
+    from ray_tpu._private.export_events import (get_export_logger,
+                                                reset_export_logger)
+
+    reset_export_logger()
+    ray_tpu.init(num_nodes=1, resources={"CPU": 2})
+    try:
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        assert ray_tpu.get(f.remote()) == 1
+        logger = get_export_logger()
+        assert logger.read("TASK") == []   # flag off: no writes
+    finally:
+        ray_tpu.shutdown()
+        reset_export_logger()
