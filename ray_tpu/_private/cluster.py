@@ -591,6 +591,7 @@ class ClusterBackend:
             with self._lock:
                 self.daemons[node_id] = handle
         self.head.subscribe("node", self._on_node_event)
+        self.start_resource_reporter()
 
     @classmethod
     def attach(cls, runtime, address: str) -> "ClusterBackend":
@@ -638,7 +639,41 @@ class ClusterBackend:
             raise RuntimeError(
                 f"cluster at {address} has no alive nodes to join")
         self.head.subscribe("node", self._on_node_event)
+        self.start_resource_reporter()
         return self
+
+    def start_resource_reporter(self, interval_s: float = 0.5) -> None:
+        """Syncer gossip (``ray_syncer.h:83`` role): the driver is the
+        scheduling authority, so it owns the true availability view —
+        push it to the head periodically (and only when changed) for the
+        state API / autoscaler / other drivers."""
+        def loop():
+            last: Dict[str, Any] = {}
+            last_sent = 0.0
+            while not self._shutting_down:
+                time.sleep(interval_s)
+                loads: Dict[str, Dict[str, float]] = {}
+                with self._lock:
+                    node_ids = list(self.daemons)
+                for node_id in node_ids:
+                    node = self.runtime.get_node(node_id)
+                    if node is None or not node.alive:
+                        continue
+                    loads[node_id.hex()] = dict(node.ledger.available())
+                # Re-send unchanged views inside the head's gossip
+                # freshness window (2s): steady load must not age out
+                # and let static heartbeat values take the view back.
+                now = time.monotonic()
+                if loads and (loads != last or now - last_sent > 1.5):
+                    try:
+                        self.head.report_resources(loads)
+                    except rpc.RpcError:
+                        continue  # lost report: retry next tick
+                    last = loads  # only after a successful send
+                    last_sent = now
+
+        threading.Thread(target=loop, daemon=True,
+                         name="resource-reporter").start()
 
     def _supervise_head(self) -> None:
         """Respawn a crashed head on the same port with the same state."""

@@ -68,12 +68,18 @@ declare("kv_del", "key", "ns")
 declare("kv_keys", "prefix", "ns")
 declare("subscribe", "channel", "cursor")
 declare("publish", "channel", "event")
+declare("report_resources", "loads")
 declare("head_stop")
+
+# High-frequency gossip channels: never persisted, log trimmed to a
+# window (the RaySyncer stream carries LATEST views, not history).
+TRANSIENT_CHANNELS = {"resources"}
+TRANSIENT_WINDOW = 200
 
 
 class _NodeEntry:
     __slots__ = ("node_id", "resources", "labels", "addr", "alive",
-                 "last_beat", "available", "reason")
+                 "last_beat", "available", "reason", "avail_gossip_ts")
 
     def __init__(self, node_id: str, resources: Dict[str, float],
                  labels: Dict[str, str], addr: Tuple[str, int]):
@@ -85,6 +91,7 @@ class _NodeEntry:
         self.last_beat = time.monotonic()
         self.available = dict(resources)
         self.reason = ""
+        self.avail_gossip_ts = 0.0   # last syncer report for this node
 
     def view(self) -> Dict[str, Any]:
         return {"node_id": self.node_id, "resources": self.resources,
@@ -146,6 +153,7 @@ class HeadService:
         self._kv: Dict[bytes, bytes] = {}
         # pubsub: channel -> (event log, parked subscriber conns)
         self._events: Dict[str, List[Any]] = {}
+        self._bases: Dict[str, int] = {}   # trimmed-channel log offsets
         self._parked: Dict[str, List[Tuple[Connection, int, int]]] = {}
         self._store: Optional[_HeadStore] = None
         if state_path:
@@ -172,7 +180,12 @@ class HeadService:
             if entry is None:
                 return {"ok": False, "unknown": True}
             entry.last_beat = time.monotonic()
-            entry.available = msg["available"]
+            # The daemon's heartbeat carries its STATIC resources; the
+            # driver's syncer gossip carries the true availability.
+            # Gossip wins while fresh; heartbeat repopulates once the
+            # reporting driver goes quiet (left / crashed).
+            if time.monotonic() - entry.avail_gossip_ts > 2.0:
+                entry.available = msg["available"]
             was_dead = not entry.alive
         if was_dead:
             # A heartbeat from a node we declared dead: tell it to exit
@@ -265,8 +278,11 @@ class HeadService:
         channel, cursor = msg["channel"], msg["cursor"]
         with self._lock:
             log = self._events.setdefault(channel, [])
-            if cursor < len(log):
-                return {"events": log[cursor:], "cursor": len(log)}
+            base = self._bases.get(channel, 0)
+            total = base + len(log)
+            if cursor < total:
+                start = max(0, cursor - base)  # trimmed past: skip ahead
+                return {"events": log[start:], "cursor": total}
             self._parked.setdefault(channel, []).append(
                 (conn, rid, cursor))
         return HOLD
@@ -275,15 +291,42 @@ class HeadService:
         with self._lock:
             log = self._events.setdefault(channel, [])
             log.append(event)
-            if self._store is not None:
+            if channel in TRANSIENT_CHANNELS:
+                if len(log) > TRANSIENT_WINDOW:  # keep only the window
+                    drop = len(log) - TRANSIENT_WINDOW
+                    del log[:drop]
+                    self._bases[channel] = \
+                        self._bases.get(channel, 0) + drop
+            elif self._store is not None:
                 self._store.append_event(channel, len(log) - 1, event)
             parked = self._parked.pop(channel, [])
-            cursor = len(log)
+            base = self._bases.get(channel, 0)
+            cursor = base + len(log)
         for conn, rid, start in parked:
-            conn.reply(rid, events=log[start:], cursor=cursor)
+            conn.reply(rid, events=log[max(0, start - base):],
+                       cursor=cursor)
 
     def handle_publish(self, conn, rid, msg):
         self._publish(msg["channel"], msg["event"])
+        return {"ok": True}
+
+    def handle_report_resources(self, conn, rid, msg):
+        """Resource-view gossip (the RaySyncer role,
+        ``common/ray_syncer/ray_syncer.h:83``): the scheduling authority
+        pushes per-node availability; the head updates its membership
+        view and re-broadcasts on the transient 'resources' channel so
+        any subscriber (state API, autoscaler, other drivers) converges
+        on the same cluster view without polling."""
+        updated = {}
+        with self._lock:
+            for node_hex, avail in msg["loads"].items():
+                entry = self._nodes.get(node_hex)
+                if entry is not None and entry.alive:
+                    entry.available = dict(avail)
+                    entry.avail_gossip_ts = time.monotonic()
+                    updated[node_hex] = dict(avail)
+        if updated:
+            self._publish("resources", {"available": updated})
         return {"ok": True}
 
     def handle_head_stop(self, conn, rid, msg):
@@ -351,6 +394,10 @@ class HeadClient:
 
     def mark_node_dead(self, node_id: str, reason: str) -> None:
         self._call("mark_node_dead", node_id=node_id, reason=reason)
+
+    def report_resources(self, loads: Dict[str, Dict[str, float]]) -> None:
+        """Push per-node availability views (syncer gossip)."""
+        self._call("report_resources", loads=loads, timeout=5.0)
 
     # kv
     def kv_put(self, key: bytes, value: bytes, overwrite: bool = True,

@@ -400,3 +400,47 @@ def test_pull_priority_ordering():
         assert p.event.wait(10)
     assert order[0] == b"warm"
     assert order[1:] == [b"get", b"wait", b"args"]
+
+
+def test_resource_view_gossip(daemon_cluster):
+    """Syncer role (ray_syncer.h:83): the driver gossips true per-node
+    availability to the head; list_nodes and the transient 'resources'
+    channel expose the live view (heartbeat static values don't clobber
+    fresh gossip)."""
+    rt = daemon_cluster
+    backend = rt.cluster_backend
+    events = []
+    backend.head.subscribe("resources", events.append)
+
+    @ray_tpu.remote(num_cpus=3)
+    def hold():
+        time.sleep(3.0)   # longer than the 2s gossip-freshness window:
+        return 1          # steady load must not revert to static values
+
+    ref = hold.remote()
+    deadline = time.monotonic() + 1.0
+    seen_during = None
+    while time.monotonic() < deadline:
+        per_node = {n["node_id"]: n["available"].get("CPU", 0)
+                    for n in backend.head.list_nodes() if n["alive"]}
+        if min(per_node.values()) <= 1:
+            seen_during = per_node
+            break
+        time.sleep(0.05)
+    assert seen_during is not None, "gossiped availability never dropped"
+    # steady load past the freshness window: the view must NOT revert
+    time.sleep(1.5)
+    per_node = {n["node_id"]: n["available"].get("CPU", 0)
+                for n in backend.head.list_nodes() if n["alive"]}
+    assert min(per_node.values()) <= 1, (
+        f"steady load reverted to static availability: {per_node}")
+    ray_tpu.get(ref)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        per_node = {n["node_id"]: n["available"].get("CPU", 0)
+                    for n in backend.head.list_nodes() if n["alive"]}
+        if all(v == 4 for v in per_node.values()):
+            break
+        time.sleep(0.05)
+    assert all(v == 4 for v in per_node.values()), per_node
+    assert events and "available" in events[-1]
