@@ -324,20 +324,27 @@ class Dataset:
     # writes
     # ------------------------------------------------------------------
     def write_parquet(self, path: str) -> None:
-        import os
         import pyarrow.parquet as pq
-        os.makedirs(path, exist_ok=True)
+
+        from ray_tpu.data.filesystem import resolve_filesystem
+        fs, local = resolve_filesystem(path)
+        fs.makedirs(local)
         for i, block in enumerate(self.iter_blocks()):
             if block.num_rows:
-                pq.write_table(block, f"{path}/part-{i:05d}.parquet")
+                with fs.open_output(
+                        f"{local}/part-{i:05d}.parquet") as f:
+                    pq.write_table(block, f)
 
     def write_csv(self, path: str) -> None:
-        import os
         import pyarrow.csv as pacsv
-        os.makedirs(path, exist_ok=True)
+
+        from ray_tpu.data.filesystem import resolve_filesystem
+        fs, local = resolve_filesystem(path)
+        fs.makedirs(local)
         for i, block in enumerate(self.iter_blocks()):
             if block.num_rows:
-                pacsv.write_csv(block, f"{path}/part-{i:05d}.csv")
+                with fs.open_output(f"{local}/part-{i:05d}.csv") as f:
+                    pacsv.write_csv(block, f)
 
     def stats(self) -> str:
         """Execution statistics summary (reference: Dataset.stats())."""

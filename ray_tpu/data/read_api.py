@@ -70,34 +70,59 @@ def _expand_paths(paths, suffix: str) -> List[str]:
 
 def _file_read_dataset(paths, suffix: str, reader: Callable,
                        name: str) -> Dataset:
+    from ray_tpu.data import filesystem as fsmod
+
     files = _expand_paths(paths, suffix)
-    tasks = [lambda f=f: reader(f) for f in files]
+    # Read tasks execute in worker processes: ship the driver's
+    # registered filesystems with the task so s3://-style schemes
+    # resolve there too (reference: the fs object travels with the
+    # read task, not via global state).
+    registry = dict(fsmod._REGISTRY)
+
+    def run(f):
+        for scheme, fs in registry.items():
+            fsmod._REGISTRY.setdefault(scheme, fs)
+        return reader(f)
+
+    tasks = [lambda f=f: run(f) for f in files]
     return Dataset(L.Read(name, [], read_tasks=tasks))
+
+
+def _seam_open(f):
+    """Open one (possibly scheme-qualified) path through the filesystem
+    seam so every reader works on any registered fs (s3://, ...)."""
+    from ray_tpu.data.filesystem import resolve_filesystem
+    fs, local = resolve_filesystem(f)
+    return fs.open_input(local)
 
 
 def read_parquet(paths) -> Dataset:
     import pyarrow.parquet as pq
     return _file_read_dataset(paths, ".parquet",
-                              lambda f: pq.read_table(f), "read_parquet")
+                              lambda f: pq.read_table(_seam_open(f)),
+                              "read_parquet")
 
 
 def read_csv(paths) -> Dataset:
     import pyarrow.csv as pacsv
     return _file_read_dataset(paths, ".csv",
-                              lambda f: pacsv.read_csv(f), "read_csv")
+                              lambda f: pacsv.read_csv(_seam_open(f)),
+                              "read_csv")
 
 
 def read_json(paths) -> Dataset:
     import pyarrow.json as pajson
     return _file_read_dataset(paths, ".json",
-                              lambda f: pajson.read_json(f), "read_json")
+                              lambda f: pajson.read_json(_seam_open(f)),
+                              "read_json")
 
 
 def read_text(paths) -> Dataset:
     def reader(f):
-        with open(f) as fh:
-            return block_from_rows(
-                [{"text": line.rstrip("\n")} for line in fh])
+        with _seam_open(f) as fh:
+            text = fh.read().decode()
+        return block_from_rows(
+            [{"text": line} for line in text.splitlines()])
     return _file_read_dataset(paths, ".txt", reader, "read_text")
 
 
