@@ -356,6 +356,30 @@ class _WorkerState:
         ctypes.pythonapi.PyThreadState_SetAsyncExc(
             ctypes.c_ulong(t.ident), ctypes.py_object(KeyboardInterrupt))
 
+    def _resolve_runtime_env(self, renv):
+        """pkg:// URIs -> node-local extracted dirs (fetched once from
+        the owner through the host channel and cached)."""
+        if not renv:
+            return renv
+        from ray_tpu._private import runtime_env_packaging as pkg
+
+        def resolve(value):
+            if not (isinstance(value, str)
+                    and value.startswith(pkg.PKG_SCHEME)):
+                return value
+            local = pkg.cached_dir(value)
+            if local is None:
+                local = pkg.extract_blob(
+                    value, self.call_host("fetch_runtime_pkg", uri=value))
+            return local
+
+        out = dict(renv)
+        if out.get("working_dir"):
+            out["working_dir"] = resolve(out["working_dir"])
+        if out.get("py_modules"):
+            out["py_modules"] = [resolve(m) for m in out["py_modules"]]
+        return out
+
     def _fn(self, msg: Dict[str, Any]):
         if "fn_blob" in msg:
             return cloudpickle.loads(msg["fn_blob"])
@@ -377,7 +401,8 @@ class _WorkerState:
         try:
             token = runtime_context._set_context(**ctx)
             try:
-                with apply_runtime_env(msg.get("runtime_env")):
+                with apply_runtime_env(
+                        self._resolve_runtime_env(msg.get("runtime_env"))):
                     if msg["op"] == "create_actor":
                         cls = self._fn(msg)
                         args, kwargs = cloudpickle.loads(msg["args_blob"])
@@ -732,6 +757,9 @@ def dispatch_core_op(rt, holder, call: str, kw: Dict[str, Any],
         return rt.gcs.get_named_actor(kw["name"], kw["namespace"])
     if call == "fetch_function":
         return fetch_function_blob(kw["fid"])
+    if call == "fetch_runtime_pkg":
+        from ray_tpu._private.runtime_env_packaging import fetch_pkg_blob
+        return fetch_pkg_blob(kw["uri"])
     if call == "locate_object":
         # Owner-keyed object directory (ownership_object_directory.h):
         # which daemons hold a copy of this object (by daemon store key),

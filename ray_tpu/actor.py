@@ -10,6 +10,8 @@ from ray_tpu._private import worker
 from ray_tpu._private.gcs import ActorState
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, next_seqno
 from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.runtime_env_packaging import \
+    prepare_runtime_env as _prepare_runtime_env
 from ray_tpu._private.task_spec import (DEFAULT_ACTOR_OPTIONS,
                                         DEFAULT_TASK_OPTIONS, TaskKind,
                                         TaskSpec, resources_from_options,
@@ -161,7 +163,8 @@ class ActorClass:
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             kind=TaskKind.ACTOR_CREATION,
-            runtime_env=options.get("runtime_env"),
+            runtime_env=_prepare_runtime_env(
+                options.get("runtime_env")),
             name=f"{self._cls.__name__}.__init__",
             func=self._cls,
             args=tuple(args),

@@ -9,6 +9,8 @@ from typing import Any, Dict, List, Optional, Union
 from ray_tpu._private import worker
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.runtime_env_packaging import \
+    prepare_runtime_env as _prepare_runtime_env
 from ray_tpu._private.task_spec import (DEFAULT_TASK_OPTIONS, TaskKind,
                                         TaskSpec, resources_from_options,
                                         validate_options)
@@ -136,7 +138,8 @@ class RemoteFunction:
             return_ids=[ObjectID.from_random() for _ in range(n_ids)],
             max_retries=options.get("max_retries", 3),
             retry_exceptions=options.get("retry_exceptions", False),
-            runtime_env=options.get("runtime_env"),
+            runtime_env=_prepare_runtime_env(
+                options.get("runtime_env")),
             scheduling_strategy=worker.capture_parent_pg_strategy(
                 options.get("scheduling_strategy", "DEFAULT")),
             job_id=rt.job_id,

@@ -62,12 +62,22 @@ def apply_runtime_env(runtime_env: Optional[Dict[str, Any]]):
             "runtime_env package materialization (pip/uv/conda/container) "
             "is a no-op in the single-image runtime", stacklevel=2)
     env_vars: Dict[str, str] = runtime_env.get("env_vars") or {}
+
+    def _local(p: str) -> str:
+        # pkg:// URIs (packaged working_dir/py_modules) materialize from
+        # the content-addressed table / node cache
+        if isinstance(p, str) and p.startswith("pkg://"):
+            from ray_tpu._private.runtime_env_packaging import \
+                resolve_local
+            return resolve_local(p)
+        return os.path.abspath(p)
+
     paths: List[str] = []
     wd = runtime_env.get("working_dir")
     if wd:
-        paths.append(os.path.abspath(wd))
+        paths.append(_local(wd))
     for mod in runtime_env.get("py_modules") or []:
-        paths.append(os.path.abspath(mod))
+        paths.append(_local(mod))
 
     with _env_lock:
         saved = {k: os.environ.get(k) for k in env_vars}
