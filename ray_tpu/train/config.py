@@ -58,6 +58,9 @@ class RunConfig:
         default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = dataclasses.field(
         default_factory=CheckpointConfig)
+    # logger/integration callbacks (reference: air callbacks + tune
+    # logger callbacks; see ray_tpu/train/callbacks.py)
+    callbacks: list = dataclasses.field(default_factory=list)
 
     def resolved_storage_path(self) -> str:
         base = self.storage_path or os.path.join(

@@ -69,10 +69,13 @@ class JaxTrainer:
                 f"multi-host initialization failed: {e}") from e
 
         from ray_tpu._private.export_events import emit_export
+        from ray_tpu.train.callbacks import invoke as _cb
         emit_export("TRAIN_RUN", name=self.run_config.name or "train_run",
                     state="RUNNING",
                     num_workers=self.scaling.num_workers)
         path = self.run_config.resolved_storage_path()
+        _cb(self.run_config.callbacks, "on_run_start",
+            self.run_config.name or "train_run", self.train_loop_config)
         ckpt_cfg = self.run_config.checkpoint_config
         manager = CheckpointManager(
             path, num_to_keep=ckpt_cfg.num_to_keep,
@@ -113,8 +116,10 @@ class JaxTrainer:
         emit_export("TRAIN_RUN", name=self.run_config.name or "train_run",
                     state="ERRORED" if error else "FINISHED",
                     error=error)
-        return Result(metrics=last_metrics, checkpoint=latest, path=path,
-                      metrics_history=history, error=error)
+        result = Result(metrics=last_metrics, checkpoint=latest, path=path,
+                        metrics_history=history, error=error)
+        _cb(self.run_config.callbacks, "on_run_end", result, error)
+        return result
 
     # ------------------------------------------------------------------
     def _split_datasets(self):
@@ -138,12 +143,18 @@ class JaxTrainer:
         pending = list(run_refs)
         while True:
             # Drain worker report buffers; persist rank-0 checkpoints.
+            from ray_tpu.train.callbacks import invoke as _cb
             for status in group.poll():
                 for entry in status["reports"]:
                     history.append(entry)
+                    _cb(self.run_config.callbacks, "on_report",
+                        entry["metrics"], len(history),
+                        rank=entry["rank"])
                     if entry["rank"] == 0 and entry["checkpoint"] is not None:
                         manager.register(entry["checkpoint"],
                                          entry["metrics"])
+                        _cb(self.run_config.callbacks, "on_checkpoint",
+                            entry["checkpoint"], len(history))
             if not pending:
                 return "finished", None
             done, pending = ray_tpu.wait(

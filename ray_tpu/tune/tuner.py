@@ -265,6 +265,12 @@ class Tuner:
         return os.path.exists(os.path.join(path, "tuner_state.pkl"))
 
     def fit(self) -> ResultGrid:
+        from ray_tpu.train.callbacks import invoke as _cb
+        cbs = (self.run_config.callbacks
+               if self.run_config is not None else [])
+        _cb(cbs, "on_run_start",
+            (self.run_config.name if self.run_config else None)
+            or "tune_run", dict(self.param_space))
         scheduler = self.cfg.scheduler or FIFOScheduler()
         searcher = self.cfg.search_alg
         if self._restored_trials is not None:
@@ -315,6 +321,8 @@ class Tuner:
                     entries = []
                 for entry in entries:
                     trial.results.append(entry["metrics"])
+                    _cb(cbs, "on_report", entry["metrics"],
+                        len(trial.results), trial_id=trial.id)
                     if entry.get("checkpoint") is not None:
                         trial.checkpoint = entry["checkpoint"]
                     if scheduler.on_result(trial, entry["metrics"]) == STOP:
@@ -336,8 +344,10 @@ class Tuner:
                             getattr(trial, "search_id", ""), value)
             self._save_state(trials)  # crash-resume snapshot per step
         self._save_state(trials)
-        return ResultGrid(trials=trials, metric=self.cfg.metric,
+        grid = ResultGrid(trials=trials, metric=self.cfg.metric,
                           mode=self.cfg.mode)
+        _cb(cbs, "on_run_end", grid)
+        return grid
 
     def _finalize(self, trial: Trial, scheduler: TrialScheduler) -> None:
         try:
@@ -351,12 +361,24 @@ class Tuner:
             else:
                 trial.status = "ERROR"
                 trial.error = msg
-        # drain any last reports
+        # drain any last reports; a timed-out get under host load must
+        # not silently lose the trial's final metrics — retry the SAME
+        # poll ref (a fresh poll.remote() would find an already-drained
+        # buffer: the first poll still executes server-side)
         try:
-            for entry in ray_tpu.get(trial.actor.poll.remote(), timeout=10):
-                trial.results.append(entry["metrics"])
+            poll_ref = trial.actor.poll.remote()
         except Exception:
-            pass
+            poll_ref = None
+        if poll_ref is not None:
+            for attempt in range(2):
+                try:
+                    for entry in ray_tpu.get(poll_ref, timeout=30):
+                        trial.results.append(entry["metrics"])
+                    break
+                except Exception:
+                    if attempt == 1:
+                        break
+                    time.sleep(0.5)
         scheduler.on_trial_complete(trial)
         try:
             ray_tpu.kill(trial.actor)

@@ -1,0 +1,94 @@
+"""Run callbacks (reference: air integration callbacks + tune logger
+callbacks — json/csv loggers functional, tracking libs import-gated)."""
+
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig, session
+from ray_tpu.train.callbacks import (Callback, CSVLoggerCallback,
+                                     JsonLoggerCallback,
+                                     MLflowLoggerCallback,
+                                     WandbLoggerCallback)
+
+
+def test_json_and_csv_loggers_on_trainer(ray_start_regular, tmp_path):
+    events = []
+
+    class Recorder(Callback):
+        def on_run_start(self, run_name, config=None):
+            events.append(("start", run_name))
+
+        def on_report(self, metrics, iteration, rank=0, trial_id=""):
+            events.append(("report", metrics["step"]))
+
+        def on_run_end(self, result=None, error=None):
+            events.append(("end", error))
+
+    def loop(config):
+        for step in range(3):
+            session.report({"step": step, "loss": 1.0 / (step + 1)})
+
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="cbrun", storage_path=str(tmp_path),
+            callbacks=[Recorder(),
+                       JsonLoggerCallback(str(tmp_path / "logs")),
+                       CSVLoggerCallback(str(tmp_path / "logs"))])).fit()
+    assert result.error is None
+    assert events[0] == ("start", "cbrun")
+    assert ("report", 0) in events and ("report", 2) in events
+    assert events[-1] == ("end", None)
+
+    lines = [json.loads(ln) for ln in
+             open(tmp_path / "logs" / "result.json")]
+    assert [ln["step"] for ln in lines] == [0, 1, 2]
+    csv = open(tmp_path / "logs" / "progress.csv").read().splitlines()
+    assert csv[0].startswith("iteration,")
+    assert len(csv) == 4
+
+
+def test_callbacks_on_tune_trials(ray_start_regular, tmp_path):
+    from ray_tpu import tune
+    from ray_tpu.tune import TuneConfig, Tuner
+
+    reports = []
+
+    class Rec(Callback):
+        def on_report(self, metrics, iteration, rank=0, trial_id=""):
+            reports.append((trial_id, metrics["v"]))
+
+    def obj(config):
+        return {"v": config["x"]}
+
+    Tuner(obj, param_space={"x": tune.grid_search([1, 2, 3])},
+          tune_config=TuneConfig(metric="v", mode="max"),
+          run_config=RunConfig(name="t", storage_path=str(tmp_path),
+                               callbacks=[Rec()])).fit()
+    assert sorted(v for _, v in reports) == [1, 2, 3]
+    assert len({tid for tid, _ in reports}) == 3   # distinct trial ids
+
+
+def test_tracking_integrations_import_gated():
+    with pytest.raises(ImportError, match="wandb"):
+        WandbLoggerCallback(project="x")
+    with pytest.raises(ImportError, match="mlflow"):
+        MLflowLoggerCallback()
+
+
+def test_broken_callback_does_not_kill_run(ray_start_regular, tmp_path):
+    class Broken(Callback):
+        def on_report(self, *a, **k):
+            raise RuntimeError("logging exploded")
+
+    def loop(config):
+        session.report({"ok": 1})
+
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="b", storage_path=str(tmp_path),
+                             callbacks=[Broken()])).fit()
+    assert result.error is None
