@@ -61,6 +61,56 @@ class GrpcProxy:
                 context.set_details(f"{type(e).__name__}: {e}")
                 return _pack({"error": f"{type(e).__name__}: {e}"})
 
+        def predict_stream(request: bytes, context):
+            """Server-streaming Predict (reference: the gRPC proxy's
+            streaming path next to HTTP SSE): one msgpack frame per
+            replica chunk, flushed as produced. Each chunk wait is
+            bounded so a wedged replica returns DEADLINE_EXCEEDED
+            instead of pinning a server thread forever."""
+            import queue as _queue
+
+            try:
+                body = _unpack(request) if request else {}
+                handle = self._get_handle(body.get("application"))
+                if body.get("method"):
+                    handle = handle.options(body["method"])
+                gen = handle.options(stream=True).remote(
+                    *body.get("args", []), **body.get("kwargs", {}))
+                q: "_queue.Queue" = _queue.Queue()
+                _END = object()
+
+                def pump():
+                    try:
+                        for chunk in gen:
+                            q.put(("chunk", chunk))
+                        q.put(("end", _END))
+                    except BaseException as e:  # noqa: BLE001
+                        q.put(("err", e))
+
+                threading.Thread(target=pump, daemon=True,
+                                 name="grpc-stream-pump").start()
+                while True:
+                    try:
+                        kind, item = q.get(timeout=120.0)
+                    except _queue.Empty:
+                        context.set_code(
+                            grpc.StatusCode.DEADLINE_EXCEEDED)
+                        context.set_details(
+                            "no chunk from the replica within 120s")
+                        yield _pack({"error": "chunk timeout"})
+                        return
+                    if kind == "chunk":
+                        yield _pack({"chunk": item})
+                    elif kind == "end":
+                        yield _pack({"done": True})
+                        return
+                    else:
+                        raise item
+            except Exception as e:  # noqa: BLE001 — shipped to client
+                context.set_code(grpc.StatusCode.INTERNAL)
+                context.set_details(f"{type(e).__name__}: {e}")
+                yield _pack({"error": f"{type(e).__name__}: {e}"})
+
         def list_applications(request: bytes, context) -> bytes:
             return _pack({"applications": self._list_apps()})
 
@@ -71,6 +121,9 @@ class GrpcProxy:
         handlers = {
             "Predict": grpc.unary_unary_rpc_method_handler(
                 predict, request_deserializer=identity,
+                response_serializer=identity),
+            "PredictStream": grpc.unary_stream_rpc_method_handler(
+                predict_stream, request_deserializer=identity,
                 response_serializer=identity),
             "ListApplications": grpc.unary_unary_rpc_method_handler(
                 list_applications, request_deserializer=identity,
@@ -106,6 +159,9 @@ class GrpcServeClient:
         self._predict = self._channel.unary_unary(
             f"{base}/Predict", request_serializer=identity,
             response_deserializer=identity)
+        self._predict_stream_rpc = self._channel.unary_stream(
+            f"{base}/PredictStream", request_serializer=identity,
+            response_deserializer=identity)
         self._list = self._channel.unary_unary(
             f"{base}/ListApplications", request_serializer=identity,
             response_deserializer=identity)
@@ -129,6 +185,27 @@ class GrpcServeClient:
         if "error" in out:
             raise RuntimeError(out["error"])
         return out["result"]
+
+    def predict_stream(self, *args, application: Optional[str] = None,
+                       method: Optional[str] = None, **kwargs):
+        """Yield chunks as the replica produces them (server streaming)."""
+        import grpc
+
+        body = {"args": list(args), "kwargs": kwargs}
+        if application:
+            body["application"] = application
+        if method:
+            body["method"] = method
+        try:
+            for frame in self._predict_stream_rpc(_pack(body)):
+                out = _unpack(frame)
+                if "error" in out:
+                    raise RuntimeError(out["error"])
+                if out.get("done"):
+                    return
+                yield out["chunk"]
+        except grpc.RpcError as e:
+            raise RuntimeError(e.details()) from None
 
     def list_applications(self) -> Dict[str, str]:
         return _unpack(self._list(b""))["applications"]

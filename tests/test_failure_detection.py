@@ -167,3 +167,40 @@ def test_serve_grpc_proxy(ray_start_regular):
         client.close()
         stop_grpc_proxy()
         serve.shutdown()
+
+
+def test_serve_grpc_streaming(ray_start_regular):
+    """gRPC server-streaming Predict: chunks arrive as the replica
+    produces them (the second streaming ingress next to HTTP SSE)."""
+    import time as _time
+
+    from ray_tpu import serve
+    from ray_tpu.serve.grpc_proxy import (GrpcServeClient,
+                                          start_grpc_proxy,
+                                          stop_grpc_proxy)
+
+    @serve.deployment
+    class Streamer:
+        def __call__(self, n):
+            for i in range(n):
+                _time.sleep(0.2)
+                yield {"i": i}
+
+    serve.run(Streamer.bind())
+    port = start_grpc_proxy()
+    client = GrpcServeClient(f"127.0.0.1:{port}")
+    try:
+        t0 = _time.monotonic()
+        chunks = []
+        t_first = None
+        for chunk in client.predict_stream(4):
+            if t_first is None:
+                t_first = _time.monotonic() - t0
+            chunks.append(chunk)
+        t_all = _time.monotonic() - t0
+        assert [c["i"] for c in chunks] == [0, 1, 2, 3]
+        assert t_first < t_all - 0.3, (t_first, t_all)
+    finally:
+        client.close()
+        stop_grpc_proxy()
+        serve.shutdown()
