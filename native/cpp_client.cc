@@ -1,0 +1,420 @@
+// C++ client for the ray_tpu control/object wire protocol.
+//
+// Reference capability: the C++ API tier (`cpp/` — ray::Init/Put/Get over
+// the core-worker runtime, 9.2k LoC) and the raylet client
+// (`src/ray/raylet_client/raylet_client.h`). This build's wire is typed
+// msgpack frames over TCP (`ray_tpu/_private/rpc.py`: u32 BE length +
+// msgpack map, "m"=method, "i"=request id), so a native client needs no
+// Python at all: it speaks to the head (KV) and node daemons (object
+// plane, ping) directly.
+//
+// Exposed as a C ABI (ctypes-consumable, same pattern as shm_store.cc):
+//   rtc_connect / rtc_close
+//   rtc_kv_put / rtc_kv_get            (head InternalKV)
+//   rtc_put_object / rtc_get_object    (daemon object table)
+//   rtc_ping                           (daemon_ping -> pid)
+//   rtc_free                           (free buffers returned by _get)
+//
+// Build: `make` in native/ produces libray_tpu_cpp_client.so.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// minimal msgpack (the subset the wire uses)
+// ---------------------------------------------------------------------------
+
+struct Value {
+  enum Kind { NIL, BOOL, INT, DBL, STR, BIN, ARR, MAP } kind = NIL;
+  bool b = false;
+  int64_t i = 0;
+  double d = 0;
+  std::string s;                       // STR and BIN both land here
+  std::vector<Value> arr;
+  std::map<std::string, Value> map;    // string-keyed maps only
+
+  const Value* get(const std::string& key) const {
+    auto it = map.find(key);
+    return it == map.end() ? nullptr : &it->second;
+  }
+};
+
+void pack_uint(std::string& out, uint64_t v) {
+  if (v < 128) {
+    out.push_back(static_cast<char>(v));
+  } else if (v <= 0xff) {
+    out.push_back(static_cast<char>(0xcc));
+    out.push_back(static_cast<char>(v));
+  } else if (v <= 0xffff) {
+    out.push_back(static_cast<char>(0xcd));
+    out.push_back(static_cast<char>(v >> 8));
+    out.push_back(static_cast<char>(v));
+  } else {
+    out.push_back(static_cast<char>(0xce));
+    for (int s = 24; s >= 0; s -= 8)
+      out.push_back(static_cast<char>(v >> s));
+  }
+}
+
+void pack_str(std::string& out, const std::string& s) {
+  size_t n = s.size();
+  if (n < 32) {
+    out.push_back(static_cast<char>(0xa0 | n));
+  } else if (n <= 0xff) {
+    out.push_back(static_cast<char>(0xd9));
+    out.push_back(static_cast<char>(n));
+  } else {
+    out.push_back(static_cast<char>(0xda));
+    out.push_back(static_cast<char>(n >> 8));
+    out.push_back(static_cast<char>(n));
+  }
+  out += s;
+}
+
+void pack_bin(std::string& out, const uint8_t* data, size_t n) {
+  if (n <= 0xff) {
+    out.push_back(static_cast<char>(0xc4));
+    out.push_back(static_cast<char>(n));
+  } else if (n <= 0xffff) {
+    out.push_back(static_cast<char>(0xc5));
+    out.push_back(static_cast<char>(n >> 8));
+    out.push_back(static_cast<char>(n));
+  } else {
+    out.push_back(static_cast<char>(0xc6));
+    for (int s = 24; s >= 0; s -= 8)
+      out.push_back(static_cast<char>(n >> s));
+  }
+  out.append(reinterpret_cast<const char*>(data), n);
+}
+
+void pack_bool(std::string& out, bool v) {
+  out.push_back(static_cast<char>(v ? 0xc3 : 0xc2));
+}
+
+void pack_map_header(std::string& out, size_t n) {
+  if (n < 16) {
+    out.push_back(static_cast<char>(0x80 | n));
+  } else {
+    out.push_back(static_cast<char>(0xde));
+    out.push_back(static_cast<char>(n >> 8));
+    out.push_back(static_cast<char>(n));
+  }
+}
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  uint64_t be(int n) {
+    if (end - p < n) { ok = false; return 0; }
+    uint64_t v = 0;
+    for (int k = 0; k < n; ++k) v = (v << 8) | *p++;
+    return v;
+  }
+
+  std::string take(size_t n) {
+    if (static_cast<size_t>(end - p) < n) { ok = false; return {}; }
+    std::string s(reinterpret_cast<const char*>(p), n);
+    p += n;
+    return s;
+  }
+
+  Value parse() {
+    Value v;
+    if (p >= end) { ok = false; return v; }
+    uint8_t t = *p++;
+    if (t < 0x80) { v.kind = Value::INT; v.i = t; return v; }
+    if (t >= 0xe0) { v.kind = Value::INT;
+                     v.i = static_cast<int8_t>(t); return v; }
+    if ((t & 0xf0) == 0x80) return parse_map(t & 0x0f);
+    if ((t & 0xf0) == 0x90) return parse_arr(t & 0x0f);
+    if ((t & 0xe0) == 0xa0) { v.kind = Value::STR;
+                              v.s = take(t & 0x1f); return v; }
+    switch (t) {
+      case 0xc0: return v;                              // nil
+      case 0xc2: v.kind = Value::BOOL; v.b = false; return v;
+      case 0xc3: v.kind = Value::BOOL; v.b = true; return v;
+      case 0xc4: v.kind = Value::BIN; v.s = take(be(1)); return v;
+      case 0xc5: v.kind = Value::BIN; v.s = take(be(2)); return v;
+      case 0xc6: v.kind = Value::BIN; v.s = take(be(4)); return v;
+      case 0xca: { v.kind = Value::DBL; uint32_t r = be(4); float f;
+                   memcpy(&f, &r, 4); v.d = f; return v; }
+      case 0xcb: { v.kind = Value::DBL; uint64_t r = be(8);
+                   memcpy(&v.d, &r, 8); return v; }
+      case 0xcc: v.kind = Value::INT; v.i = be(1); return v;
+      case 0xcd: v.kind = Value::INT; v.i = be(2); return v;
+      case 0xce: v.kind = Value::INT; v.i = be(4); return v;
+      case 0xcf: v.kind = Value::INT;
+                 v.i = static_cast<int64_t>(be(8)); return v;
+      case 0xd0: v.kind = Value::INT;
+                 v.i = static_cast<int8_t>(be(1)); return v;
+      case 0xd1: v.kind = Value::INT;
+                 v.i = static_cast<int16_t>(be(2)); return v;
+      case 0xd2: v.kind = Value::INT;
+                 v.i = static_cast<int32_t>(be(4)); return v;
+      case 0xd3: v.kind = Value::INT;
+                 v.i = static_cast<int64_t>(be(8)); return v;
+      case 0xd9: v.kind = Value::STR; v.s = take(be(1)); return v;
+      case 0xda: v.kind = Value::STR; v.s = take(be(2)); return v;
+      case 0xdb: v.kind = Value::STR; v.s = take(be(4)); return v;
+      case 0xdc: return parse_arr(be(2));
+      case 0xdd: return parse_arr(be(4));
+      case 0xde: return parse_map(be(2));
+      case 0xdf: return parse_map(be(4));
+      default: ok = false; return v;                    // unsupported
+    }
+  }
+
+  Value parse_arr(size_t n) {
+    Value v;
+    v.kind = Value::ARR;
+    for (size_t k = 0; k < n && ok; ++k) v.arr.push_back(parse());
+    return v;
+  }
+
+  Value parse_map(size_t n) {
+    Value v;
+    v.kind = Value::MAP;
+    for (size_t k = 0; k < n && ok; ++k) {
+      Value key = parse();
+      Value val = parse();
+      if (key.kind == Value::STR) v.map.emplace(key.s, std::move(val));
+    }
+    return v;
+  }
+};
+
+// ---------------------------------------------------------------------------
+// connection
+// ---------------------------------------------------------------------------
+
+struct Client {
+  int fd = -1;
+  uint64_t next_id = 0;
+  std::mutex mu;
+  std::string last_error;
+
+  bool send_all(const std::string& buf) {
+    size_t off = 0;
+    while (off < buf.size()) {
+      ssize_t n = ::send(fd, buf.data() + off, buf.size() - off, 0);
+      if (n <= 0) return false;
+      off += static_cast<size_t>(n);
+    }
+    return true;
+  }
+
+  bool recv_all(uint8_t* out, size_t n) {
+    size_t off = 0;
+    while (off < n) {
+      ssize_t r = ::recv(fd, out + off, n - off, 0);
+      if (r <= 0) return false;
+      off += static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  // One request/reply round trip. Body packs the extra fields.
+  bool call(const std::string& method,
+            const std::string& packed_fields, size_t n_fields,
+            Value* reply) {
+    std::lock_guard<std::mutex> lock(mu);
+    uint64_t rid = ++next_id;
+    std::string body;
+    pack_map_header(body, n_fields + 2);
+    pack_str(body, "m");
+    pack_str(body, method);
+    pack_str(body, "i");
+    pack_uint(body, rid);
+    body += packed_fields;
+
+    std::string frame;
+    uint32_t len = htonl(static_cast<uint32_t>(body.size()));
+    frame.append(reinterpret_cast<const char*>(&len), 4);
+    frame += body;
+    if (!send_all(frame)) { last_error = "send failed"; return false; }
+
+    // read frames until the one carrying our id (pushes have no "i")
+    while (true) {
+      uint8_t hdr[4];
+      if (!recv_all(hdr, 4)) { last_error = "recv failed"; return false; }
+      uint32_t blen = (uint32_t(hdr[0]) << 24) | (uint32_t(hdr[1]) << 16) |
+                      (uint32_t(hdr[2]) << 8) | uint32_t(hdr[3]);
+      std::vector<uint8_t> buf(blen);
+      if (!recv_all(buf.data(), blen)) {
+        last_error = "recv failed";
+        return false;
+      }
+      Reader r{buf.data(), buf.data() + blen};
+      Value msg = r.parse();
+      if (!r.ok || msg.kind != Value::MAP) {
+        last_error = "bad frame";
+        return false;
+      }
+      const Value* id = msg.get("i");
+      if (id == nullptr || static_cast<uint64_t>(id->i) != rid) {
+        continue;  // server push or stale frame: skip
+      }
+      const Value* err = msg.get("e");
+      if (err != nullptr && err->kind == Value::STR) {
+        last_error = err->s;
+        return false;
+      }
+      *reply = std::move(msg);
+      return true;
+    }
+  }
+};
+
+uint8_t* dup_buffer(const std::string& s, int64_t* out_len) {
+  auto* out = static_cast<uint8_t*>(malloc(s.size() ? s.size() : 1));
+  memcpy(out, s.data(), s.size());
+  *out_len = static_cast<int64_t>(s.size());
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rtc_connect(const char* host, int port) {
+  struct addrinfo hints;
+  memset(&hints, 0, sizeof(hints));
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  std::string port_s = std::to_string(port);
+  if (getaddrinfo(host, port_s.c_str(), &hints, &res) != 0 || !res) {
+    return nullptr;
+  }
+  int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0 || connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    if (fd >= 0) close(fd);
+    freeaddrinfo(res);
+    return nullptr;
+  }
+  freeaddrinfo(res);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, 1 /*TCP_NODELAY*/, &one, sizeof(one));
+  // Bound every send/recv (the Python wire client defaults to 30s):
+  // a wedged peer returns an error instead of hanging the caller.
+  struct timeval tv;
+  tv.tv_sec = 30;
+  tv.tv_usec = 0;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  auto* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+void rtc_close(void* handle) {
+  auto* c = static_cast<Client*>(handle);
+  if (c == nullptr) return;
+  if (c->fd >= 0) close(c->fd);
+  delete c;
+}
+
+void rtc_free(void* p) { free(p); }
+
+// -- head InternalKV --------------------------------------------------------
+
+int rtc_kv_put(void* handle, const uint8_t* key, int klen,
+               const uint8_t* value, int vlen) {
+  auto* c = static_cast<Client*>(handle);
+  std::string fields;
+  pack_str(fields, "key");
+  pack_bin(fields, key, static_cast<size_t>(klen));
+  pack_str(fields, "value");
+  pack_bin(fields, value, static_cast<size_t>(vlen));
+  pack_str(fields, "overwrite");
+  pack_bool(fields, true);
+  pack_str(fields, "ns");
+  pack_bin(fields, nullptr, 0);
+  Value reply;
+  if (!c->call("kv_put", fields, 4, &reply)) return -1;
+  return 0;
+}
+
+// Returns 0 + *out on hit, 1 on miss, -1 on transport error.
+int rtc_kv_get(void* handle, const uint8_t* key, int klen,
+               uint8_t** out, int64_t* out_len) {
+  auto* c = static_cast<Client*>(handle);
+  std::string fields;
+  pack_str(fields, "key");
+  pack_bin(fields, key, static_cast<size_t>(klen));
+  pack_str(fields, "ns");
+  pack_bin(fields, nullptr, 0);
+  Value reply;
+  if (!c->call("kv_get", fields, 2, &reply)) return -1;
+  const Value* v = reply.get("value");
+  if (v == nullptr || v->kind == Value::NIL) return 1;
+  *out = dup_buffer(v->s, out_len);
+  return 0;
+}
+
+// -- daemon object plane ----------------------------------------------------
+
+int rtc_put_object(void* handle, const uint8_t* oid, int oid_len,
+                   const uint8_t* blob, int64_t blob_len) {
+  auto* c = static_cast<Client*>(handle);
+  std::string fields;
+  pack_str(fields, "oid");
+  pack_bin(fields, oid, static_cast<size_t>(oid_len));
+  pack_str(fields, "blob");
+  pack_bin(fields, blob, static_cast<size_t>(blob_len));
+  Value reply;
+  return c->call("put_object", fields, 2, &reply) ? 0 : -1;
+}
+
+// Returns 0 + *out on hit, 1 on miss, -1 on transport error.
+int rtc_get_object(void* handle, const uint8_t* oid, int oid_len,
+                   uint8_t** out, int64_t* out_len) {
+  auto* c = static_cast<Client*>(handle);
+  std::string fields;
+  pack_str(fields, "oid");
+  pack_bin(fields, oid, static_cast<size_t>(oid_len));
+  pack_str(fields, "prefer_shm");
+  pack_bool(fields, false);
+  Value reply;
+  if (!c->call("get_object", fields, 2, &reply)) return -1;
+  const Value* missing = reply.get("missing");
+  if (missing != nullptr && missing->kind == Value::BOOL && missing->b) {
+    return 1;
+  }
+  const Value* blob = reply.get("blob");
+  if (blob == nullptr || blob->kind == Value::NIL) return 1;
+  *out = dup_buffer(blob->s, out_len);
+  return 0;
+}
+
+// -- daemon ping ------------------------------------------------------------
+
+// Returns the daemon's pid, or -1.
+long rtc_ping(void* handle) {
+  auto* c = static_cast<Client*>(handle);
+  Value reply;
+  if (!c->call("daemon_ping", "", 0, &reply)) return -1;
+  const Value* pid = reply.get("pid");
+  return pid != nullptr ? static_cast<long>(pid->i) : -1;
+}
+
+const char* rtc_last_error(void* handle) {
+  auto* c = static_cast<Client*>(handle);
+  return c->last_error.c_str();
+}
+
+}  // extern "C"

@@ -1,0 +1,43 @@
+"""Shared compile-if-stale + dlopen helper for the native tier.
+
+One place owns the g++ invocation and staleness check; the per-library
+modules (native_store.py, cpp_client.py) only declare their prototypes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native")
+
+
+def build_native_so(src_name: str, out_name: str,
+                    libs: Optional[List[str]] = None) -> Optional[str]:
+    """Compile ``native/<src_name>`` into ``native/<out_name>`` when the
+    source is newer; returns the .so path or None (no g++ / failure)."""
+    src = os.path.join(NATIVE_DIR, src_name)
+    out = os.path.join(NATIVE_DIR, out_name)
+    if not os.path.exists(src):
+        return None
+    if os.path.exists(out) and (
+            os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-Wall",
+             "-o", out, src, *(libs or [])],
+            check=True, capture_output=True, timeout=120)
+        return out
+    except Exception:
+        return None
+
+
+def load_native_so(src_name: str, out_name: str,
+                   libs: Optional[List[str]] = None
+                   ) -> Optional[ctypes.CDLL]:
+    path = build_native_so(src_name, out_name, libs)
+    return ctypes.CDLL(path) if path else None

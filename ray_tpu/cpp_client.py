@@ -1,0 +1,133 @@
+"""ctypes surface over the native C++ wire-protocol client.
+
+Reference capability: the C++ API tier (``cpp/`` — a native program
+talking to a Ray cluster without Python). ``native/cpp_client.cc``
+speaks the typed msgpack wire directly (head InternalKV, daemon object
+plane, daemon_ping); this module is the thin loader + a pythonic wrapper
+used by tests to prove cross-language interop (bytes written by Python
+read back by C++, and vice versa).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional, Tuple
+
+from ray_tpu._private.native_build import load_native_so
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib = load_native_so("cpp_client.cc", "libray_tpu_cpp_client.so",
+                             ["-lpthread"])
+        if lib is None:
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.rtc_connect.restype = ctypes.c_void_p
+        lib.rtc_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.rtc_close.argtypes = [ctypes.c_void_p]
+        lib.rtc_free.argtypes = [ctypes.c_void_p]
+        lib.rtc_kv_put.restype = ctypes.c_int
+        lib.rtc_kv_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int, ctypes.c_char_p,
+                                   ctypes.c_int]
+        lib.rtc_kv_get.restype = ctypes.c_int
+        lib.rtc_kv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int, ctypes.POINTER(u8p),
+                                   ctypes.POINTER(ctypes.c_int64)]
+        lib.rtc_put_object.restype = ctypes.c_int
+        lib.rtc_put_object.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_int, ctypes.c_char_p,
+                                       ctypes.c_int64]
+        lib.rtc_get_object.restype = ctypes.c_int
+        lib.rtc_get_object.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_int, ctypes.POINTER(u8p),
+                                       ctypes.POINTER(ctypes.c_int64)]
+        lib.rtc_ping.restype = ctypes.c_long
+        lib.rtc_ping.argtypes = [ctypes.c_void_p]
+        lib.rtc_last_error.restype = ctypes.c_char_p
+        lib.rtc_last_error.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class CppClient:
+    """One native TCP connection to a head or daemon."""
+
+    def __init__(self, addr: Tuple[str, int]):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native cpp client unavailable "
+                               "(g++ missing or build failed)")
+        self._lib = lib
+        self._h = lib.rtc_connect(addr[0].encode(), int(addr[1]))
+        if not self._h:
+            raise ConnectionError(f"cpp client: connect to {addr} failed")
+
+    def _handle(self):
+        if not self._h:
+            raise ValueError("cpp client is closed")
+        return self._h
+
+    def _take(self, out, n) -> bytes:
+        try:
+            return ctypes.string_at(out, n.value)
+        finally:
+            self._lib.rtc_free(out)
+
+    # head KV ------------------------------------------------------------
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        rc = self._lib.rtc_kv_put(self._handle(), key, len(key), value,
+                                  len(value))
+        if rc != 0:
+            raise IOError(self.last_error())
+
+    def kv_get(self, key: bytes) -> Optional[bytes]:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_int64()
+        rc = self._lib.rtc_kv_get(self._handle(), key, len(key),
+                                  ctypes.byref(out), ctypes.byref(n))
+        if rc == 1:
+            return None
+        if rc != 0:
+            raise IOError(self.last_error())
+        return self._take(out, n)
+
+    # daemon object plane -------------------------------------------------
+    def put_object(self, oid: bytes, blob: bytes) -> None:
+        rc = self._lib.rtc_put_object(self._handle(), oid, len(oid), blob,
+                                      len(blob))
+        if rc != 0:
+            raise IOError(self.last_error())
+
+    def get_object(self, oid: bytes) -> Optional[bytes]:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_int64()
+        rc = self._lib.rtc_get_object(self._handle(), oid, len(oid),
+                                      ctypes.byref(out), ctypes.byref(n))
+        if rc == 1:
+            return None
+        if rc != 0:
+            raise IOError(self.last_error())
+        return self._take(out, n)
+
+    def ping(self) -> int:
+        pid = self._lib.rtc_ping(self._handle())
+        if pid < 0:
+            raise IOError(self.last_error())
+        return int(pid)
+
+    def last_error(self) -> str:
+        return self._lib.rtc_last_error(self._h).decode(errors="replace")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.rtc_close(self._h)
+            self._h = None

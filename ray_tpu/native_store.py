@@ -26,32 +26,16 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
-def _build() -> Optional[str]:
-    src = os.path.join(_NATIVE_DIR, "shm_store.cc")
-    if not os.path.exists(src):
-        return None
-    if os.path.exists(_SO_PATH) and (
-            os.path.getmtime(_SO_PATH) >= os.path.getmtime(src)):
-        return _SO_PATH
-    try:
-        subprocess.run(
-            ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
-             "-o", _SO_PATH, src, "-lpthread", "-lrt"],
-            check=True, capture_output=True, timeout=120)
-        return _SO_PATH
-    except Exception:
-        return None
-
-
 def _load():
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        path = _build()
-        if path is None:
+        from ray_tpu._private.native_build import load_native_so
+        lib = load_native_so("shm_store.cc", "libray_tpu_native.so",
+                             ["-lpthread", "-lrt"])
+        if lib is None:
             return None
-        lib = ctypes.CDLL(path)
         lib.rtpu_store_open.restype = ctypes.c_void_p
         lib.rtpu_store_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
         lib.rtpu_store_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
