@@ -1,10 +1,13 @@
-"""Autoscaler: reconciler loop + node providers.
+"""Autoscaler v1: the LEGACY monitor loop — superseded by
+:mod:`ray_tpu.autoscaler_v2`.
 
-Reference: v1 `autoscaler/_private/autoscaler.py` (StandardAutoscaler,
-LoadMetrics, resource_demand_scheduler bin-packing, NodeProvider) and the
-v2 reconciler (`autoscaler/v2/instance_manager/reconciler.py`). The fake
-provider mirrors `autoscaler/_private/fake_multi_node/node_provider.py` —
-the fixture the reference uses to test scaling without a cloud.
+Use ``autoscaler_v2.Reconciler`` for anything new: it is the real
+implementation (GCS-state reconciler, instance state machine, TPU
+slice-typed node catalog, provider seam), mirroring the reference's v2
+rewrite. This module stays only as the thin v1-shaped surface
+(StandardAutoscaler/LoadMetrics/NodeProvider names) for parity with
+`autoscaler/_private/autoscaler.py` and for the fake-provider test
+fixture (`autoscaler/_private/fake_multi_node/node_provider.py` role).
 
 TPU-first note: a real TPU provider allocates whole ICI slices (a node
 type = a slice topology), so `node_resources` carries `TPU` counts and

@@ -76,36 +76,54 @@ class GrpcProxy:
                     handle = handle.options(body["method"])
                 gen = handle.options(stream=True).remote(
                     *body.get("args", []), **body.get("kwargs", {}))
-                q: "_queue.Queue" = _queue.Queue()
+                # small bound: end-to-end flow control for slow clients
+                # (an unbounded queue would buffer the whole stream)
+                q: "_queue.Queue" = _queue.Queue(maxsize=8)
+                stop = threading.Event()
                 _END = object()
+
+                def offer(item) -> bool:
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.5)
+                            return True
+                        except _queue.Full:
+                            continue
+                    return False
 
                 def pump():
                     try:
                         for chunk in gen:
-                            q.put(("chunk", chunk))
-                        q.put(("end", _END))
+                            if not offer(("chunk", chunk)):
+                                return   # consumer gone: stop reading
+                        offer(("end", _END))
                     except BaseException as e:  # noqa: BLE001
-                        q.put(("err", e))
+                        offer(("err", e))
 
                 threading.Thread(target=pump, daemon=True,
                                  name="grpc-stream-pump").start()
-                while True:
-                    try:
-                        kind, item = q.get(timeout=120.0)
-                    except _queue.Empty:
-                        context.set_code(
-                            grpc.StatusCode.DEADLINE_EXCEEDED)
-                        context.set_details(
-                            "no chunk from the replica within 120s")
-                        yield _pack({"error": "chunk timeout"})
-                        return
-                    if kind == "chunk":
-                        yield _pack({"chunk": item})
-                    elif kind == "end":
-                        yield _pack({"done": True})
-                        return
-                    else:
-                        raise item
+                try:
+                    while True:
+                        try:
+                            kind, item = q.get(timeout=120.0)
+                        except _queue.Empty:
+                            context.set_code(
+                                grpc.StatusCode.DEADLINE_EXCEEDED)
+                            context.set_details(
+                                "no chunk from the replica within 120s")
+                            yield _pack({"error": "chunk timeout"})
+                            return
+                        if kind == "chunk":
+                            yield _pack({"chunk": item})
+                        elif kind == "end":
+                            yield _pack({"done": True})
+                            return
+                        else:
+                            raise item
+                finally:
+                    # client cancel / timeout / error: release the pump
+                    # (it stops at its next offer/iteration)
+                    stop.set()
             except Exception as e:  # noqa: BLE001 — shipped to client
                 context.set_code(grpc.StatusCode.INTERNAL)
                 context.set_details(f"{type(e).__name__}: {e}")

@@ -156,3 +156,49 @@ def test_wait_under_churn(ray_start_regular):
         assert done, "wait() starved despite pending work"
         collected.extend(ray_tpu.get(done))
     assert len(collected) == 60
+
+
+def test_queued_task_backlog_2000(ray_start_regular):
+    """Scale envelope, CI-sized slice of the reference's 1M-queued-task
+    target (release/benchmarks/README.md:25-31): 2,000 no-op tasks
+    queued before any get, then fully drained, results in order."""
+
+    @ray_tpu.remote
+    def val(i):
+        return i
+
+    refs = [val.remote(i) for i in range(2000)]
+    out = ray_tpu.get(refs, timeout=600)
+    assert out == list(range(2000))
+
+
+def test_many_actors_200(ray_start_regular):
+    """200 live actors (reference envelope: 40k cluster-wide; this is
+    the single-host CI slice), every one answering."""
+
+    @ray_tpu.remote(_in_process=True)
+    class Cell:
+        def __init__(self, i):
+            self.i = i
+
+        def get(self):
+            return self.i
+
+    cells = [Cell.remote(i) for i in range(200)]
+    out = ray_tpu.get([c.get.remote() for c in cells], timeout=300)
+    assert out == list(range(200))
+    for c in cells:
+        ray_tpu.kill(c)
+
+
+def test_many_object_args_one_task(ray_start_regular):
+    """1,000 object arguments to a single task (reference envelope:
+    10k+ on a 64-core box; CI slice on 1 CPU)."""
+
+    @ray_tpu.remote
+    def total(*parts):
+        return sum(parts)
+
+    refs = [ray_tpu.put(i) for i in range(1000)]
+    assert ray_tpu.get(total.remote(*refs), timeout=300) == sum(
+        range(1000))
