@@ -1,0 +1,95 @@
+"""LearnerGroup: data-parallel learner updates over a device mesh.
+
+Reference capability: ``rllib/core/learner/learner_group.py:234`` — N
+DDP learner workers, each on its own GPU, gradients all-reduced by NCCL.
+TPU-first shape: the group is ONE jitted SPMD update over a ``dp`` mesh
+axis — the minibatch is sharded across devices, params/optimizer state
+stay replicated, and XLA inserts the gradient ``psum`` exactly where DDP
+would run its all-reduce. No learner actors, no weight broadcast between
+"learners": replication is maintained by the compiler.
+
+Works with any learner whose jitted step is a pure 3-arg function
+``(params, opt_state, batch) -> (params, opt_state, metrics)`` — PPO
+and IMPALA in-tree. (SAC's step threads a 4th ``targets`` pytree and
+would need its own sharding tuple; not wrapped here.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class LearnerGroup:
+    """Wrap a learner so its gradient step runs data-parallel over a
+    mesh. The learner's host-side logic (GAE, replay, minibatching) is
+    untouched; only the jitted step is re-bound with shardings."""
+
+    def __init__(self, learner: Any, *, mesh: Optional[Mesh] = None,
+                 num_learners: Optional[int] = None,
+                 step_attr: str = "_update",
+                 impl_attr: str = "_update_impl"):
+        devices = jax.devices()
+        n = num_learners or len(devices)
+        if mesh is None:
+            if len(devices) < n:
+                raise ValueError(
+                    f"num_learners={n} but only {len(devices)} devices")
+            # the shared mesh vocabulary (all six named axes, size-1
+            # included) so learner shardings compose with the rest of
+            # the parallel stack
+            from ray_tpu.parallel.mesh import MeshSpec, build_mesh
+
+            mesh = build_mesh(MeshSpec(dp=n), devices[:n])
+        if "dp" not in mesh.shape:
+            raise ValueError(
+                f"LearnerGroup needs a 'dp' mesh axis; mesh has "
+                f"{tuple(mesh.shape)}")
+        if num_learners is not None and mesh.shape["dp"] != num_learners:
+            raise ValueError(
+                f"num_learners={num_learners} conflicts with the "
+                f"mesh's dp={mesh.shape['dp']}")
+        self.mesh = mesh
+        self.num_learners = mesh.shape["dp"]
+        self.learner = learner
+
+        replicated = NamedSharding(mesh, P())
+        batch_sharded = NamedSharding(mesh, P("dp"))
+        impl = getattr(learner, impl_attr)
+        sharded_step = jax.jit(
+            impl,
+            in_shardings=(replicated, replicated, batch_sharded),
+            out_shardings=(replicated, replicated, replicated))
+
+        def step(params, opt_state, batch):
+            # minibatch rows must divide dp; drop the ragged tail (the
+            # permutation re-covers those rows across epochs)
+            dp = self.num_learners
+            first = jax.tree.leaves(batch)[0].shape[0]
+            usable = (first // dp) * dp
+            if usable == 0:      # batch smaller than the mesh: replicate
+                return impl(params, opt_state, batch)
+            if usable != first:
+                batch = jax.tree.map(lambda x: x[:usable], batch)
+            return sharded_step(params, opt_state, batch)
+
+        setattr(learner, step_attr, step)
+
+    # the group IS the learner for the algorithm control loop
+    def update(self, rollouts):
+        return self.learner.update(rollouts)
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, weights):
+        return self.learner.set_weights(weights)
+
+
+def wrap_learner_data_parallel(learner: Any,
+                               num_learners: Optional[int] = None) -> Any:
+    """Convenience: in-place rebind (returns the same learner)."""
+    LearnerGroup(learner, num_learners=num_learners)
+    return learner
