@@ -9,6 +9,7 @@ Endpoints (reference: dashboard modules `node`, `state`, `metrics`,
   GET /api/objects            object table
   GET /api/cluster_status     resources + runtime stats summary
   GET /api/timeline           chrome-trace JSON of task events
+  GET /api/config             resolved flag table + provenance
   GET /metrics                Prometheus exposition
 """
 
@@ -81,6 +82,11 @@ class _DashboardHandler(BaseHTTPRequestHandler):
                 self._json(state_api.list_objects())
             elif path == "/api/timeline":
                 self._json(state_api.timeline())
+            elif path == "/api/config":
+                # the resolved flag table with provenance (the
+                # ray_config_def.h surface, observable)
+                from ray_tpu._private.config import cfg
+                self._json(cfg().describe())
             elif path == "/api/cluster_status":
                 rt = _worker.global_runtime()
                 import ray_tpu
@@ -94,7 +100,7 @@ class _DashboardHandler(BaseHTTPRequestHandler):
                 self._json({"endpoints": [
                     "/api/nodes", "/api/tasks", "/api/actors",
                     "/api/placement_groups", "/api/objects",
-                    "/api/cluster_status", "/api/timeline",
+                    "/api/cluster_status", "/api/timeline", "/api/config",
                     "/api/profile/cpu", "/api/profile/memory",
                     "/metrics", "/"]})
             else:

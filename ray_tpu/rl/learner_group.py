@@ -10,8 +10,9 @@ would run its all-reduce. No learner actors, no weight broadcast between
 
 Works with any learner whose jitted step is a pure 3-arg function
 ``(params, opt_state, batch) -> (params, opt_state, metrics)`` — PPO
-and IMPALA in-tree. (SAC's step threads a 4th ``targets`` pytree and
-would need its own sharding tuple; not wrapped here.)
+and IMPALA in-tree: batch-major leaves shard over dp, side inputs
+(IMPALA's bootstrap observation) stay replicated. (SAC's step threads a
+4th ``targets`` pytree and would need its own placement; not wrapped.)
 """
 
 from __future__ import annotations
@@ -58,22 +59,29 @@ class LearnerGroup:
         replicated = NamedSharding(mesh, P())
         batch_sharded = NamedSharding(mesh, P("dp"))
         impl = getattr(learner, impl_attr)
-        sharded_step = jax.jit(
-            impl,
-            in_shardings=(replicated, replicated, batch_sharded),
-            out_shardings=(replicated, replicated, replicated))
+        jitted = jax.jit(impl)   # shardings propagate from the inputs
 
         def step(params, opt_state, batch):
-            # minibatch rows must divide dp; drop the ragged tail (the
-            # permutation re-covers those rows across epochs)
+            # Shard only batch-major leaves (dim 0 == the batch/time
+            # length); side inputs like IMPALA's next_obs_last stay
+            # replicated. Ragged tails drop to the dp multiple (the
+            # epoch permutation re-covers those rows).
             dp = self.num_learners
-            first = jax.tree.leaves(batch)[0].shape[0]
-            usable = (first // dp) * dp
+            rows = max((x.shape[0] for x in jax.tree.leaves(batch)
+                        if getattr(x, "ndim", 0) >= 1), default=0)
+            usable = (rows // dp) * dp
             if usable == 0:      # batch smaller than the mesh: replicate
-                return impl(params, opt_state, batch)
-            if usable != first:
-                batch = jax.tree.map(lambda x: x[:usable], batch)
-            return sharded_step(params, opt_state, batch)
+                return jitted(params, opt_state, batch)
+
+            def place(x):
+                if getattr(x, "ndim", 0) >= 1 and x.shape[0] == rows:
+                    return jax.device_put(x[:usable], batch_sharded)
+                return jax.device_put(x, replicated)
+
+            batch = jax.tree.map(place, batch)
+            params = jax.device_put(params, replicated)
+            opt_state = jax.device_put(opt_state, replicated)
+            return jitted(params, opt_state, batch)
 
         setattr(learner, step_attr, step)
 

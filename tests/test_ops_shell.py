@@ -88,6 +88,10 @@ def test_dashboard_endpoints(ray_start_regular):
         assert status["stats"]["tasks_finished"] >= 1
         assert "ray_tpu_tasks_finished" in get("/metrics")
         assert json.loads(get("/api/timeline"))
+        from ray_tpu._private.config import cfg
+        config = json.loads(get("/api/config"))
+        assert config["pull_chunk"]["value"] == cfg().pull_chunk
+        assert "source" in config["memory_monitor"]
     finally:
         stop_dashboard()
 

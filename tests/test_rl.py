@@ -261,3 +261,29 @@ def test_learner_group_spmd_matches_single_device(ray_start_regular):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
     assert abs(m1["total_loss"] - m2["total_loss"]) < 1e-3
+
+
+def test_learner_group_wraps_impala(ray_start_regular):
+    """IMPALA's batch has a non-batch-major leaf (the bootstrap
+    observation): the group must replicate it and still match the
+    single-device update."""
+    import jax
+    from ray_tpu.rl.env import CartPoleEnv, EnvRunner
+    from ray_tpu.rl.impala import ImpalaLearner
+    from ray_tpu.rl.learner_group import LearnerGroup
+    from ray_tpu.rl.ppo import ActorCriticPolicy
+
+    runner = EnvRunner(CartPoleEnv,
+                       lambda: ActorCriticPolicy(4, 2, seed=0), seed=0)
+    rollouts = [runner.sample(256)]
+    single = ImpalaLearner(4, 2, seed=0)
+    grouped = ImpalaLearner(4, 2, seed=0)
+    LearnerGroup(grouped, num_learners=8)
+    m1 = single.update(rollouts)
+    m2 = grouped.update(rollouts)
+    assert np.isfinite(m2["loss"])
+    for a, b in zip(jax.tree.leaves(single.get_weights()),
+                    jax.tree.leaves(grouped.get_weights())):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+    assert abs(m1["loss"] - m2["loss"]) < 1e-3
