@@ -87,12 +87,13 @@ class Algorithm:
                                       **config.learner_kwargs)
             policy_factory = lambda: QPolicy(  # noqa: E731
                 obs_dim, n_actions, seed=config.seed)
-        elif config.algo.upper() == "IMPALA":
-            from ray_tpu.rl.impala import ImpalaLearner
+        elif config.algo.upper() in ("IMPALA", "APPO"):
+            from ray_tpu.rl.impala import APPOLearner, ImpalaLearner
             from ray_tpu.rl.ppo import ActorCriticPolicy
-            self.learner = ImpalaLearner(obs_dim, n_actions,
-                                         seed=config.seed,
-                                         **config.learner_kwargs)
+            cls = (APPOLearner if config.algo.upper() == "APPO"
+                   else ImpalaLearner)
+            self.learner = cls(obs_dim, n_actions, seed=config.seed,
+                               **config.learner_kwargs)
             policy_factory = lambda: ActorCriticPolicy(  # noqa: E731
                 obs_dim, n_actions, seed=config.seed)
         elif config.algo.upper() == "SAC":
@@ -172,7 +173,7 @@ class Algorithm:
     def train(self) -> Dict[str, Any]:
         """One training iteration (reference Algorithm.step)."""
         cfg = self.config
-        if cfg.algo.upper() == "IMPALA":
+        if cfg.algo.upper() in ("IMPALA", "APPO"):
             return self._train_async()
         metrics: Dict[str, Any] = {}
         for _ in range(cfg.train_iterations_per_call):

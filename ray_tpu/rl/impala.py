@@ -70,6 +70,11 @@ class ImpalaLearner:
         self.num_updates = 0
 
     # -- jitted update ---------------------------------------------------
+    def _pg_loss(self, target_logp, behavior_logp, pg_adv):
+        """Policy objective on the V-trace advantages; APPO overrides
+        with the clipped surrogate."""
+        return -jnp.mean(target_logp * pg_adv)
+
     def _loss(self, params, batch):
         logits = _mlp_apply(params["pi"], batch["obs"])        # [T, A]
         logp_all = jax.nn.log_softmax(logits)
@@ -84,7 +89,7 @@ class ImpalaLearner:
             jax.lax.stop_gradient(values),
             jax.lax.stop_gradient(bootstrap),
             rho_bar=self.rho_bar, c_bar=self.c_bar)
-        pg_loss = -jnp.mean(target_logp * pg_adv)
+        pg_loss = self._pg_loss(target_logp, batch["logp"], pg_adv)
         vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
         entropy = -jnp.mean(
             jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
@@ -128,3 +133,25 @@ class ImpalaLearner:
 
     def set_weights(self, params):
         self.policy.set_weights(params)
+
+
+class APPOLearner(ImpalaLearner):
+    """APPO (reference: ``rllib/algorithms/appo/``): the IMPALA
+    architecture (async runners, V-trace target correction) with PPO's
+    clipped-surrogate policy objective on the V-trace advantages —
+    tolerates more policy lag than plain IMPALA's policy gradient."""
+
+    def __init__(self, obs_dim: int, n_actions: int, *,
+                 clip: float = 0.2, **kwargs):
+        super().__init__(obs_dim, n_actions, **kwargs)
+        # read at first trace (after __init__), so setting it after
+        # super() is safe; the inherited jitted _update dispatches to
+        # THIS class's _pg_loss through self
+        self.clip = clip
+
+    def _pg_loss(self, target_logp, behavior_logp, pg_adv):
+        # PPO clip on the importance ratio vs the BEHAVIOR policy
+        ratio = jnp.exp(target_logp - behavior_logp)
+        unclipped = ratio * pg_adv
+        clipped = jnp.clip(ratio, 1 - self.clip, 1 + self.clip) * pg_adv
+        return -jnp.mean(jnp.minimum(unclipped, clipped))

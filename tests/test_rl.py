@@ -287,3 +287,20 @@ def test_learner_group_wraps_impala(ray_start_regular):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-5)
     assert abs(m1["loss"] - m2["loss"]) < 1e-3
+
+
+def test_appo_runs_async_with_clipped_vtrace(ray_start_regular):
+    """APPO = IMPALA architecture + PPO clip on V-trace advantages."""
+    from ray_tpu.rl import AlgorithmConfig
+
+    algo = (AlgorithmConfig(algo="APPO")
+            .environment("CartPole-v1")
+            .env_runners(2, rollout_fragment_length=128)
+            .training(lr=1e-3, clip=0.2)
+            .build())
+    m = {}
+    for _ in range(3):
+        m = algo.train()
+    algo.stop()
+    assert m["num_learner_updates"] >= 6   # async per-fragment updates
+    assert np.isfinite(m["pg_loss"]) and np.isfinite(m["vf_loss"])
