@@ -232,6 +232,27 @@ def cmd_cluster_status(args) -> int:
     return 0
 
 
+def cmd_serve_deploy(args) -> int:
+    """Deploy Serve applications from a YAML/JSON config (the
+    `serve deploy` role)."""
+    _init_runtime(args)
+    from ray_tpu import serve
+
+    handles = serve.run_config(args.config_file)
+    print(f"deployed {len(handles)} application(s): "
+          f"{sorted(handles)}")
+    if args.http_port >= 0:
+        port = serve.start_http_proxy(port=args.http_port)
+        print(f"http proxy on :{port}")
+        import time
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            return 0
+    return 0
+
+
 def cmd_job_submit(args) -> int:
     _init_runtime(args)
     from ray_tpu.job import JobSubmissionClient
@@ -272,6 +293,10 @@ def main(argv=None) -> int:
     p.add_argument("--duration", type=float, default=2.0)
     p = sub.add_parser("dashboard")
     p.add_argument("--port", type=int, default=8265)
+    p = sub.add_parser("serve-deploy")
+    p.add_argument("config_file")
+    p.add_argument("--http-port", type=int, default=-1,
+                   help=">=0: start the HTTP proxy and block")
     p = sub.add_parser("job-submit")
     p.add_argument("entrypoint")
     p.add_argument("--timeout", type=float, default=300.0)
@@ -283,7 +308,7 @@ def main(argv=None) -> int:
         "status": cmd_status, "summary": cmd_summary,
         "memory": cmd_memory, "timeline": cmd_timeline,
         "microbenchmark": cmd_microbenchmark, "dashboard": cmd_dashboard,
-        "job-submit": cmd_job_submit,
+        "serve-deploy": cmd_serve_deploy, "job-submit": cmd_job_submit,
     }[args.command]
     return handler(args)
 

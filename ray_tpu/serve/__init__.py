@@ -14,11 +14,12 @@ from ray_tpu.serve.multiplex import (get_multiplexed_model_id, multiplexed)
 from ray_tpu.serve.deployment import (Application, AutoscalingConfig,
                                       Deployment, deployment)
 from ray_tpu.serve.router import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.schema import run_config
 
 __all__ = [
     "deployment", "Deployment", "Application", "AutoscalingConfig",
     "run", "shutdown", "status", "delete", "get_deployment_handle",
     "get_app_handle", "start_http_proxy",
     "batch", "DeploymentHandle", "DeploymentResponse",
-    "multiplexed", "get_multiplexed_model_id",
+    "multiplexed", "get_multiplexed_model_id", "run_config",
 ]

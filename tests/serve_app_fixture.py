@@ -1,0 +1,25 @@
+"""Importable Serve applications for the declarative-config tests
+(the role of a user's app module named by ``import_path``)."""
+
+from ray_tpu import serve
+
+
+@serve.deployment(name="Scaler")
+class Scaler:
+    def __init__(self, factor: int = 2):
+        self.factor = factor
+
+    def __call__(self, x):
+        return x * self.factor
+
+    def reconfigure(self, user_config):
+        self.factor = user_config.get("factor", self.factor)
+
+
+# a pre-bound Application
+app = Scaler.bind(2)
+
+
+def build_app(args):
+    """A builder callable: config args choose the bound arguments."""
+    return Scaler.bind(int(args.get("factor", 3)))

@@ -314,3 +314,75 @@ def test_llm_serve_token_streaming(serve_cluster):
     deltas = [c for c in chunks if "delta" in c]
     assert 1 <= len(deltas) <= 8
     assert chunks[-1]["usage"]["completion_tokens"] == len(deltas)
+
+
+# ---------------------------------------------------------------------------
+# Declarative app config (reference: serve/schema.py + `serve deploy`)
+# ---------------------------------------------------------------------------
+
+def test_run_config_from_yaml(serve_cluster, tmp_path):
+    cfg = tmp_path / "app.yaml"
+    cfg.write_text("""
+applications:
+  - name: default
+    import_path: tests.serve_app_fixture:app
+    deployments:
+      - name: Scaler
+        num_replicas: 2
+        user_config: {factor: 5}
+""")
+    handles = serve.run_config(str(cfg))
+    assert set(handles) == {"default"}
+    # user_config override applied via reconfigure
+    assert handles["default"].remote(10).result(timeout=30) == 50
+    st = serve.status()
+    dep = st["Scaler"]
+    assert dep["target_replicas"] == 2
+
+
+def test_run_config_builder_with_args(serve_cluster):
+    handles = serve.run_config({
+        "applications": [{
+            "name": "built",
+            "import_path": "tests.serve_app_fixture:build_app",
+            "args": {"factor": 7},
+        }]})
+    assert handles["built"].remote(3).result(timeout=30) == 21
+
+
+def test_run_config_rejects_bad_entries(serve_cluster, tmp_path):
+    with pytest.raises(ValueError, match="applications"):
+        serve.run_config({"nope": []})
+    with pytest.raises(ValueError, match="unknown deployment option"):
+        serve.run_config({"applications": [{
+            "import_path": "tests.serve_app_fixture:app",
+            "deployments": [{"name": "Scaler", "bogus_knob": 1}]}]})
+
+
+def test_run_config_validation_errors(serve_cluster):
+    import pytest as _pytest
+
+    # typo'd deployment name must raise, not silently no-op
+    with _pytest.raises(ValueError, match="match no deployment"):
+        serve.run_config({"applications": [{
+            "import_path": "tests.serve_app_fixture:app",
+            "deployments": [{"name": "Sclaer", "num_replicas": 9}]}]})
+    # override entry without a name
+    with _pytest.raises(ValueError, match="missing 'name'"):
+        serve.run_config({"applications": [{
+            "import_path": "tests.serve_app_fixture:app",
+            "deployments": [{"num_replicas": 2}]}]})
+    # internal fields are not part of the declarative surface
+    with _pytest.raises(ValueError, match="unknown deployment option"):
+        serve.run_config({"applications": [{
+            "import_path": "tests.serve_app_fixture:app",
+            "deployments": [{"name": "Scaler",
+                             "func_or_class": "x:y"}]}]})
+    # duplicate app names shadow routes
+    with _pytest.raises(ValueError, match="duplicate application"):
+        serve.run_config({"applications": [
+            {"import_path": "tests.serve_app_fixture:app"},
+            {"import_path": "tests.serve_app_fixture:app"}]})
+    # a typo'd path is a file error, not a schema error
+    with _pytest.raises(FileNotFoundError):
+        serve.run_config("/nonexistent/app.yaml")
