@@ -41,6 +41,29 @@ _RETRYABLE = ("UNAVAILABLE", "Unavailable", "backend", "DEADLINE_EXCEEDED",
 
 _CPU_RESERVE = 120  # seconds kept back for the CPU-fallback child
 
+# The axon PJRT plugin dials the relayed TPU terminal on these loopback
+# ports (stateless InitRequest :8083, session :8082 — see
+# tools/evidence/tpu_init_hang_r4.log). When the tunnel is down the
+# plugin retries connecting FOREVER inside PJRT_Client_Create (no
+# claim timeout), which is the "hang at importing jax backend" of
+# rounds 1-3. A TCP preflight turns that into a fast, explained skip.
+_TUNNEL_PORTS = (8083, 8082)
+
+
+def _tunnel_up(timeout: float = 3.0) -> bool:
+    """True only when EVERY terminal port accepts: a half-up tunnel
+    (init :8083 alive, session :8082 dead) would pass a weaker check
+    and still hang the attempt at the first session RPC."""
+    import socket
+
+    for port in _TUNNEL_PORTS:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout):
+                pass
+        except OSError:
+            return False
+    return True
+
 
 def _hb(stage: str) -> None:
     """Heartbeat on stderr: survives in the captured tail if we get killed."""
@@ -224,6 +247,11 @@ def main() -> int:
 
     err = ""
     for attempt in range(attempts):
+        if not _tunnel_up():
+            err = ("tunnel down: 127.0.0.1:8083/:8082 closed (the axon "
+                   "PJRT plugin would retry-connect forever; see "
+                   "tools/evidence/tpu_init_hang_r4.log)")
+            break
         budget = min(attempt_cap, remaining() - _CPU_RESERVE)
         if budget < 30:  # not enough room left for a real attempt
             err = err or "no budget left for accelerator attempt"

@@ -61,9 +61,13 @@ void pack_uint(std::string& out, uint64_t v) {
     out.push_back(static_cast<char>(0xcd));
     out.push_back(static_cast<char>(v >> 8));
     out.push_back(static_cast<char>(v));
-  } else {
+  } else if (v <= 0xffffffffULL) {
     out.push_back(static_cast<char>(0xce));
     for (int s = 24; s >= 0; s -= 8)
+      out.push_back(static_cast<char>(v >> s));
+  } else {
+    out.push_back(static_cast<char>(0xcf));
+    for (int s = 56; s >= 0; s -= 8)
       out.push_back(static_cast<char>(v >> s));
   }
 }
@@ -75,10 +79,14 @@ void pack_str(std::string& out, const std::string& s) {
   } else if (n <= 0xff) {
     out.push_back(static_cast<char>(0xd9));
     out.push_back(static_cast<char>(n));
-  } else {
+  } else if (n <= 0xffff) {
     out.push_back(static_cast<char>(0xda));
     out.push_back(static_cast<char>(n >> 8));
     out.push_back(static_cast<char>(n));
+  } else {
+    out.push_back(static_cast<char>(0xdb));
+    for (int s = 24; s >= 0; s -= 8)
+      out.push_back(static_cast<char>(n >> s));
   }
   out += s;
 }

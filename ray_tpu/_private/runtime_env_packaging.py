@@ -124,7 +124,8 @@ def resolve_local(uri: str) -> str:
     return extract_blob(uri, fetch_pkg_blob(uri))
 
 
-_PREPARED: Dict[int, Tuple[tuple, dict]] = {}
+_PREPARED: Dict[int, Tuple[tuple, dict, float]] = {}
+_PREPARED_TTL = 0.25   # seconds between tree re-validations
 
 
 def prepare_runtime_env(runtime_env):
@@ -134,13 +135,22 @@ def prepare_runtime_env(runtime_env):
 
     Submission hot path: the prepared result is memoized per
     runtime_env dict (a decorator's options dict is stable across
-    .remote() calls), so repeated submissions skip the tree walk."""
+    .remote() calls) — but only for ``_PREPARED_TTL``: edits to a
+    working_dir between submissions must be re-packaged, and only the
+    tree walk in ``package_directory`` (which fingerprints by newest
+    mtime and skips re-zipping when unchanged) can see them. The TTL
+    amortizes that walk over hot submission loops without letting
+    workers run stale code for the process lifetime."""
     if not runtime_env:
         return runtime_env
+    import time as _time
+
     fingerprint = (runtime_env.get("working_dir"),
                    tuple(runtime_env.get("py_modules") or ()))
+    now = _time.monotonic()
     cached = _PREPARED.get(id(runtime_env))
-    if cached is not None and cached[0] == fingerprint:
+    if (cached is not None and cached[0] == fingerprint
+            and now - cached[2] < _PREPARED_TTL):
         return cached[1]
     out = dict(runtime_env)
     excludes = out.get("excludes") or []
@@ -155,5 +165,5 @@ def prepare_runtime_env(runtime_env):
             else m for m in mods]
     if len(_PREPARED) > 256:
         _PREPARED.clear()   # unbounded decorator churn backstop
-    _PREPARED[id(runtime_env)] = (fingerprint, out)
+    _PREPARED[id(runtime_env)] = (fingerprint, out, now)
     return out

@@ -31,7 +31,12 @@ class LearnerGroup:
     def __init__(self, learner: Any, *, mesh: Optional[Mesh] = None,
                  num_learners: Optional[int] = None,
                  step_attr: str = "_update",
-                 impl_attr: str = "_update_impl"):
+                 impl_attr: str = "_update_impl",
+                 ragged: str = "replicate"):
+        if ragged not in ("replicate", "truncate"):
+            raise ValueError(f"ragged must be 'replicate' or 'truncate', "
+                             f"got {ragged!r}")
+        self.ragged = ragged
         devices = jax.devices()
         n = num_learners or len(devices)
         if mesh is None:
@@ -64,13 +69,19 @@ class LearnerGroup:
         def step(params, opt_state, batch):
             # Shard only batch-major leaves (dim 0 == the batch/time
             # length); side inputs like IMPALA's next_obs_last stay
-            # replicated. Ragged tails drop to the dp multiple (the
-            # epoch permutation re-covers those rows).
+            # replicated. A ragged tail (rows % dp != 0) runs replicated
+            # by default: truncating is unsound for time-major learners
+            # whose side inputs bootstrap from the step AFTER the last
+            # row (IMPALA's next_obs_last) — dropping tail steps would
+            # silently bias the V-trace targets. ``ragged="truncate"``
+            # opts i.i.d.-minibatch learners (PPO) back into dropping
+            # the tail, where the epoch permutation re-covers those rows.
             dp = self.num_learners
             rows = max((x.shape[0] for x in jax.tree.leaves(batch)
                         if getattr(x, "ndim", 0) >= 1), default=0)
             usable = (rows // dp) * dp
-            if usable == 0:      # batch smaller than the mesh: replicate
+            if usable == 0 or (usable != rows
+                               and self.ragged == "replicate"):
                 return jitted(params, opt_state, batch)
 
             def place(x):
@@ -97,7 +108,8 @@ class LearnerGroup:
 
 
 def wrap_learner_data_parallel(learner: Any,
-                               num_learners: Optional[int] = None) -> Any:
+                               num_learners: Optional[int] = None,
+                               ragged: str = "replicate") -> Any:
     """Convenience: in-place rebind (returns the same learner)."""
-    LearnerGroup(learner, num_learners=num_learners)
+    LearnerGroup(learner, num_learners=num_learners, ragged=ragged)
     return learner

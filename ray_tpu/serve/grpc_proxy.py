@@ -52,9 +52,19 @@ class GrpcProxy:
                 handle = self._get_handle(body.get("application"))
                 if body.get("method"):
                     handle = handle.options(body["method"])
+                # Honor the client's gRPC deadline (the reference proxy
+                # propagates it); fall back to 60 s when the client set
+                # none. A small margin keeps our timeout error readable
+                # instead of racing the transport's DEADLINE_EXCEEDED.
+                # The server-side ceiling stops an hour-long client
+                # deadline from pinning a proxy worker thread on a
+                # wedged replica.
+                rem = context.time_remaining()
+                wait = (min(max(0.1, rem - 0.5), 600.0)
+                        if rem is not None else 60.0)
                 result = handle.remote(
                     *body.get("args", []),
-                    **body.get("kwargs", {})).result(timeout=60)
+                    **body.get("kwargs", {})).result(timeout=wait)
                 return _pack({"result": result})
             except Exception as e:  # noqa: BLE001 — shipped to client
                 context.set_code(grpc.StatusCode.INTERNAL)

@@ -320,11 +320,7 @@ class Tuner:
                 except Exception:
                     entries = []
                 for entry in entries:
-                    trial.results.append(entry["metrics"])
-                    _cb(cbs, "on_report", entry["metrics"],
-                        len(trial.results), trial_id=trial.id)
-                    if entry.get("checkpoint") is not None:
-                        trial.checkpoint = entry["checkpoint"]
+                    self._consume_entry(trial, entry, cbs)
                     if scheduler.on_result(trial, entry["metrics"]) == STOP:
                         trial.actor.stop.remote()
                         trial.status = "STOPPED"
@@ -334,7 +330,7 @@ class Tuner:
             done_set = set(done)
             for trial in list(running):
                 if trial.run_ref in done_set:
-                    self._finalize(trial, scheduler)
+                    self._finalize(trial, scheduler, cbs)
                     running.remove(trial)
                     if searcher is not None:
                         value = trial.last_result.get(self.cfg.metric)
@@ -349,7 +345,21 @@ class Tuner:
         _cb(cbs, "on_run_end", grid)
         return grid
 
-    def _finalize(self, trial: Trial, scheduler: TrialScheduler) -> None:
+    @staticmethod
+    def _consume_entry(trial: Trial, entry: dict, cbs) -> None:
+        """Per-report handling shared by the live event loop and the
+        finalize drain: record metrics, fire logger callbacks, advance
+        the trial's checkpoint pointer to the latest reported one."""
+        from ray_tpu.train.callbacks import invoke as _cb
+
+        trial.results.append(entry["metrics"])
+        _cb(cbs, "on_report", entry["metrics"],
+            len(trial.results), trial_id=trial.id)
+        if entry.get("checkpoint") is not None:
+            trial.checkpoint = entry["checkpoint"]
+
+    def _finalize(self, trial: Trial, scheduler: TrialScheduler,
+                  cbs=()) -> None:
         try:
             ray_tpu.get(trial.run_ref)
             if trial.status != "STOPPED":
@@ -373,7 +383,7 @@ class Tuner:
             for attempt in range(2):
                 try:
                     for entry in ray_tpu.get(poll_ref, timeout=30):
-                        trial.results.append(entry["metrics"])
+                        self._consume_entry(trial, entry, cbs)
                     break
                 except Exception:
                     if attempt == 1:

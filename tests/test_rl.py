@@ -289,6 +289,33 @@ def test_learner_group_wraps_impala(ray_start_regular):
     assert abs(m1["loss"] - m2["loss"]) < 1e-3
 
 
+def test_learner_group_ragged_impala_matches_single_device(
+        ray_start_regular):
+    """A time-major fragment whose length is NOT a dp multiple must not
+    be truncated (the bootstrap obs belongs to the step after the last
+    row; dropping tail steps biases V-trace targets). The group falls
+    back to the replicated path and matches single-device exactly."""
+    import jax
+    from ray_tpu.rl.env import CartPoleEnv, EnvRunner
+    from ray_tpu.rl.impala import ImpalaLearner
+    from ray_tpu.rl.learner_group import LearnerGroup
+    from ray_tpu.rl.ppo import ActorCriticPolicy
+
+    runner = EnvRunner(CartPoleEnv,
+                       lambda: ActorCriticPolicy(4, 2, seed=0), seed=0)
+    rollouts = [runner.sample(250)]       # 250 % 8 != 0
+    single = ImpalaLearner(4, 2, seed=0)
+    grouped = ImpalaLearner(4, 2, seed=0)
+    LearnerGroup(grouped, num_learners=8)
+    m1 = single.update(rollouts)
+    m2 = grouped.update(rollouts)
+    for a, b in zip(jax.tree.leaves(single.get_weights()),
+                    jax.tree.leaves(grouped.get_weights())):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+    assert abs(m1["loss"] - m2["loss"]) < 1e-3
+
+
 def test_appo_runs_async_with_clipped_vtrace(ray_start_regular):
     """APPO = IMPALA architecture + PPO clip on V-trace advantages."""
     from ray_tpu.rl import AlgorithmConfig

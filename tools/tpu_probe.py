@@ -1,0 +1,61 @@
+"""TPU backend-init probe with hang diagnostics (VERDICT r3 #1).
+
+Three rounds of bench runs hung at "importing jax backend" with no
+traceback.  This probe captures WHERE: ``faulthandler.dump_traceback_later``
+fires every 30 s into stderr, TPU plugin logging is forced on, and each
+stage heartbeats.  Run under a timeout; the dumped stacks survive the kill.
+
+Usage: timeout -k 5 300 python tools/tpu_probe.py 2>&1 | tee probe.log
+"""
+from __future__ import annotations
+
+import faulthandler
+import os
+import sys
+import time
+
+T0 = time.time()
+
+
+def hb(msg: str) -> None:
+    print(f"[probe +{time.time() - T0:6.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    # Maximum plugin verbosity.
+    os.environ.setdefault("TPU_STDERR_LOG_LEVEL", "0")
+    os.environ.setdefault("TPU_MIN_LOG_LEVEL", "0")
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "0")
+    os.environ.setdefault("TPU_VMODULE", "tpu_driver=2")
+    os.environ.setdefault("JAX_DEBUG_LOG_MODULES", "jax._src.xla_bridge")
+    # Periodic stack dumps: if anything below blocks, we learn the frame.
+    faulthandler.enable()
+    faulthandler.dump_traceback_later(30, repeat=True, file=sys.stderr)
+
+    hb("importing jax")
+    import jax
+
+    hb(f"jax {jax.__version__} imported; calling jax.devices()")
+    devs = jax.devices()
+    hb(f"devices: {devs}")
+
+    d = devs[0]
+    hb(f"platform={d.platform} kind={getattr(d, 'device_kind', '?')}")
+
+    import jax.numpy as jnp
+
+    hb("running tiny matmul")
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    y = (x @ x).block_until_ready()
+    hb(f"matmul ok: {float(y[0, 0])}")
+
+    hb("running jitted matmul")
+    f = jax.jit(lambda a: a @ a)
+    z = f(x).block_until_ready()
+    hb(f"jit ok: {float(z[0, 0])}")
+    print("PROBE_OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
