@@ -251,6 +251,11 @@ def main() -> int:
             err = ("tunnel down: 127.0.0.1:8083/:8082 closed (the axon "
                    "PJRT plugin would retry-connect forever; see "
                    "tools/evidence/tpu_init_hang_r4.log)")
+            # a flapping relay may come back: re-probe while attempts
+            # and budget remain instead of giving up on the first miss
+            if attempt + 1 < attempts and remaining() > _CPU_RESERVE + 45:
+                time.sleep(10)
+                continue
             break
         budget = min(attempt_cap, remaining() - _CPU_RESERVE)
         if budget < 30:  # not enough room left for a real attempt

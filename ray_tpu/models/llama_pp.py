@@ -1,0 +1,209 @@
+"""Pipeline-parallel Llama: the flagship model on a real ``pp`` mesh axis.
+
+Reference capability: the reference expresses pipeline parallelism as a
+compiled actor DAG with NCCL channels and an explicit tick schedule
+(``python/ray/dag/compiled_dag_node.py:809``, schedule construction
+``python/ray/dag/dag_node_operation.py:14-24``). TPU-first shape: the
+schedule is DATA, not actors — the stacked layer params get a leading
+``[num_stages, layers_per_stage, ...]`` dim sharded over the ``pp`` mesh
+axis, and the GPipe fill/drain schedule is the ``lax.scan`` +
+``lax.ppermute`` program in ``ray_tpu.parallel.pipeline``. Autodiff
+through the scan IS the backward pipeline schedule; XLA overlaps the
+neighbor ppermute with stage compute over ICI.
+
+Composition (the classic 3D recipe):
+  - ``pp``    — stages (this module)
+  - ``dp``/``fsdp`` — batch axes for the microbatches (both act as plain
+    data parallelism here: inside the stage shard_map weights are NOT
+    fsdp-sharded — ZeRO resharding of stage-local weights would need
+    per-leaf all-gathers in the stage body)
+  - ``tp``    — Megatron tensor parallelism INSIDE each stage: head-dim
+    sharded qkv/wo, ffn-dim sharded gate/up/down, with the two psums per
+    block placed exactly where GSPMD would put them (shard_map makes the
+    collectives explicit)
+  - ``sp``/``ep`` must be 1 (ring/Ulysses CP and MoE dispatch compose
+    with GSPMD in ``LlamaModel``/``MoEModel``, not the shard_map stage)
+
+Embedding lookup and the LM head run OUTSIDE the pipelined section under
+GSPMD (replicated over pp, tp-sharded via the vocab-parallel lookup), so
+the stage contract stays ``y.shape == x.shape`` at every boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.models.llama import LlamaConfig, LlamaModel, Params
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+def stack_stages(params: Params, num_stages: int) -> Params:
+    """Reshape the stacked-layer leaves [L, ...] -> [S, L/S, ...].
+
+    Stage s holds layers ``s*L/S .. (s+1)*L/S - 1`` — the same order the
+    un-pipelined ``lax.scan`` applies them, so a ``LlamaModel`` checkpoint
+    restacks losslessly in either direction."""
+    out = dict(params)
+    out["layers"] = jax.tree.map(
+        lambda p: p.reshape((num_stages, p.shape[0] // num_stages)
+                            + p.shape[1:]),
+        params["layers"])
+    return out
+
+
+def unstack_stages(params: Params) -> Params:
+    """Inverse of :func:`stack_stages` (for checkpoint interop)."""
+    out = dict(params)
+    out["layers"] = jax.tree.map(
+        lambda p: p.reshape((p.shape[0] * p.shape[1],) + p.shape[2:]),
+        params["layers"])
+    return out
+
+
+# Per-leaf PartitionSpecs for the [S, l, ...] stage weights: leading dim
+# over pp, Megatron tp on the head/ffn dims, everything else replicated
+# (see module docstring for why fsdp stays off stage weights).
+_STAGE_SPECS: Dict[str, P] = {
+    "attn_norm": P("pp", None, None),
+    "wq": P("pp", None, None, "tp", None),
+    "wk": P("pp", None, None, "tp", None),
+    "wv": P("pp", None, None, "tp", None),
+    "wo": P("pp", None, "tp", None, None),
+    "mlp_norm": P("pp", None, None),
+    "w_gate": P("pp", None, None, "tp"),
+    "w_up": P("pp", None, None, "tp"),
+    "w_down": P("pp", None, "tp", None),
+}
+
+
+class PipelinedLlama:
+    """Stage-split Llama driven by the GPipe microbatch schedule.
+
+    Exposes the same functional surface as ``LlamaModel`` (``init`` /
+    ``apply`` / ``loss`` / ``param_shardings``) so ``make_train_step``,
+    the JaxTrainer and the dryrun drive it unchanged.
+
+    Reference parity contract: same forward math as ``LlamaModel`` —
+    ``tests/test_pipeline_llama.py`` asserts loss parity with pp=1.
+    """
+
+    def __init__(self, cfg: LlamaConfig, mesh, *,
+                 num_microbatches: int = 2):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.num_microbatches = num_microbatches
+        self.num_stages = mesh.shape.get("pp", 1)
+        if self.num_stages < 2:
+            raise ValueError(
+                f"PipelinedLlama needs a pp>=2 mesh axis, got "
+                f"pp={self.num_stages}; use LlamaModel for pp=1")
+        if cfg.n_layers % self.num_stages != 0:
+            raise ValueError(
+                f"n_layers={cfg.n_layers} not divisible by "
+                f"pp={self.num_stages}")
+        if mesh.shape.get("sp", 1) != 1 or mesh.shape.get("ep", 1) != 1:
+            raise ValueError(
+                "PipelinedLlama composes pp x dp x fsdp x tp; sp/ep must "
+                "be 1 (context parallelism lives in LlamaModel's GSPMD "
+                "path)")
+        tp = mesh.shape.get("tp", 1)
+        if cfg.n_heads % tp or cfg.n_kv_heads % tp or cfg.ffn_dim % tp:
+            raise ValueError(
+                f"n_heads/n_kv_heads/ffn_dim must divide tp={tp}")
+        self._tp = tp
+        # the un-pipelined twin supplies init + the vocab-parallel
+        # embedding lookup and activation constraints
+        base_cfg = cfg if cfg.attention_impl != "flash" else \
+            dataclasses.replace(cfg, attention_impl="ring")
+        self._base = LlamaModel(base_cfg, mesh=mesh)
+        self._angles = rope_frequencies(cfg.head_dim, cfg.max_seq_len,
+                                        theta=cfg.rope_theta)
+
+    # -- init / shardings --------------------------------------------------
+    def init(self, rng: jax.Array) -> Params:
+        return stack_stages(self._base.init(rng), self.num_stages)
+
+    def param_shardings(self):
+        base = self._base.param_shardings()
+        out = dict(base)
+        out["layers"] = {
+            name: NamedSharding(self.mesh, _STAGE_SPECS[name])
+            for name in base["layers"]}
+        return out
+
+    # -- stage body (runs INSIDE shard_map: collectives are manual) --------
+    def _stage_fn(self, local_layers: Params, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        dt = cfg.dtype
+        angles = self._angles
+
+        def block(x, layer):
+            h = rms_norm(x, layer["attn_norm"], eps=cfg.norm_eps)
+            q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
+            kk = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(dt))
+            vv = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(dt))
+            q = apply_rope(q, angles)
+            kk = apply_rope(kk, angles)
+            # local heads only (tp shards the head dim); the kernel
+            # dispatcher picks flash on TPU when shapes tile
+            o = attention(q, kk, vv, causal=True)
+            o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
+            # Megatron psum #1: wo is row-sharded over tp
+            o = jax.lax.psum(o, "tp")
+            x = x + o
+            h = rms_norm(x, layer["mlp_norm"], eps=cfg.norm_eps)
+            gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(dt))
+            up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(dt))
+            down = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                              layer["w_down"].astype(dt))
+            # Megatron psum #2: w_down is row-sharded over tp
+            return x + jax.lax.psum(down, "tp")
+
+        if cfg.remat:
+            block = jax.checkpoint(block)
+
+        def scan_body(x, layer):
+            return block(x, layer), None
+
+        y, _ = jax.lax.scan(scan_body, x, local_layers)
+        return y
+
+    # -- forward -----------------------------------------------------------
+    def apply(self, params: Params, tokens: jax.Array) -> jax.Array:
+        """tokens [B, S] int32 -> logits [B, S, V] (f32)."""
+        from ray_tpu.parallel.pipeline import pipelined
+
+        cfg = self.cfg
+        B = tokens.shape[0]
+        if B % self.num_microbatches:
+            raise ValueError(
+                f"batch {B} not divisible by num_microbatches="
+                f"{self.num_microbatches}")
+        x = self._base._embed_lookup(params["embed"].astype(cfg.dtype),
+                                     tokens)
+        x = self._base._constrain(x, "batch", None, "embed")
+
+        param_specs = {name: _STAGE_SPECS[name]
+                       for name in params["layers"]}
+        run = pipelined(self._stage_fn, self.mesh,
+                        num_microbatches=self.num_microbatches,
+                        param_specs=param_specs)
+        x = run(params["layers"], x)
+
+        x = rms_norm(x, params["norm_f"], eps=cfg.norm_eps)
+        head = (params["embed"].T if cfg.tie_embeddings
+                else params["lm_head"])
+        logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype))
+        logits = self._base._constrain(logits, "batch", None, "vocab")
+        return logits.astype(jnp.float32)
+
+    # identical objective, routed through the pipelined apply (the
+    # base implementation only touches self.apply)
+    loss = LlamaModel.loss

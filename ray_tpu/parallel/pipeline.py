@@ -85,7 +85,7 @@ def pipelined(stage_fn: Callable,
               *,
               num_microbatches: int,
               axis_name: str = "pp",
-              params_spec: Optional[P] = None,
+              param_specs: Optional[Any] = None,
               batch_axes: Tuple[str, ...] = ("dp", "fsdp")) -> Callable:
     """Wrap a stage function into a full-batch pipelined forward.
 
@@ -94,12 +94,14 @@ def pipelined(stage_fn: Callable,
         sharded along ``pp``.
       - ``batch``: ``[global_batch, ...]`` sharded along the data axes;
         reshaped to microbatches internally.
+      - ``param_specs``: optional pytree of ``PartitionSpec`` (leading dim
+        must be the pp axis) so stage weights can ALSO shard over other
+        axes (e.g. Megatron tp) — inside the shard_map the stage_fn sees
+        its local shard and owns the matching collectives.
     """
-    from jax import shard_map
+    from ray_tpu.parallel.mesh import shard_map_compat
 
     num_stages = mesh.shape[axis_name]
-
-    other_axes = tuple(a for a in mesh.axis_names if a != axis_name)
 
     def in_params_spec(leaf_ndim):
         return P(axis_name, *([None] * (leaf_ndim - 1)))
@@ -113,16 +115,16 @@ def pipelined(stage_fn: Callable,
                 num_stages=num_stages,
                 num_microbatches=num_microbatches)
 
-        p_specs = jax.tree_util.tree_map(
-            lambda p: in_params_spec(p.ndim), stacked_params)
+        p_specs = (param_specs if param_specs is not None
+                   else jax.tree_util.tree_map(
+                       lambda p: in_params_spec(p.ndim), stacked_params))
         # microbatch the (locally sharded) batch dim
         mb = batch.reshape((num_microbatches, -1) + batch.shape[1:])
         mb_spec = P(None, batch_axes, *([None] * (batch.ndim - 1)))
-        out = shard_map(
-            inner, mesh=mesh,
-            in_specs=(p_specs, mb_spec),
-            out_specs=mb_spec,
-            check_vma=False,
+        out = shard_map_compat(
+            inner, mesh,
+            (p_specs, mb_spec),
+            mb_spec,
         )(stacked_params, mb)
         return out.reshape((-1,) + out.shape[2:])
 

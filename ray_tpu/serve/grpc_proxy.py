@@ -66,6 +66,11 @@ class GrpcProxy:
                     *body.get("args", []),
                     **body.get("kwargs", {})).result(timeout=wait)
                 return _pack({"result": result})
+            except TimeoutError as e:
+                # same retryable status the streaming path emits
+                context.set_code(grpc.StatusCode.DEADLINE_EXCEEDED)
+                context.set_details(f"{type(e).__name__}: {e}")
+                return _pack({"error": f"{type(e).__name__}: {e}"})
             except Exception as e:  # noqa: BLE001 — shipped to client
                 context.set_code(grpc.StatusCode.INTERNAL)
                 context.set_details(f"{type(e).__name__}: {e}")
@@ -198,7 +203,10 @@ class GrpcServeClient:
             response_deserializer=identity)
 
     def predict(self, *args, application: Optional[str] = None,
-                method: Optional[str] = None, **kwargs) -> Any:
+                method: Optional[str] = None,
+                timeout: Optional[float] = None, **kwargs) -> Any:
+        """``timeout`` becomes the gRPC deadline; the proxy bounds the
+        replica wait by it (minus a margin) server-side."""
         import grpc
 
         body = {"args": list(args), "kwargs": kwargs}
@@ -207,9 +215,11 @@ class GrpcServeClient:
         if method:
             body["method"] = method
         try:
-            out = _unpack(self._predict(_pack(body)))
+            out = _unpack(self._predict(_pack(body), timeout=timeout))
         except grpc.RpcError as e:
-            raise RuntimeError(e.details()) from None
+            # keep the status code visible for retry policies
+            raise RuntimeError(
+                f"{e.code().name}: {e.details()}") from None
         if "error" in out:
             raise RuntimeError(out["error"])
         return out["result"]
