@@ -92,6 +92,10 @@ FLAG_DEFS = [
     Flag("export_events", bool, False, "write structured task/actor/node/"
          "job/train/PG lifecycle events as JSONL under the session dir "
          "(export_*.proto role)"),
+    # -- accelerator topology --
+    Flag("tpu_topology", str, "", "TPU slice topology for ICI-aware gang "
+         "scheduling, '<gen>:<AxBxC>' (e.g. 'v5p:4x4x4'); '' = no "
+         "topology (resource-count placement only)"),
     # -- bench --
     Flag("bench_total_deadline", int, 540, "bench.py total wall-clock "
          "budget (seconds)"),

@@ -188,6 +188,17 @@ class Runtime:
 
         self._generators: Dict[TaskID, GeneratorState] = {}
 
+        # ICI-topology-aware gang scheduling: when a slice topology is
+        # declared, TPU placement-group bundles claim contiguous
+        # sub-slices instead of landing by resource count
+        # (bundle_scheduling_policy.h role; SURVEY §2.3 gang row).
+        from ray_tpu._private.config import cfg as _cfg
+        self.tpu_topology = None
+        _topo_spec = _cfg().tpu_topology
+        if _topo_spec:
+            from ray_tpu.parallel.topology import TpuTopologyManager
+            self.tpu_topology = TpuTopologyManager.from_spec(_topo_spec)
+
         from ray_tpu.util.placement_group import PlacementGroupManager
         self.pg_manager = PlacementGroupManager(self)
         self._shutdown = False

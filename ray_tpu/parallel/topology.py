@@ -114,11 +114,19 @@ class TpuTopology:
         return sorted({self.host_of(c) for c in sub.chips()})
 
     # -- sub-slice allocation (for placement-group bundles) ----------------
-    def allocate(self, num_chips: int) -> Optional[SubSlice]:
+    def allocate(self, num_chips: int,
+                 max_hosts: Optional[int] = None,
+                 accept=None) -> Optional[SubSlice]:
         """Allocate an ICI-contiguous sub-slice of the given chip count.
 
         Chooses the most cube-like axis-aligned box with that volume that
         fits in the remaining space (greedy first-fit over origins).
+        ``max_hosts`` restricts candidates to boxes spanning at most that
+        many hosts (STRICT_PACK: 1 — the box must sit inside one host's
+        chip block). ``accept(cand)`` lets the caller veto candidates
+        that don't suit its bundle->host packing (e.g. host-sized
+        bundles need host-block-aligned boxes) — the search then moves
+        on to the next shape/origin instead of failing outright.
         """
         shapes = self._candidate_shapes(num_chips)
         for shape in shapes:
@@ -126,9 +134,15 @@ class TpuTopology:
                     *(range(0, d - s + 1)
                       for d, s in zip(self.dims, shape))):
                 cand = SubSlice(origin, shape)
-                if not any(self._overlaps(cand, a) for a in self._allocated):
-                    self._allocated.append(cand)
-                    return cand
+                if any(self._overlaps(cand, a) for a in self._allocated):
+                    continue
+                if (max_hosts is not None
+                        and len(self.hosts_of_subslice(cand)) > max_hosts):
+                    continue
+                if accept is not None and not accept(cand):
+                    continue
+                self._allocated.append(cand)
+                return cand
         return None
 
     def free(self, sub: SubSlice) -> None:
@@ -158,6 +172,76 @@ class TpuTopology:
         return all(ao < bo + bs and bo < ao + as_
                    for ao, as_, bo, bs in zip(a.origin, a.shape,
                                               b.origin, b.shape))
+
+
+class TpuTopologyManager:
+    """Cluster-side view of one TPU slice: binds runtime nodes to torus
+    hosts and hands out ICI-contiguous sub-slices under a lock.
+
+    Reference capability: bundle placement policy
+    (``src/ray/raylet/scheduling/policy/bundle_scheduling_policy.h``) —
+    but where the reference packs by resource count only, TPU gang
+    bundles must land on the hosts of one axis-aligned sub-slice or the
+    mesh's collectives fall off ICI onto DCN.
+    """
+
+    def __init__(self, topology: TpuTopology):
+        import threading
+
+        self.topology = topology
+        self._lock = threading.RLock()
+        self._host_of_node: Dict[object, int] = {}   # node_id -> host idx
+        self._node_of_host: Dict[int, object] = {}
+
+    @staticmethod
+    def from_spec(spec: str) -> "TpuTopologyManager":
+        """'v5p:4x4x4' -> manager over that slice."""
+        gen, _, topo = spec.partition(":")
+        if not topo:
+            raise ValueError(
+                f"bad tpu_topology {spec!r} (want '<gen>:<AxBxC>')")
+        return TpuTopologyManager(TpuTopology(gen, topo))
+
+    # -- node <-> host binding (first-seen order, stable) ------------------
+    def bind_nodes(self, node_ids: Sequence) -> None:
+        with self._lock:
+            for nid in node_ids:
+                if nid in self._host_of_node:
+                    continue
+                for h in range(self.topology.num_hosts):
+                    if h not in self._node_of_host:
+                        self._host_of_node[nid] = h
+                        self._node_of_host[h] = nid
+                        break
+
+    def unbind_node(self, node_id) -> None:
+        with self._lock:
+            h = self._host_of_node.pop(node_id, None)
+            if h is not None:
+                self._node_of_host.pop(h, None)
+
+    def node_of_host(self, host: int):
+        with self._lock:
+            return self._node_of_host.get(host)
+
+    # -- allocation --------------------------------------------------------
+    def allocate(self, num_chips: int,
+                 max_hosts: Optional[int] = None,
+                 accept=None) -> Optional[SubSlice]:
+        with self._lock:
+            return self.topology.allocate(num_chips, max_hosts=max_hosts,
+                                          accept=accept)
+
+    def free(self, sub: SubSlice) -> None:
+        with self._lock:
+            self.topology.free(sub)
+
+    def chips_by_host(self, sub: SubSlice) -> Dict[int, List[Tuple[int, ...]]]:
+        """host index -> the sub-slice chips that host owns."""
+        out: Dict[int, List[Tuple[int, ...]]] = {}
+        for c in sub.chips():
+            out.setdefault(self.topology.host_of(c), []).append(c)
+        return out
 
 
 def detect_local_topology() -> Optional[TpuTopology]:

@@ -45,6 +45,8 @@ class Bundle:
     index: int
     resources: Dict[str, float]
     node_id: Optional[NodeID] = None
+    # ICI coords this bundle's TPU chips claimed (topology-aware path)
+    tpu_chips: Optional[List[tuple]] = None
 
     def scoped_resources(self, pg_id: PlacementGroupID) -> Dict[str, float]:
         return {_scoped(pg_id, self.index, k): v
@@ -61,6 +63,9 @@ class PlacementGroup:
         self.strategy = strategy
         self.name = name
         self.state = "PENDING"
+        # the ICI-contiguous sub-slice this group claimed (TPU bundles
+        # under a declared topology); freed on remove/node-death
+        self.subslice = None
         self._ready_event = threading.Event()
         self._ready_ref: Optional[ObjectID] = None
         self._failure: Optional[str] = None
@@ -175,6 +180,20 @@ class PlacementGroupManager:
         for b in bundles:
             if not b or any(v < 0 for v in b.values()):
                 raise ValueError(f"invalid bundle {b!r}")
+        topo = getattr(self._rt, "tpu_topology", None)
+        if topo is not None:
+            cap = topo.topology.chips_per_host
+            for b in bundles:
+                t = b.get("TPU", 0)
+                if t != int(t):
+                    raise ValueError(
+                        f"fractional TPU bundle {b!r}: chips are whole "
+                        "torus nodes under a declared topology")
+                if t > cap:
+                    raise ValueError(
+                        f"bundle {b!r} wants {int(t)} chips but hosts of "
+                        f"{topo.topology!r} have {cap}; a bundle is one "
+                        "node's reservation — split it across bundles")
         pg = PlacementGroup(
             PlacementGroupID.from_random(),
             [Bundle(i, dict(b)) for i, b in enumerate(bundles)],
@@ -197,6 +216,15 @@ class PlacementGroupManager:
                 if daemon is not None:
                     daemon.cancel_bundle(pg.id.hex(), b.index)
             b.node_id = None
+        self._free_subslice(pg)
+
+    def _free_subslice(self, pg: PlacementGroup) -> None:
+        topo = getattr(self._rt, "tpu_topology", None)
+        if pg.subslice is not None and topo is not None:
+            topo.free(pg.subslice)
+        pg.subslice = None
+        for b in pg.bundles:
+            b.tpu_chips = None
 
     def remove(self, pg: PlacementGroup) -> None:
         with self._lock:
@@ -221,10 +249,20 @@ class PlacementGroupManager:
                 "bundles": {b.index: dict(b.resources) for b in pg.bundles},
                 "bundle_nodes": [b.node_id.hex() if b.node_id else None
                                  for b in pg.bundles],
+                **({"subslice": {"origin": sub.origin,
+                                 "shape": sub.shape},
+                    "bundle_chips": [b.tpu_chips for b in pg.bundles]}
+                   # snapshot: _free_subslice nulls the field lock-free
+                   if (sub := pg.subslice) is not None else {}),
             } for pg in self._groups.values()}
 
     def on_node_death(self, node_id: NodeID) -> None:
         """Re-place bundles that lived on a dead node."""
+        topo = getattr(self._rt, "tpu_topology", None)
+        if topo is not None:
+            # the dead host's chips return to the pool; a replacement
+            # node binds to the freed host index on next placement
+            topo.unbind_node(node_id)
         with self._lock:
             for pg in self._groups.values():
                 if pg.state != "CREATED":
@@ -239,6 +277,7 @@ class PlacementGroupManager:
                                     b.scoped_resources(pg.id))
                                 node.ledger.release(b.resources)
                         b.node_id = None
+                    self._free_subslice(pg)
                     pg.state = "RESCHEDULING"
                     # Not ready again until re-placed: waiters must block.
                     pg._ready_event.clear()
@@ -292,6 +331,7 @@ class PlacementGroupManager:
                 daemon = getattr(node, "daemon", None)
                 if daemon is not None:
                     daemon.cancel_bundle(pg.id.hex(), bundle.index)
+            self._free_subslice(pg)
             return False
         for bundle, node in acquired:
             node.ledger.add_total(bundle.scoped_resources(pg.id))
@@ -304,6 +344,10 @@ class PlacementGroupManager:
     def _assign(self, pg: PlacementGroup,
                 nodes: List["Node"]) -> Optional[List[tuple]]:
         """Map bundles to nodes per strategy using *available* capacity."""
+        topo = getattr(self._rt, "tpu_topology", None)
+        if topo is not None and any(
+                b.resources.get("TPU", 0) > 0 for b in pg.bundles):
+            return self._assign_tpu(pg, nodes, topo)
         avail = {n.node_id: n.effective_available() for n in nodes}
 
         def fits(node, bundle) -> bool:
@@ -319,6 +363,7 @@ class PlacementGroupManager:
         out: List[tuple] = []
         strategy = pg.strategy
         if strategy in ("PACK", "STRICT_PACK"):
+            # (TPU bundles took the topology path above when declared)
             # Greedy: fewest nodes; STRICT_PACK demands exactly one node.
             ordered = sorted(
                 nodes, key=lambda n: -sum(avail[n.node_id].values()))
@@ -357,6 +402,123 @@ class PlacementGroupManager:
                     break
             if not placed:
                 return None
+        return out
+
+    def _assign_tpu(self, pg: PlacementGroup, nodes: List["Node"],
+                    topo) -> Optional[List[tuple]]:
+        """ICI-topology path (bundle_scheduling_policy.h role, TPU-first):
+        the group's TPU chips claim ONE axis-aligned contiguous sub-slice
+        of the torus; bundles land on the sub-slice's hosts, so the
+        gang's collectives ride ICI. The claim is recorded on the PG
+        (``pg.subslice`` + per-bundle chip coords) and released on
+        remove / node death / 2PC abort."""
+        chips = [int(b.resources.get("TPU", 0)) for b in pg.bundles]
+        total = sum(chips)
+        # bind TPU-capable nodes to torus hosts (first-seen, stable)
+        tpu_nodes = [n for n in nodes
+                     if n.ledger.total.get("TPU", 0) > 0]
+        topo.bind_nodes([n.node_id for n in tpu_nodes])
+        node_by_id = {n.node_id: n for n in tpu_nodes}
+        host_node = {h: topo.node_of_host(h)
+                     for h in range(topo.topology.num_hosts)}
+        avail = {n.node_id: n.effective_available() for n in nodes}
+        strategy = pg.strategy
+
+        tpu_items = [(b, c) for b, c in zip(pg.bundles, chips) if c > 0]
+        cpu_items = [b for b, c in zip(pg.bundles, chips) if c == 0]
+
+        def try_pack(cand) -> Optional[List[tuple]]:
+            """Greedy bundle->host packing for one candidate box
+            (largest bundles first keeps per-host fragments down).
+            Chip-less bundles place by the generic strategy semantics on
+            ANY node — they must not be forced onto (or burn) sub-slice
+            hosts. Returns [(bundle, node, chip_coords)] or None."""
+            remaining = topo.chips_by_host(cand)
+            trial = {nid: dict(a) for nid, a in avail.items()}
+            packed: List[tuple] = []
+            used_hosts: set = set()
+            used_nodes: List = []
+
+            def fits(node, bundle) -> bool:
+                a = trial[node.node_id]
+                return all(a.get(k, 0.0) >= v - 1e-9
+                           for k, v in bundle.resources.items())
+
+            def charge(node, bundle) -> None:
+                a = trial[node.node_id]
+                for k, v in bundle.resources.items():
+                    a[k] = a.get(k, 0.0) - v
+
+            for bundle, c in sorted(tpu_items, key=lambda t: -t[1]):
+                hosts = sorted(remaining)
+                if strategy in ("SPREAD", "STRICT_SPREAD"):
+                    # spread across hosts: fresh hosts first (STRICT:
+                    # fresh hosts only)
+                    order = [h for h in hosts if h not in used_hosts]
+                    if strategy == "SPREAD":
+                        order += [h for h in hosts if h in used_hosts]
+                else:
+                    order = hosts
+                for h in order:
+                    if len(remaining[h]) < c:
+                        continue
+                    node = node_by_id.get(host_node.get(h))
+                    if (node is None or not node.alive
+                            or not fits(node, bundle)):
+                        continue
+                    charge(node, bundle)
+                    taken = [remaining[h].pop(0) for _ in range(c)]
+                    used_hosts.add(h)
+                    if node not in used_nodes:
+                        used_nodes.append(node)
+                    packed.append((bundle, node, taken))
+                    break
+                else:
+                    return None
+            for bundle in cpu_items:
+                if strategy == "STRICT_PACK":
+                    cands = used_nodes[:1] or list(nodes)
+                elif strategy == "STRICT_SPREAD":
+                    cands = [n for n in nodes if n not in used_nodes]
+                elif strategy == "SPREAD":
+                    cands = ([n for n in nodes if n not in used_nodes]
+                             + used_nodes)
+                else:  # PACK
+                    cands = (used_nodes
+                             + [n for n in nodes if n not in used_nodes])
+                for node in cands:
+                    if not node.alive or not fits(node, bundle):
+                        continue
+                    charge(node, bundle)
+                    if node not in used_nodes:
+                        used_nodes.append(node)
+                    packed.append((bundle, node, []))
+                    break
+                else:
+                    return None
+            return packed
+
+        plan: Dict[str, List[tuple]] = {}
+
+        def accept(cand) -> bool:
+            p = try_pack(cand)
+            if p is None:
+                return False
+            plan["packed"] = p
+            return True
+
+        # STRICT_PACK = one node = the box must fit one host's block
+        sub = topo.allocate(total,
+                            max_hosts=1 if strategy == "STRICT_PACK"
+                            else None,
+                            accept=accept)
+        if sub is None:
+            return None      # slice full/fragmented: stay pending
+        out: List[tuple] = []
+        for bundle, node, taken in plan["packed"]:
+            bundle.tpu_chips = taken or None
+            out.append((bundle, node))
+        pg.subslice = sub
         return out
 
 

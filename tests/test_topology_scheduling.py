@@ -1,0 +1,161 @@
+"""ICI-topology-aware gang scheduling: placement groups claim
+contiguous sub-slices.
+
+Reference capability under test: bundle placement policy
+(``src/ray/raylet/scheduling/policy/bundle_scheduling_policy.h``) —
+upgraded TPU-first: bundles land on the hosts of one axis-aligned torus
+sub-slice (``parallel/topology.py``) instead of by resource count.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.placement_group import (placement_group,
+                                          placement_group_table,
+                                          remove_placement_group)
+
+
+@pytest.fixture
+def tpu_cluster():
+    """4 virtual hosts of a v5p 2x2x4 slice (16 chips, 4 chips/host)."""
+    rt = ray_tpu.init(
+        num_nodes=4, resources={"CPU": 4, "TPU": 4},
+        _system_config={"tpu_topology": "v5p:2x2x4"})
+    yield rt
+    ray_tpu.shutdown()
+
+
+def _entry(pg):
+    return placement_group_table()[pg.id.hex()]
+
+
+def _is_contiguous_box(chips):
+    """The claimed chip coords form exactly one axis-aligned box."""
+    lo = tuple(min(c[i] for c in chips) for i in range(len(chips[0])))
+    hi = tuple(max(c[i] for c in chips) for i in range(len(chips[0])))
+    volume = 1
+    for a, b in zip(lo, hi):
+        volume *= (b - a + 1)
+    return volume == len(chips) and len(set(chips)) == len(chips)
+
+
+def test_pg_claims_contiguous_subslice(tpu_cluster):
+    pg = placement_group([{"TPU": 4}, {"TPU": 4}], strategy="PACK")
+    assert pg.wait(10)
+    e = _entry(pg)
+    assert "subslice" in e, e
+    all_chips = [tuple(c) for chips in e["bundle_chips"] for c in chips]
+    assert len(all_chips) == 8
+    assert _is_contiguous_box(all_chips)
+    # 8 chips at 4/host -> exactly two distinct hosts/nodes
+    assert len(set(e["bundle_nodes"])) == 2
+    remove_placement_group(pg)
+    assert tpu_cluster.tpu_topology.topology._allocated == []
+
+
+def test_strict_pack_stays_inside_one_host_block(tpu_cluster):
+    pg = placement_group([{"TPU": 2}, {"TPU": 2}], strategy="STRICT_PACK")
+    assert pg.wait(10)
+    e = _entry(pg)
+    nodes = e["bundle_nodes"]
+    assert len(set(nodes)) == 1          # one node...
+    topo = tpu_cluster.tpu_topology.topology
+    hosts = topo.hosts_of_subslice(pg.subslice)
+    assert len(hosts) == 1               # ...backed by one torus host block
+    remove_placement_group(pg)
+
+
+def test_strict_spread_uses_distinct_hosts(tpu_cluster):
+    pg = placement_group([{"TPU": 2}] * 3, strategy="STRICT_SPREAD")
+    assert pg.wait(10)
+    e = _entry(pg)
+    assert len(set(e["bundle_nodes"])) == 3
+
+
+def test_spread_prefers_distinct_hosts(tpu_cluster):
+    """SPREAD must not collapse onto one host just because the cube-like
+    box fits a single host block (fault isolation is the point)."""
+    pg = placement_group([{"TPU": 2}, {"TPU": 2}], strategy="SPREAD")
+    assert pg.wait(10)
+    assert len(set(_entry(pg)["bundle_nodes"])) == 2
+    remove_placement_group(pg)
+
+
+def test_mixed_cpu_bundle_not_forced_onto_subslice_hosts():
+    """A chip-less bundle in a TPU group places by generic semantics:
+    a big CPU bundle lands on the fat CPU node, not a 4-CPU TPU host."""
+    rt = ray_tpu.init(
+        num_nodes=1, resources={"CPU": 4, "TPU": 4},
+        _system_config={"tpu_topology": "v5e:2x2"})
+    try:
+        cpu_node = rt.add_node({"CPU": 64})
+        pg = placement_group([{"TPU": 4}, {"CPU": 16}], strategy="PACK")
+        assert pg.wait(10)
+        e = _entry(pg)
+        assert e["bundle_nodes"][1] == cpu_node.node_id.hex()
+        assert e["bundle_chips"][0] is not None
+        assert e["bundle_chips"][1] is None
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_fragmentation_blocks_then_release_unblocks(tpu_cluster):
+    """A full slice leaves a later group pending; freeing a sub-slice
+    lets it place (the allocator is consulted, not just chip counts)."""
+    pg_a = placement_group([{"TPU": 4}, {"TPU": 4}], strategy="PACK")
+    pg_b = placement_group([{"TPU": 4}, {"TPU": 4}], strategy="PACK")
+    assert pg_a.wait(10) and pg_b.wait(10)
+
+    pg_c = placement_group([{"TPU": 4}], strategy="PACK")
+    assert not pg_c.wait(1.5)            # 16/16 chips claimed
+    assert pg_c.state in ("PENDING", "RESCHEDULING")
+
+    remove_placement_group(pg_a)
+    assert pg_c.wait(10)
+    chips = [tuple(c) for c in _entry(pg_c)["bundle_chips"][0]]
+    assert _is_contiguous_box(chips)
+    remove_placement_group(pg_b)
+    remove_placement_group(pg_c)
+
+
+def test_oversized_bundle_rejected_up_front(tpu_cluster):
+    with pytest.raises(ValueError, match="split it across bundles"):
+        placement_group([{"TPU": 8}])
+    with pytest.raises(ValueError, match="fractional"):
+        placement_group([{"TPU": 0.5}])
+
+
+def test_node_death_frees_and_replaces_subslice(tpu_cluster):
+    rt = tpu_cluster
+    pg = placement_group([{"TPU": 4}, {"TPU": 4}], strategy="SPREAD")
+    assert pg.wait(10)
+    first_slice = pg.subslice
+    victim = pg.bundles[0].node_id
+    rt.remove_node(rt.get_node(victim))
+    assert pg.wait(15)                   # re-placed on surviving hosts
+    e = _entry(pg)
+    assert victim.hex() not in e["bundle_nodes"]
+    all_chips = [tuple(c) for chips in e["bundle_chips"] for c in chips]
+    assert _is_contiguous_box(all_chips)
+    # the first claim was released: allocator holds exactly one slice
+    assert len(rt.tpu_topology.topology._allocated) == 1
+    assert rt.tpu_topology.topology._allocated[0] is not first_slice
+
+
+def test_tasks_schedule_into_topology_bundles(tpu_cluster):
+    """PG-scheduled work runs on the bundle's sub-slice node (the scoped
+    resource rewrite rides the existing ledger machinery)."""
+    pg = placement_group([{"CPU": 1, "TPU": 4}], strategy="PACK")
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        import ray_tpu
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    strat = ray_tpu.PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    node_hex = ray_tpu.get(
+        where.options(scheduling_strategy=strat).remote(), timeout=30)
+    assert node_hex == pg.bundles[0].node_id.hex()
+    remove_placement_group(pg)
