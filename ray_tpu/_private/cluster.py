@@ -187,13 +187,14 @@ class DaemonHandle:
                           job_id=cloudpickle.dumps(job_id),
                           namespace=namespace)
 
-    # -- lease + task push ------------------------------------------------
+    # -- fused task submit ------------------------------------------------
     def execute_task(self, spec, fid: str, args_blob: bytes):
-        """Lease a worker, push the task, decode the outcome. Returns the
-        same (kind, value) contract as ProcessRouter.execute_task."""
-        lease = self._call("request_worker_lease",
-                           task_meta={"name": spec.name})
-        lease_id = lease["lease_id"]
+        """Submit in ONE round trip: the daemon leases a pooled worker,
+        pushes the task, and releases the worker itself (streams keep it
+        until drained). Returns the same (kind, value) contract as
+        ProcessRouter.execute_task. The explicit lease protocol
+        (request_worker_lease/push_task/return_worker) stays on the wire
+        for callers that pin a worker across calls."""
         task_hex = spec.task_id.hex()
         stream = _Stream()
         with self._slock:
@@ -201,23 +202,14 @@ class DaemonHandle:
         out = None
         try:
             out = self._call(
-                "push_task", spec=_slim_spec_blob(spec), fid=fid,
-                args=args_blob, lease_id=lease_id,
+                "submit_task", spec=_slim_spec_blob(spec), fid=fid,
+                args=args_blob,
                 backpressure=spec.backpressure_num_objects)
             return self._decode_outcome(out, spec, stream)
         finally:
             if out_is_final(out):
-                # Streams keep their lease until drained: the daemon
-                # releases the worker at stream end (returning it now
-                # would let a full pool kill the producer mid-stream).
                 with self._slock:
                     self._streams.pop(task_hex, None)
-                try:
-                    if not self.dead:
-                        self.client.call("return_worker",
-                                         lease_id=lease_id, timeout=5.0)
-                except rpc.RpcError:
-                    pass
 
     def _decode_outcome(self, out: Dict[str, Any], spec, stream: _Stream):
         kind = out["outcome"]

@@ -60,6 +60,8 @@ FLAG_DEFS = [
          "nodes, 'daemons' = head + node-daemon OS processes"),
     Flag("process_pool_size", int, 0, "idle worker-process pool target "
          "(0 = auto: min(4, max(2, cpus//2)))"),
+    Flag("process_pool_max", int, 32, "hard cap on the adaptive idle pool "
+         "(demand high-water raises the target up to this)"),
     Flag("head_grace_s", float, 20.0, "how long daemons/drivers re-dial a "
          "crashed head before giving up (head FT window)"),
     # -- health / heartbeats --

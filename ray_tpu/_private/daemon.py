@@ -51,6 +51,7 @@ declare("hello_driver", "owner_addr", "job_id", "namespace")
 declare("request_worker_lease", "task_meta")
 declare("return_worker", "lease_id")
 declare("push_task", "spec", "fid", "args", "lease_id", "backpressure")
+declare("submit_task", "spec", "fid", "args", "backpressure")
 declare("create_actor", "spec", "fid", "args")
 declare("call_actor_method", "spec", "args")
 declare("kill_actor", "actor_id", "expected")
@@ -392,6 +393,15 @@ class DaemonService:
         self._task_rids: Dict[str, Tuple[Any, str]] = {}
         self._bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self._peers: Dict[Tuple[str, int], Client] = {}
+        # Task bodies block on worker IPC, so the pool is sized well past
+        # core count; reusing threads beats per-task spawn under GIL
+        # contention (reference: raylet dispatches from its event loop).
+        # The cap must exceed the driver's per-node in-flight bound (256,
+        # node.py max_worker_threads): a parent task blocked in get() on
+        # a child routed here holds a pool thread, and a cap at or below
+        # the in-flight bound could starve the child of a thread.
+        from ray_tpu._private.thread_pool import DaemonThreadPool
+        self._task_pool = DaemonThreadPool(1024, name="daemon-task")
         self.pulls = PullManager(self.objects, self._peer)
         # Worker log capture: this daemon's workers write per-pid files;
         # the monitor forwards new lines to the driver (worker_log push).
@@ -578,24 +588,59 @@ class DaemonService:
             if on_done is not None:
                 on_done(False)
 
+    def handle_submit_task(self, conn, rid, msg):
+        """Fused lease+push+release in ONE round trip — the common task
+        path. The explicit lease protocol (request_worker_lease /
+        push_task / return_worker) remains for callers that need to hold
+        a worker across calls; the reference gets the same effect by
+        caching leases per SchedulingKey
+        (``transport/normal_task_submitter.cc:140``)."""
+        from ray_tpu._private import worker_process as wp
+
+        client = wp.acquire_worker()
+        client.raw_outcomes = True
+        client.runtime = self.runtime
+        client.node = self.node_stub
+        try:
+            return self._run_pushed_task(conn, rid, msg, client,
+                                         lease_id=None)
+        except BaseException:
+            # e.g. an unpicklable spec: without this the checked-out
+            # worker (and its _ACTIVE slot) would leak per failed submit.
+            wp.release_worker(client)
+            raise
+
     def handle_push_task(self, conn, rid, msg):
         """Execute on the leased worker; replies with the outcome. Big
         results go to the object table and return as a location; streams
         flow back as task_yield/task_result pushes."""
-        spec = cloudpickle.loads(msg["spec"])
         client = self._leased(msg["lease_id"])
+        return self._run_pushed_task(conn, rid, msg, client,
+                                     lease_id=msg["lease_id"])
+
+    def _run_pushed_task(self, conn, rid, msg, client, lease_id):
+        spec = cloudpickle.loads(msg["spec"])
         spec.backpressure_num_objects = msg["backpressure"]
         task_hex = spec.task_id.hex()
 
         def release_lease(crashed: bool) -> None:
             from ray_tpu._private import worker_process as wp
 
-            with self._lock:
-                self._leases.pop(msg["lease_id"], None)
+            if lease_id is not None:
+                with self._lock:
+                    self._leases.pop(lease_id, None)
             # (the driver never calls return_worker for streams; and for
-            # final outcomes its return_worker becomes a no-op)
-            if not crashed and client.actor_id is None and client.alive():
-                wp.release_worker(client)
+            # final outcomes its return_worker becomes a no-op.)
+            # Unconditional for non-actor workers: release_worker reaps
+            # dead ones itself, and skipping it would leak the checkout
+            # accounting for a worker that died AFTER returning its
+            # result (crash paths already called kill(), which cleared
+            # the checkout — release is then a no-op on accounting).
+            if client.actor_id is None:
+                if crashed:
+                    wp._checkout_done(client)
+                else:
+                    wp.release_worker(client)
 
         def run():
             from ray_tpu._private.worker_process import WorkerCrashed
@@ -619,11 +664,16 @@ class DaemonService:
                 release_lease(True)
                 conn.reply(rid, outcome="crashed", error=str(e))
                 return
+            except BaseException as e:  # noqa: BLE001 — must answer HOLD
+                with self._lock:
+                    self._task_rids.pop(task_hex, None)
+                release_lease(False)
+                conn.reply_error(rid, f"{type(e).__name__}: {e}")
+                return
             self._pump_outcome(conn, rid, client, spec, outcome,
                                on_done=release_lease)
 
-        threading.Thread(target=run, daemon=True,
-                         name=f"task-{task_hex[:8]}").start()
+        self._task_pool.submit(run)
         return rpc.HOLD
 
     def handle_cancel_task(self, conn, rid, msg):
@@ -675,6 +725,7 @@ class DaemonService:
                 conn.reply(rid, outcome="err", blob=blob)
                 return
             client.actor_since = time.time()
+            wp._checkout_done(client)   # actor ownership: permanent checkout
             router = self.runtime.process_router
             with router._lock:
                 router._actor_workers[spec.actor_id] = client
@@ -683,7 +734,7 @@ class DaemonService:
                 lambda c, aid=actor_id: router._actor_worker_died(aid, c))
             conn.reply(rid, outcome="ok", worker_pid=client.proc.pid)
 
-        threading.Thread(target=run, daemon=True).start()
+        self._task_pool.submit(run)
         return rpc.HOLD
 
     def handle_call_actor_method(self, conn, rid, msg):
@@ -717,7 +768,7 @@ class DaemonService:
                 return
             self._pump_outcome(conn, rid, client, spec, outcome)
 
-        threading.Thread(target=run, daemon=True).start()
+        self._task_pool.submit(run)
         return rpc.HOLD
 
     def handle_kill_actor(self, conn, rid, msg):
@@ -777,6 +828,7 @@ class DaemonService:
             self.objects.delete(oid)
         return {"ok": True}
 
+    @rpc.concurrent
     def handle_pull_object(self, conn, rid, msg):
         """Inter-node transfer: fetch from a peer daemon into the local
         table via the PullManager (chunked + deduped + prioritized;

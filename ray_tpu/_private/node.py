@@ -312,6 +312,9 @@ class Node:
         self._running: set = set()
         self._running_lock = threading.Lock()
         self._sema = threading.Semaphore(max_worker_threads)
+        from ray_tpu._private.thread_pool import DaemonThreadPool
+        self._task_pool = DaemonThreadPool(
+            max_worker_threads, name=f"task-{node_id.hex()[:8]}")
         # Event-loop instrumentation (reference: asio
         # instrumented_io_context / event_stats.h — per-handler counts and
         # queue lag surfaced in debug_state dumps).
@@ -417,8 +420,7 @@ class Node:
                     self.ledger.release(spec.resources)
                 self._sema.release()
 
-        threading.Thread(target=run, daemon=True,
-                         name=f"worker-{spec.task_id.hex()[:8]}").start()
+        self._task_pool.submit(run)
 
     def _fail_backlog(self) -> None:
         from ray_tpu._private import worker

@@ -15,11 +15,14 @@ Design choices, TPU-first rationale:
 - Framing: ``u32 length | msgpack map``. msgpack handles bytes natively,
   so serialized task payloads embed without base64.
 
-Server model: thread-per-connection, dispatch by method name to a
-service object (``handle_<method>``). A handler may return
-``HOLD`` to park the request (long-poll; reference
-``pubsub/publisher.h:300``) and complete it later via
-``Connection.reply``.
+Server model: one decode thread per connection feeding a shared handler
+pool — requests PIPELINE (the reference multiplexes gRPC streams the
+same way). Per-connection arrival order is preserved for ordinary
+handlers via a FIFO lane; handlers that may block mark themselves
+``@concurrent`` to run outside the lane. Dispatch is by method name to
+a service object (``handle_<method>``). A handler may return ``HOLD``
+to park the request (long-poll; reference ``pubsub/publisher.h:300``)
+and complete it later via ``Connection.reply``.
 """
 
 from __future__ import annotations
@@ -28,6 +31,7 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import msgpack
@@ -49,6 +53,17 @@ class _Hold:
 
 
 HOLD = _Hold()
+
+
+def concurrent(handler):
+    """Mark a handler as safe to run OUTSIDE its connection's FIFO lane.
+
+    Use for handlers that may block (e.g. a 120s object pull): they run
+    directly on the dispatch pool so they cannot head-of-line-block other
+    requests from the same peer. Everything unmarked keeps strict
+    per-connection arrival order (actor-call ordering relies on it)."""
+    handler._rpc_concurrent = True
+    return handler
 
 
 # ---------------------------------------------------------------------------
@@ -216,6 +231,12 @@ class Connection:
         self.wlock = threading.Lock()
         self.meta: Dict[str, Any] = {}   # services stash identity here
         self.closed = False
+        # FIFO lane: ordered handlers from this peer execute one at a
+        # time in arrival order, but OFF the read thread, so decoding
+        # (and @concurrent handlers) pipeline ahead of a slow handler.
+        self._lane: deque = deque()
+        self._lane_lock = threading.Lock()
+        self._lane_busy = False
 
     def reply(self, rid: int, **kw) -> None:
         msg = dict(kw)
@@ -251,9 +272,37 @@ class Server:
         self.addr = self._srv.getsockname()
         self._stop = False
         self._conns: list = []
+        from ray_tpu._private.thread_pool import DaemonThreadPool
+        self._pool = DaemonThreadPool(128, name=f"rpc-{self.addr[1]}")
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True,
             name=f"rpc-server-{self.addr[1]}")
+
+    def _run_handler(self, conn: Connection, handler, rid, msg) -> None:
+        try:
+            out = handler(conn, rid, msg)
+            if out is HOLD or rid is None:
+                return
+            conn.reply(rid, **(out or {}))
+        except Exception as e:  # noqa: BLE001 — shipped back; the reply
+            # is inside the try because an unserializable handler return
+            # raises in msgpack, not in the handler
+            if rid is not None:
+                conn.reply_error(rid, f"{type(e).__name__}: {e}")
+
+    def _drain_lane(self, conn: Connection) -> None:
+        while True:
+            with conn._lane_lock:
+                if not conn._lane:
+                    conn._lane_busy = False
+                    return
+                handler, rid, msg = conn._lane.popleft()
+            try:
+                self._run_handler(conn, handler, rid, msg)
+            except BaseException:   # never wedge the lane
+                with conn._lane_lock:
+                    conn._lane_busy = False
+                raise
 
     def start(self) -> "Server":
         self._accept_thread.start()
@@ -283,15 +332,22 @@ class Server:
                     if rid is not None:
                         conn.reply_error(rid, f"no such method {method!r}")
                     continue
-                try:
-                    out = handler(conn, rid, msg)
-                except Exception as e:  # noqa: BLE001 — shipped back
-                    if rid is not None:
-                        conn.reply_error(rid, f"{type(e).__name__}: {e}")
+                if getattr(handler, "_rpc_concurrent", False):
+                    # Dedicated thread, NOT the shared pool: @concurrent
+                    # handlers may block for minutes (object pulls), and
+                    # enough of them would exhaust the pool and stall
+                    # every connection's lane drain.
+                    threading.Thread(
+                        target=self._run_handler,
+                        args=(conn, handler, rid, msg), daemon=True,
+                        name=f"rpc-conc-{method}").start()
                     continue
-                if out is HOLD or rid is None:
-                    continue
-                conn.reply(rid, **(out or {}))
+                with conn._lane_lock:
+                    conn._lane.append((handler, rid, msg))
+                    if conn._lane_busy:
+                        continue
+                    conn._lane_busy = True
+                self._pool.submit(lambda: self._drain_lane(conn))
         except (RpcError, OSError):
             pass
         finally:

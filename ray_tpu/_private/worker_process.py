@@ -901,6 +901,7 @@ class WorkerClient:
 
     def kill(self, expected: bool = True) -> None:
         import subprocess
+        _checkout_done(self)
         self.expected_death = self.expected_death or expected
         try:
             self._send({"op": "shutdown"})
@@ -1116,12 +1117,35 @@ _POOL_LOCK = threading.Lock()
 _IDLE: List[WorkerClient] = []
 _PRESTARTING = [0]
 _POOL_CLOSED = threading.Event()   # interpreter exiting: no new spawns
+# Demand tracking: the idle cap follows the high-water mark of concurrent
+# checkouts (decayed on a window) so a burst of N parallel tasks keeps N
+# workers warm instead of churning fork+join on every release (reference:
+# worker_pool.h num_workers_soft_limit + idle reaping).
+_ACTIVE = [0]
+_PEAK = [0]
+_PEAK_TS = [0.0]
+_PEAK_WINDOW_S = 60.0
+from ray_tpu._private.thread_pool import DaemonThreadPool
+
+_REAPER = DaemonThreadPool(2, name="worker-reaper")
 
 
-def _pool_target() -> int:
+def _pool_floor() -> int:
     from ray_tpu._private.config import cfg
     n = cfg().process_pool_size
     return n if n > 0 else min(4, max(2, (os.cpu_count() or 4) // 2))
+
+
+def _pool_target() -> int:
+    """Idle cap: configured floor, raised to the recent peak of concurrent
+    checkouts (bounded by process_pool_max)."""
+    from ray_tpu._private.config import cfg
+    return max(_pool_floor(), min(_PEAK[0], cfg().process_pool_max))
+
+
+def _async_kill(w: WorkerClient) -> None:
+    """Reap off the caller's thread: kill() blocks up to 1.5s on join."""
+    _REAPER.submit(lambda: w.kill(expected=True))
 
 
 def _make_boot() -> Dict[str, Any]:
@@ -1151,41 +1175,85 @@ def _spawn_worker() -> WorkerClient:
     return WorkerClient(_make_boot())
 
 
-def acquire_worker() -> WorkerClient:
+def _checkout_done(w: WorkerClient) -> None:
+    """Decrement the active-checkout count exactly once per checkout;
+    called from release_worker AND WorkerClient.kill so crash paths
+    (which kill without releasing) keep the accounting straight."""
     with _POOL_LOCK:
+        if getattr(w, "_checked_out", False):
+            w._checked_out = False
+            _ACTIVE[0] = max(0, _ACTIVE[0] - 1)
+
+
+def acquire_worker() -> WorkerClient:
+    got: Optional[WorkerClient] = None
+    with _POOL_LOCK:
+        now = time.monotonic()
+        _ACTIVE[0] += 1
+        if now - _PEAK_TS[0] > _PEAK_WINDOW_S:
+            _PEAK[0] = _ACTIVE[0]
+            _PEAK_TS[0] = now
+        elif _ACTIVE[0] > _PEAK[0]:
+            _PEAK[0] = _ACTIVE[0]
         while _IDLE:
             w = _IDLE.pop()
             if w.alive():
-                _maybe_prestart_async()
-                return w
-            w.kill()
+                got = w
+                break
+            _async_kill(w)
+    if got is None:
+        try:
+            got = _spawn_worker()
+        except BaseException:
+            with _POOL_LOCK:   # keep _ACTIVE honest on spawn failure
+                _ACTIVE[0] = max(0, _ACTIVE[0] - 1)
+            raise
+    got._checked_out = True
     _maybe_prestart_async()
-    return _spawn_worker()
+    return got
 
 
 def release_worker(w: WorkerClient) -> None:
+    _checkout_done(w)
     if w.actor_id is not None or not w.alive():
-        w.kill(expected=True)
+        _async_kill(w)
         return
     w.runtime = None
     w.node = None
     with _POOL_LOCK:
         if len(_IDLE) >= _pool_target():
-            w.kill(expected=True)
-            return
-        _IDLE.append(w)
+            keep = False
+        else:
+            _IDLE.append(w)
+            keep = True
+    if not keep:
+        _async_kill(w)
+
+
+_FILL_RUNNING = [False]
 
 
 def _maybe_prestart_async() -> None:
-    """Keep the idle pool warm in the background (reference: PrestartWorkers)."""
+    """Keep the idle pool warm in the background (reference: PrestartWorkers).
+
+    The deficit counts checked-out workers too: a burst's active workers
+    return to the idle pool on release, so spawning replacements for them
+    would overshoot and churn."""
     if _POOL_CLOSED.is_set():
         return
+    with _POOL_LOCK:
+        deficit = (_pool_target() - len(_IDLE) - _PRESTARTING[0]
+                   - _ACTIVE[0])
+        if deficit <= 0 or _FILL_RUNNING[0]:
+            return
+        _FILL_RUNNING[0] = True
 
     def fill():
         try:
             while not _POOL_CLOSED.is_set():
                 with _POOL_LOCK:
-                    deficit = _pool_target() - len(_IDLE) - _PRESTARTING[0]
+                    deficit = (_pool_target() - len(_IDLE)
+                               - _PRESTARTING[0] - _ACTIVE[0])
                     if deficit <= 0:
                         return
                     _PRESTARTING[0] += 1
@@ -1199,10 +1267,13 @@ def _maybe_prestart_async() -> None:
                             and not _POOL_CLOSED.is_set()):
                         _IDLE.append(w)
                     else:
-                        w.kill()
+                        _async_kill(w)
                         return
         except Exception:
             pass
+        finally:
+            with _POOL_LOCK:
+                _FILL_RUNNING[0] = False
     threading.Thread(target=fill, daemon=True,
                      name="worker-prestart").start()
 
@@ -1376,6 +1447,10 @@ class ProcessRouter:
             release_worker(client)  # init failed cleanly; process reusable
             raise value
         client.actor_since = time.time()
+        # Actor ownership is a PERMANENT checkout: stop counting it in
+        # _ACTIVE, or _PEAK could never decay below the live-actor count
+        # and the idle pool would stay burst-sized forever.
+        _checkout_done(client)
         with self._lock:
             self._actor_workers[spec.actor_id] = client
         actor_id = spec.actor_id

@@ -81,7 +81,10 @@ def _file_read_dataset(paths, suffix: str, reader: Callable,
 
     def run(f):
         for scheme, fs in registry.items():
-            fsmod._REGISTRY.setdefault(scheme, fs)
+            # Overwrite, never setdefault: pooled workers OUTLIVE a
+            # driver-side re-registration (e.g. a new S3 endpoint), and a
+            # stale entry would shadow the one this task shipped with.
+            fsmod._REGISTRY[scheme] = fs
         return reader(f)
 
     tasks = [lambda f=f: run(f) for f in files]

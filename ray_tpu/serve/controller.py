@@ -38,6 +38,16 @@ class _DeploymentState:
         self.replica_slots: List[int] = []   # parallel to replicas
         self.version = 0
         self.last_scale_ts = 0.0
+        # slot -> (metrics dict, monotonic recv time): PUSHED by replica
+        # reporter threads; reconcile/autoscale read this cache and never
+        # block on a per-replica RPC (reference: autoscaling_state.py).
+        self.metrics_cache: Dict[int, Any] = {}
+        self.started_at: Dict[int, float] = {}   # slot -> start time
+        # slot -> actor id hex of the replica the CONTROLLER placed
+        # there: reports from any other incarnation (e.g. a killed
+        # in-process replica whose reporter thread is still running) are
+        # dropped, so a zombie heartbeat can't keep a dead slot healthy.
+        self.replica_ids: Dict[int, str] = {}
 
 
 _CKPT_KEY = b"serve::applications"
@@ -57,6 +67,10 @@ class ServeController:
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._tick_s = 0.5
+        self._report_interval_s = 0.5
+        # 6 missed reports before a replica becomes a ping-confirmed
+        # death suspect
+        self._stale_after_s = 3.0
         self._long_poll = LongPollHost()
         self._scheduler = DeploymentScheduler()
         self._compact_counter = 0
@@ -183,11 +197,27 @@ class ServeController:
             max_concurrency=st.deployment.max_ongoing_requests,
             max_restarts=st.deployment.max_restarts, **opts,
         ).remote(st.deployment.func_or_class, st.init_args, st.init_kwargs,
-                 st.deployment.user_config)
+                 st.deployment.user_config,
+                 report_to="serve_controller", deployment=name, slot=slot,
+                 report_interval_s=self._report_interval_s)
         ray_tpu.get(handle.ping.remote())   # fail fast on ctor errors
+        st.started_at[slot] = time.monotonic()
+        st.replica_ids[slot] = handle._actor_id.hex()
+        st.metrics_cache.pop(slot, None)   # no stale entry for a reused slot
         if node_hex is not None:
             self._scheduler.record(name, handle, node_hex)
         return handle
+
+    def report_metrics(self, name: str, slot: int, m: Dict,
+                       actor_id: Optional[str] = None) -> None:
+        """Push endpoint for replica reporter threads (reference:
+        autoscaling_state.py record_request_metrics_for_replica)."""
+        with self._lock:
+            st = self._state.get(name)
+            if st is not None and (
+                    actor_id is None
+                    or st.replica_ids.get(slot) == actor_id):
+                st.metrics_cache[slot] = (m, time.monotonic())
 
     def _reconcile_one(self, name: str) -> None:
         with self._lock:
@@ -224,20 +254,43 @@ class ServeController:
             st = self._state.get(name)
             if st is None:
                 return
-            alive = []
-            alive_slots = []
+            now = time.monotonic()
             changed = False
+            suspects = []
             for r, slot in zip(st.replicas, st.replica_slots):
-                try:
-                    ray_tpu.get(r.ping.remote(), timeout=5)
-                    alive.append(r)
-                    alive_slots.append(slot)
-                except Exception:
-                    changed = True
-            if changed:
-                st.replicas = alive
-                st.replica_slots = alive_slots
+                entry = st.metrics_cache.get(slot)
+                # unseen slot (e.g. re-bound after controller restart):
+                # start its staleness clock at this pass
+                st.started_at.setdefault(slot, now)
+                age = now - entry[1] if entry is not None else \
+                    now - st.started_at[slot]
+                if age > self._stale_after_s:
+                    # no recent push: confirm before declaring it dead
+                    # (a replica whose reporter died but whose executor
+                    # lives should survive a health pass)
+                    suspects.append((r, slot))
+        dead = []
+        for r, slot in suspects:
+            try:
+                ray_tpu.get(r.ping.remote(), timeout=5)
+            except Exception:
+                dead.append(slot)
+        with self._lock:
+            if dead and st is self._state.get(name):
+                # Remove ONLY the ping-confirmed dead slots from the
+                # CURRENT lists — replicas added concurrently during the
+                # unlocked ping window must survive.
+                keep = [(r, slot)
+                        for r, slot in zip(st.replicas, st.replica_slots)
+                        if slot not in dead]
+                st.replicas = [r for r, _ in keep]
+                st.replica_slots = [slot for _, slot in keep]
+                for slot in dead:
+                    st.metrics_cache.pop(slot, None)
+                    st.replica_ids.pop(slot, None)
+                    st.started_at.pop(slot, None)
                 st.version += 1
+                changed = True
         if changed:
             self._publish_replicas(name)
             self._reconcile_one(name)
@@ -249,13 +302,17 @@ class ServeController:
         if st is None or st.deployment.autoscaling_config is None:
             return
         cfg = st.deployment.autoscaling_config
+        # Read ONLY the pushed cache: the reconcile loop never issues a
+        # per-replica RPC (reference: autoscaling_state.py keeps the
+        # controller-side aggregate the same way).
         total_ongoing = 0.0
-        for r in list(st.replicas):
-            try:
-                m = ray_tpu.get(r.metrics.remote(), timeout=5)
-                total_ongoing += m["ongoing"]
-            except Exception:
-                pass
+        now = time.monotonic()
+        with self._lock:
+            for slot in st.replica_slots:
+                entry = st.metrics_cache.get(slot)
+                if entry is not None and \
+                        now - entry[1] <= self._stale_after_s:
+                    total_ongoing += entry[0].get("ongoing", 0.0)
         desired = math.ceil(total_ongoing / cfg.target_ongoing_requests) \
             if cfg.target_ongoing_requests > 0 else cfg.min_replicas
         desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
@@ -357,6 +414,12 @@ class ServeController:
                     "version": st.version,
                     "autoscaling": st.deployment.autoscaling_config
                     is not None,
+                    # slots with a fresh PUSHED metrics entry (replica
+                    # reporter heartbeats; the controller never polls)
+                    "metrics_fresh": sum(
+                        1 for slot in st.replica_slots
+                        if (e := st.metrics_cache.get(slot)) is not None
+                        and time.monotonic() - e[1] <= self._stale_after_s),
                 }
                 for name, st in self._state.items()}
 

@@ -15,7 +15,11 @@ from typing import Any, Dict, Optional
 
 class Replica:
     def __init__(self, func_or_class, init_args, init_kwargs,
-                 user_config: Optional[Dict] = None):
+                 user_config: Optional[Dict] = None,
+                 report_to: Optional[str] = None,
+                 deployment: Optional[str] = None,
+                 slot: Optional[int] = None,
+                 report_interval_s: float = 1.0):
         self._lock = threading.Lock()
         self._ongoing = 0
         self._total = 0
@@ -27,6 +31,71 @@ class Replica:
                 self._callable.reconfigure(user_config)
         else:
             self._callable = func_or_class
+        # Push-based metrics (reference: autoscaling_state.py — replicas
+        # REPORT running/queued counts; the controller never polls): a
+        # reporter thread pushes ongoing/total to the named controller,
+        # doubling as the liveness heartbeat for health checks.
+        if report_to is not None:
+            import ray_tpu
+            self._actor_id = ray_tpu.get_runtime_context().get_actor_id()
+            self._generation = self._own_restart_count()
+            threading.Thread(
+                target=self._report_loop,
+                args=(report_to, deployment, slot,
+                      max(0.1, report_interval_s)),
+                daemon=True, name=f"replica-report-{deployment}-{slot}",
+            ).start()
+
+    def _own_restart_count(self) -> Optional[int]:
+        try:
+            from ray_tpu._private import worker
+            from ray_tpu._private.ids import ActorID
+            info = worker.global_runtime().gcs.get_actor_info(
+                ActorID.from_hex(self._actor_id))
+            return info.num_restarts if info is not None else None
+        except Exception:
+            return None
+
+    def _still_current(self) -> bool:
+        """False once THIS incarnation's actor is dead or restarted —
+        the instance's threads outlive an in-process actor kill, and a
+        zombie heartbeat would keep a dead slot looking healthy."""
+        if self._actor_id is None:
+            return True   # no identity available: report unconditionally
+        try:
+            from ray_tpu._private import worker
+            from ray_tpu._private.ids import ActorID
+            info = worker.global_runtime().gcs.get_actor_info(
+                ActorID.from_hex(self._actor_id))
+        except Exception:
+            return True   # runtime unavailable ≠ dead; keep reporting
+        if info is None:
+            return False
+        state = getattr(info, "state", None)
+        if state is not None and getattr(state, "name", "") == "DEAD":
+            return False
+        if self._generation is None:
+            # generation unknown (GCS unavailable at construction):
+            # state alone decides — a healthy replica must keep reporting
+            return True
+        return info.num_restarts == self._generation
+
+    def _report_loop(self, controller_name: str, deployment: str,
+                     slot: int, interval: float) -> None:
+        import ray_tpu
+        controller = None
+        while True:
+            time.sleep(interval)
+            if not self._still_current():
+                return
+            try:
+                if controller is None:
+                    controller = ray_tpu.get_actor(controller_name)
+                controller.report_metrics.remote(
+                    deployment, slot, self.metrics(),
+                    actor_id=self._actor_id)
+            except Exception:
+                controller = None  # controller restarting: re-resolve
 
     def reconfigure(self, user_config: Dict) -> None:
         if hasattr(self._callable, "reconfigure"):

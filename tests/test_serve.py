@@ -148,6 +148,48 @@ def test_replica_failure_recovery(serve_cluster):
     assert handle.remote().result() == "ok"
 
 
+def test_push_metrics_feed_controller(serve_cluster):
+    """Replicas PUSH their metrics (reference: autoscaling_state.py) —
+    the controller's cache fills without it ever polling, and a killed
+    replica's zombie reporter cannot keep its slot looking healthy."""
+
+    @serve.deployment(num_replicas=2)
+    class Svc:
+        def __call__(self):
+            return "ok"
+
+    handle = serve.run(Svc.bind())
+    assert handle.remote().result() == "ok"
+    controller = ray_tpu.get_actor("serve_controller")
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        st = ray_tpu.get(controller.status.remote())["Svc"]
+        if st["metrics_fresh"] == 2:
+            break
+        time.sleep(0.2)
+    assert st["metrics_fresh"] == 2, st
+
+    # kill one replica: its Replica instance (and reporter thread) lives
+    # on in-process, but its reports must be rejected/stopped so the
+    # slot goes stale, the death is detected, and a replacement lands
+    replicas = ray_tpu.get(controller.get_replicas.remote("Svc"))["replicas"]
+    dead_id = replicas[0]._actor_id
+    ray_tpu.kill(replicas[0])
+    deadline = time.time() + 20
+    recovered = False
+    while time.time() < deadline:
+        reps = ray_tpu.get(controller.get_replicas.remote("Svc"))
+        ids = [r._actor_id for r in reps["replicas"]]
+        if len(ids) == 2 and dead_id not in ids:
+            recovered = True
+            break
+        time.sleep(0.3)
+    assert recovered, "dead replica was never replaced"
+    handle._refresh(force=True)
+    assert handle.remote().result() == "ok"
+
+
 def test_autoscaling_up(serve_cluster):
     @serve.deployment(autoscaling_config={
         "min_replicas": 1, "max_replicas": 3,
