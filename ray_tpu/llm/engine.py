@@ -1,17 +1,28 @@
-"""TPU continuous-batching inference engine.
+"""TPU continuous-batching inference engine with a paged KV cache.
 
 Reference capability: ray.llm serves via the vLLM engine (outside the
 reference tree, `llm/_internal/serve/deployments/llm/vllm/`); this engine
 is the in-tree TPU-native equivalent (BASELINE.md config 5):
 
-- slot-major KV cache [L, max_slots, max_seq, Hkv, D] resident in HBM;
+- PAGED KV cache: one block pool ``[L, num_blocks, bs, Hkv, D]`` in HBM
+  shared by all slots through per-slot block tables (PAPERS.md paged
+  attention; `llm/paged_cache.py` owns the host-side pool), so HBM holds
+  ragged sequences without per-slot max_seq reservations;
+- PREFIX REUSE: full prompt blocks are content-hashed; identical
+  prefixes across requests (and across time — freed blocks stay
+  reusable until reallocated) share physical blocks AND skip their
+  prefill FLOPs via a suffix-prefill that attends over the cached
+  prefix (`LlamaModel.prefill_with_prefix`);
 - requests admitted into free slots at any time (continuous batching —
-  decode never drains to admit);
-- prefill at bucketed lengths (static shapes → one jit specialization per
-  bucket, no recompation churn), scattered into the slot cache;
-- decode is ONE jitted step for all slots every iteration (inactive slots
-  masked), sampling on-device (greedy/temperature/top-k), only B int32s
-  return to host per step;
+  decode never drains to admit); pool exhaustion mid-decode PREEMPTS
+  the youngest slot by recompute (blocks freed, request requeued with
+  its generated tokens folded into the prompt), like vLLM's
+  recompute-preemption;
+- prefill at bucketed lengths (static shapes → one jit specialization
+  per bucket, no recompilation churn), scattered into pool blocks;
+- decode is ONE jitted step for all slots every iteration (inactive
+  slots masked), block tables riding along as a tiny int32 array;
+  sampling on-device, only B int32s return to host per step;
 - per-request TTFT / throughput stats (the reference's
   `release/llm_tests/serve/benchmark/load_test.py` metrics).
 """
@@ -23,11 +34,16 @@ import itertools
 import queue
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ray_tpu.llm.paged_cache import (BlockPool, SlotAllocation,
+                                     allocate_slot, ensure_capacity,
+                                     seal_prompt_blocks)
 
 
 @dataclasses.dataclass
@@ -53,12 +69,19 @@ class Request:
         self.finished_at: Optional[float] = None
         self.done = threading.Event()
         self.finish_reason: Optional[str] = None
+        self.preemptions = 0
 
     @property
     def ttft_s(self) -> Optional[float]:
         if self.first_token_at is None:
             return None
         return self.first_token_at - self.submitted_at
+
+    def cache_tokens(self) -> List[int]:
+        """Tokens whose K/V must be cached before the next decode step —
+        the prompt plus everything generated so far (non-empty output
+        only after a preemption re-admission)."""
+        return self.prompt + self.output
 
     def iter_tokens(self):
         """Stream tokens as they are generated."""
@@ -70,43 +93,69 @@ class Request:
 
 
 class ContinuousBatchingEngine:
-    def __init__(self, model, params, *, max_slots: int = 8,
+    def __init__(self, model, params, *, max_slots: int = 32,
                  max_seq: int = 1024,
-                 prefill_buckets: tuple = (32, 64, 128, 256, 512)):
+                 prefill_buckets: tuple = (32, 64, 128, 256, 512),
+                 block_size: int = 32,
+                 num_blocks: Optional[int] = None):
         self.model = model
         self.params = params
         self.max_slots = max_slots
         self.max_seq = max_seq
         self.buckets = tuple(b for b in sorted(prefill_buckets)
                              if b <= max_seq)
-        self.cache = model.init_kv_cache(max_slots, max_seq)
+        if self.buckets:
+            # prefill scatters whole buckets into blocks, so every
+            # bucket must be block-aligned; shrink toward the smallest
+            # bucket rather than reject tiny test configs
+            block_size = min(block_size, self.buckets[0])
+        for b in self.buckets:
+            if b % block_size != 0:
+                raise ValueError(
+                    f"prefill bucket {b} not a multiple of "
+                    f"block_size {block_size}")
+        self.block_size = block_size
+        self.blocks_per_slot = (max_seq + block_size - 1) // block_size
+        if num_blocks is None:
+            num_blocks = max_slots * self.blocks_per_slot
+        self.num_blocks = num_blocks
+        self.pool = BlockPool(num_blocks, block_size)
+        # +1: physical block ``num_blocks`` is the SCRATCH block — every
+        # padded table/scatter entry points there, so inactive slots and
+        # bucket padding write garbage into scratch instead of a live
+        # block, and every device index stays in-bounds (no OOB DMA for
+        # the Pallas path to trip on)
+        self.kv = model.init_kv_pool(num_blocks + 1, block_size)
 
         self.slots: List[Optional[Request]] = [None] * max_slots
+        self.allocs: List[Optional[SlotAllocation]] = [None] * max_slots
         self.offsets = np.zeros(max_slots, np.int32)   # tokens cached/slot
-        self.waiting: "queue.Queue[Request]" = queue.Queue()
+        self._tables = np.full((max_slots, self.blocks_per_slot),
+                               num_blocks, np.int32)
+        self._admit_order: List[int] = []   # oldest-first slot ids
+        self.waiting: "deque[Request]" = deque()
         self._lock = threading.Lock()
         self._rng_key = jax.random.key(0)
 
         # jitted programs ------------------------------------------------
-        self._decode = jax.jit(self._decode_impl, donate_argnums=(1,))
+        self._decode = jax.jit(model.decode_step_paged,
+                               donate_argnums=(2,))
         self._prefill = jax.jit(self._prefill_impl)
+        self._prefill_prefix = jax.jit(model.prefill_with_prefix)
         self._insert = jax.jit(self._insert_impl, donate_argnums=(0,))
+        self._gather = jax.jit(self._gather_impl)
         self._sample = jax.jit(self._sample_impl)
 
         self.stats = {"requests": 0, "tokens_generated": 0,
-                      "decode_steps": 0, "prefills": 0}
+                      "decode_steps": 0, "prefills": 0,
+                      "prefix_prefills": 0, "prefix_tokens_reused": 0,
+                      "preemptions": 0}
 
     # -- jitted internals --------------------------------------------------
-    def _decode_impl(self, params, cache, tokens, offsets):
-        logits, cache = self.model.forward_step(
-            params, tokens[:, None], cache, offsets)
-        return logits[:, 0], cache
-
     def _prefill_impl(self, params, tokens, lengths):
         """BATCHED prefill: tokens [N, Tb], lengths [N]; returns each
         request's last-valid-token logits [N, V] + a BUCKET-SIZED cache
-        [L, N, Tb, Hkv, D] (never max_seq — admission writes only the
-        bucket rows)."""
+        [L, N, Tb, Hkv, D] that admission scatters into pool blocks."""
         N, Tb = tokens.shape
         small = self.model.init_kv_cache(N, Tb)
         logits, small = self.model.forward_step(
@@ -115,14 +164,29 @@ class ContinuousBatchingEngine:
             logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
         return last, small
 
-    def _insert_impl(self, cache, small, slots):
-        """Scatter a bucket-sized prefill cache [L, N, Tb, ...] into the
-        slot cache [L, max_slots, max_seq, ...] at ``slots`` [N] — a
-        per-slot dynamic update of Tb rows, NOT a rebuild of max_seq."""
-        Tb = small["k"].shape[2]
-        k = cache["k"].at[:, slots, :Tb].set(small["k"])
-        v = cache["v"].at[:, slots, :Tb].set(small["v"])
+    def _insert_impl(self, pool, small, block_ids):
+        """Scatter bucket prefill K/V [L, N, Tb, Hkv, D] into pool
+        blocks. ``block_ids`` [N*nb] flat physical ids in logical order
+        (pad with num_blocks = the scratch block)."""
+        L, N, Tb = small["k"].shape[:3]
+        bs = self.block_size
+        nb = Tb // bs
+
+        def to_blocks(x):
+            # [L, N, Tb, H, D] -> [L, N*nb, bs, H, D]
+            return x.reshape(L, N * nb, bs, *x.shape[3:])
+
+        k = pool["k"].at[:, block_ids].set(to_blocks(small["k"]))
+        v = pool["v"].at[:, block_ids].set(to_blocks(small["v"]))
         return {"k": k, "v": v}
+
+    def _gather_impl(self, pool, block_ids):
+        """Gather prefix blocks [Pb] -> dense [L, 1, Pb*bs, Hkv, D]."""
+        k = pool["k"][:, block_ids]          # [L, Pb, bs, Hkv, D]
+        v = pool["v"][:, block_ids]
+        L, Pb, bs = k.shape[:3]
+        return (k.reshape(L, 1, Pb * bs, *k.shape[3:]),
+                v.reshape(L, 1, Pb * bs, *v.shape[3:]))
 
     def _sample_impl(self, logits, temps, top_ks, key):
         """logits [B, V] → tokens [B] on-device."""
@@ -147,11 +211,12 @@ class ContinuousBatchingEngine:
                sampling: Optional[SamplingParams] = None) -> Request:
         req = Request(prompt_tokens, sampling or SamplingParams())
         self.stats["requests"] += 1
-        self.waiting.put(req)
+        with self._lock:
+            self.waiting.append(req)
         return req
 
     def has_work(self) -> bool:
-        return (not self.waiting.empty()
+        return (bool(self.waiting)
                 or any(s is not None for s in self.slots))
 
     def step(self) -> int:
@@ -167,78 +232,203 @@ class ContinuousBatchingEngine:
                 return b
         return None
 
+    # -- admission ---------------------------------------------------------
     def _admit(self) -> None:
-        """Admit as many waiting requests as there are free slots. All
-        admissions sharing a bucket prefill in ONE batched forward (the
-        reference engine's batched prefill), then one batched scatter
-        into the slot cache and one batched sample."""
+        """Admit as many waiting requests as slots AND pool blocks
+        allow. Prefix-hit requests prefill one-by-one through the
+        suffix path; the rest batch per bucket (one forward per
+        bucket). Pool exhaustion stops admission (FIFO order held)."""
         free = [i for i, r in enumerate(self.slots) if r is None]
-        if not free:
+        if not free or not self.waiting:
             return
         by_bucket: Dict[int, List] = {}
-        while free:
-            try:
-                req = self.waiting.get_nowait()
-            except queue.Empty:
-                break
-            n = len(req.prompt)
-            bucket = self._bucket_for(n)
-            if bucket is None or n >= self.max_seq:
-                req.finish_reason = "prompt_too_long"
+        chunked_group: List = []
+        while free and self.waiting:
+            req = self.waiting.popleft()
+            toks = req.cache_tokens()
+            n = len(toks)
+            never_fits = ((n + 1 + self.block_size - 1)
+                          // self.block_size > self.num_blocks)
+            if n >= self.max_seq or never_fits:
+                req.finish_reason = ("length" if req.output
+                                     else "prompt_too_long")
+                req.finished_at = time.perf_counter()
                 req.done.set()
                 req.stream.put(None)
                 continue
-            by_bucket.setdefault(bucket, []).append((free.pop(0), req))
+            # +1 so the first decode write never needs a growth step
+            alloc = allocate_slot(self.pool, toks, n + 1)
+            if alloc is None:
+                # pool can't host it right now — put it back, stop
+                self.waiting.appendleft(req)
+                break
+            alloc, shared_tok = alloc
+            slot = free.pop(0)
+            bucket = self._bucket_for(n)
+            if shared_tok > 0 or bucket is None:
+                # prefix hit, or context longer than the largest
+                # bucket (e.g. a preempted request's regrown context):
+                # CHUNKED prefill over the cached/growing prefix
+                chunked_group.append((slot, req, alloc, shared_tok))
+            else:
+                by_bucket.setdefault(bucket, []).append(
+                    (slot, req, alloc))
+        for slot, req, alloc, shared_tok in chunked_group:
+            self._admit_chunked(slot, req, alloc, shared_tok)
         for bucket, group in by_bucket.items():
-            # pad the group to the next power of two so each bucket has
-            # O(log max_slots) jit specializations, not one per N (a
-            # fresh XLA compile on the admission hot path would stall
-            # every in-flight decode); padded slot ids point past
-            # max_slots, which jax scatter DROPS.
-            n_pad = 1
-            while n_pad < len(group):
-                n_pad *= 2
-            n_pad = min(n_pad, self.max_slots)
-            slots = np.full(n_pad, self.max_slots, np.int32)
-            lengths = np.ones(n_pad, np.int32)
-            toks = np.zeros((n_pad, bucket), np.int32)
-            for row, (slot, req) in enumerate(group):
-                slots[row] = slot
-                lengths[row] = len(req.prompt)
-                toks[row, :len(req.prompt)] = req.prompt
-            last_logits, small = self._prefill(
-                self.params, jnp.asarray(toks), jnp.asarray(lengths))
-            self.cache = self._insert(self.cache, small,
-                                      jnp.asarray(slots))
-            self.stats["prefills"] += 1
-            # sample every first generated token in one batch (padded
-            # rows sampled too, then discarded)
-            self._rng_key, sub = jax.random.split(self._rng_key)
-            temps_np = np.zeros(n_pad, np.float32)
-            top_ks_np = np.zeros(n_pad, np.int32)
-            for row, (_, req) in enumerate(group):
-                temps_np[row] = req.sampling.temperature
-                top_ks_np[row] = req.sampling.top_k
-            temps = jnp.asarray(temps_np)
-            top_ks = jnp.asarray(top_ks_np)
-            toks_out = np.asarray(
-                self._sample(last_logits, temps, top_ks, sub))
-            now = time.perf_counter()
-            for row, (slot, req) in enumerate(group):
-                req.first_token_at = now
-                self.slots[slot] = req
-                self.offsets[slot] = lengths[row]
-                self._emit(slot, int(toks_out[row]))
+            self._admit_bucket(bucket, group)
 
-    def _sample_one(self, logits_1d, req: Request):
+    def _pad_pow2(self, n: int, cap: int) -> int:
+        p = 1
+        while p < n:
+            p *= 2
+        return min(p, cap)
+
+    def _admit_bucket(self, bucket: int, group: List) -> None:
+        """Batched no-prefix prefill: one forward + one pool scatter +
+        one sample for the whole group."""
+        bs = self.block_size
+        nb = bucket // bs
+        # pad the group to the next power of two so each bucket has
+        # O(log max_slots) jit specializations, not one per N
+        n_pad = self._pad_pow2(len(group), self.max_slots)
+        lengths = np.ones(n_pad, np.int32)
+        toks = np.zeros((n_pad, bucket), np.int32)
+        block_ids = np.full(n_pad * nb, self.num_blocks, np.int32)
+        for row, (slot, req, alloc) in enumerate(group):
+            seq = req.cache_tokens()
+            lengths[row] = len(seq)
+            toks[row, :len(seq)] = seq
+            ids = alloc.blocks[:nb]
+            block_ids[row * nb:row * nb + len(ids)] = ids
+        last_logits, small = self._prefill(
+            self.params, jnp.asarray(toks), jnp.asarray(lengths))
+        self.kv = self._insert(self.kv, small, jnp.asarray(block_ids))
+        self.stats["prefills"] += 1
+        toks_out = self._sample_batch(last_logits,
+                                      [req for _, req, _ in group], n_pad)
+        now = time.perf_counter()
+        for row, (slot, req, alloc) in enumerate(group):
+            self._activate(slot, req, alloc, int(lengths[row]), now)
+            self._emit(slot, int(toks_out[row]))
+
+    def _prefill_chunk(self, alloc: SlotAllocation, seq: List[int],
+                       pos: int, chunk_len: int):
+        """Prefill ``seq[pos:pos+chunk_len]`` attending over the
+        already-cached ``pos`` tokens (gathered dense from the pool),
+        scattering the chunk's K/V into the slot's blocks. ``pos`` is
+        block-aligned. Returns the chunk's last-token logits."""
+        bs = self.block_size
+        pb = pos // bs
+        chunk = seq[pos:pos + chunk_len]
+        s_bucket = self._bucket_for(len(chunk))
+        # pad the gathered prefix to a power-of-two block count to bound
+        # jit specializations; padded rows are position-masked
+        pb_pad = self._pad_pow2(max(pb, 1), self.blocks_per_slot)
+        ids = np.zeros(pb_pad, np.int32)
+        ids[:pb] = alloc.blocks[:pb]
+        pk, pv = self._gather(self.kv, jnp.asarray(ids))
+        toks = np.zeros((1, s_bucket), np.int32)
+        toks[0, :len(chunk)] = chunk
+        last_logits, small = self._prefill_prefix(
+            self.params, jnp.asarray(toks), pk, pv,
+            jnp.asarray([pos], np.int32),
+            jnp.asarray([len(chunk)], np.int32))
+        nb = s_bucket // bs
+        block_ids = np.full(nb, self.num_blocks, np.int32)
+        avail = alloc.blocks[pb:pb + nb]
+        block_ids[:len(avail)] = avail
+        # chunk cache is [L, 1, Tb, ...]: reuse the batched scatter
+        self.kv = self._insert(self.kv, small, jnp.asarray(block_ids))
+        self.stats["prefills"] += 1
+        return last_logits
+
+    def _admit_chunked(self, slot: int, req: Request,
+                      alloc: SlotAllocation, shared_tok: int) -> None:
+        """Single-request chunked prefill: the cached prefix (shared
+        blocks and/or earlier chunks) is attended as context, so any
+        context length admits — shared-prefix FLOPs are skipped, and a
+        context longer than the largest bucket prefills in bucket-sized
+        chunks (vLLM's chunked prefill)."""
+        seq = req.cache_tokens()
+        n = len(seq)
+        if shared_tok > 0:
+            self.stats["prefix_prefills"] += 1
+            self.stats["prefix_tokens_reused"] += shared_tok
+        pos = shared_tok
+        big = self.buckets[-1]
+        last_logits = None
+        while pos < n:
+            chunk_len = min(big, n - pos)
+            last_logits = self._prefill_chunk(alloc, seq, pos, chunk_len)
+            pos += chunk_len
+        toks_out = self._sample_batch(last_logits, [req], 1)
+        self._activate(slot, req, alloc, n, time.perf_counter())
+        self._emit(slot, int(toks_out[0]))
+
+    def _activate(self, slot: int, req: Request, alloc: SlotAllocation,
+                  n_cached: int, now: float) -> None:
+        seal_prompt_blocks(self.pool, alloc, req.cache_tokens())
+        if req.first_token_at is None:
+            req.first_token_at = now
+        self.slots[slot] = req
+        self.allocs[slot] = alloc
+        self.offsets[slot] = n_cached
+        self._tables[slot] = self.num_blocks
+        self._tables[slot, :len(alloc.blocks)] = alloc.blocks
+        self._admit_order.append(slot)
+
+    def _sample_batch(self, logits, reqs: List[Request], n_pad: int):
         self._rng_key, sub = jax.random.split(self._rng_key)
-        tok = self._sample(
-            logits_1d[None, :],
-            jnp.asarray([req.sampling.temperature], jnp.float32),
-            jnp.asarray([req.sampling.top_k], jnp.int32), sub)
-        return int(tok[0])
+        temps = np.zeros(n_pad, np.float32)
+        top_ks = np.zeros(n_pad, np.int32)
+        for row, req in enumerate(reqs):
+            temps[row] = req.sampling.temperature
+            top_ks[row] = req.sampling.top_k
+        return np.asarray(self._sample(
+            logits, jnp.asarray(temps), jnp.asarray(top_ks), sub))
+
+    # -- decode ------------------------------------------------------------
+    def _preempt(self, slot: int) -> None:
+        """Free a slot's blocks and requeue its request (recompute
+        preemption): generated tokens fold into the prompt so the
+        re-admission prefill rebuilds the full context."""
+        req = self.slots[slot]
+        self.pool.unref_all(self.allocs[slot].blocks)
+        self.slots[slot] = None
+        self.allocs[slot] = None
+        self.offsets[slot] = 0
+        self._tables[slot] = self.num_blocks   # idle writes go to scratch
+        self._admit_order.remove(slot)
+        req.preemptions += 1
+        self.stats["preemptions"] += 1
+        self.waiting.appendleft(req)
+
+    def _grow_or_preempt(self) -> None:
+        """Every active slot must have capacity for its next token's
+        K/V before the batched decode runs. Exhaustion preempts the
+        YOUNGEST slot (recompute is cheapest for it) until the older
+        ones fit — the victim may be the grower itself."""
+        for slot in list(self._admit_order):      # oldest first
+            if self.slots[slot] is None:
+                continue
+            alloc = self.allocs[slot]
+            while not ensure_capacity(self.pool, alloc,
+                                      int(self.offsets[slot]) + 1):
+                # chunked prefill re-admits ANY context length, so plain
+                # youngest-first is always safe (and discards the least
+                # computed work)
+                victims = [s for s in self._admit_order
+                           if s != slot] or [slot]
+                victim = victims[-1]
+                self._preempt(victim)
+                if victim == slot:
+                    break
+            if self.slots[slot] is not None:
+                self._tables[slot, :len(alloc.blocks)] = alloc.blocks
 
     def _decode_step(self) -> int:
+        self._grow_or_preempt()
         active = [i for i, r in enumerate(self.slots) if r is not None]
         if not active:
             return 0
@@ -251,9 +441,9 @@ class ContinuousBatchingEngine:
                 (req.prompt[-1] if req.prompt else 0)
             temps[i] = req.sampling.temperature
             top_ks[i] = req.sampling.top_k
-        logits, self.cache = self._decode(
-            self.params, self.cache, jnp.asarray(last_tokens),
-            jnp.asarray(self.offsets))
+        logits, self.kv = self._decode(
+            self.params, jnp.asarray(last_tokens), self.kv,
+            jnp.asarray(self._tables), jnp.asarray(self.offsets))
         self._rng_key, sub = jax.random.split(self._rng_key)
         toks = np.asarray(self._sample(
             logits, jnp.asarray(temps), jnp.asarray(top_ks), sub))
@@ -277,8 +467,14 @@ class ContinuousBatchingEngine:
             req.finished_at = time.perf_counter()
             req.stream.put(None)
             req.done.set()
+            # blocks go cached-free: content stays prefix-reusable
+            # until the pool reallocates them
+            self.pool.unref_all(self.allocs[slot].blocks)
             self.slots[slot] = None
+            self.allocs[slot] = None
             self.offsets[slot] = 0
+            self._tables[slot] = self.num_blocks   # idle writes → scratch
+            self._admit_order.remove(slot)
 
     # -- prefill/decode disaggregation handoff -----------------------------
     def prefill_only(self, prompt_tokens: List[int]):
@@ -302,22 +498,39 @@ class ContinuousBatchingEngine:
                          last_logits, sampling: Optional[SamplingParams]
                          = None) -> Optional[Request]:
         """Admit a request whose prefill happened elsewhere. Returns None
-        if no slot is free (caller retries)."""
+        if no slot (or pool room) is free (caller retries)."""
         req = Request(prompt_tokens, sampling or SamplingParams())
+        n = len(prompt_tokens)
+        if n >= self.max_seq:
+            req.finish_reason = "prompt_too_long"
+            req.finished_at = time.perf_counter()
+            req.done.set()
+            req.stream.put(None)
+            return req
         with self._lock:
             free = [i for i, s in enumerate(self.slots) if s is None]
             if not free:
                 return None
-            slot = free[0]
+            bs = self.block_size
+            Tb = kv["k"].shape[2]
+            nb = Tb // bs
+            need = max((n + 1 + bs - 1) // bs, 1)
+            blocks = self.pool.alloc(max(need, 0))
+            if blocks is None:
+                return None
+            alloc = SlotAllocation(blocks, 0)
+            block_ids = np.full(nb, self.num_blocks, np.int32)
+            avail = blocks[:nb]
+            block_ids[:len(avail)] = avail
             small = {"k": jnp.asarray(kv["k"]), "v": jnp.asarray(kv["v"])}
-            self.cache = self._insert(self.cache, small,
-                                      jnp.asarray([slot], np.int32))
-            tok = self._sample_one(jnp.asarray(last_logits), req)
-            req.first_token_at = time.perf_counter()
-            self.slots[slot] = req
-            self.offsets[slot] = len(prompt_tokens)
+            self.kv = self._insert(self.kv, small,
+                                   jnp.asarray(block_ids))
+            slot = free[0]
+            toks_out = self._sample_batch(jnp.asarray(last_logits)[None],
+                                          [req], 1)
             self.stats["requests"] += 1
-            self._emit(slot, int(tok))
+            self._activate(slot, req, alloc, n, time.perf_counter())
+            self._emit(slot, int(toks_out[0]))
         return req
 
     # -- convenience -------------------------------------------------------
@@ -333,5 +546,5 @@ class ContinuousBatchingEngine:
                     idle_sleep_s: float = 0.002) -> None:
         """Background engine loop (used by the serving integration)."""
         while not stop_event.is_set():
-            if self.step() == 0 and self.waiting.empty():
+            if self.step() == 0 and not self.waiting:
                 time.sleep(idle_sleep_s)

@@ -381,6 +381,152 @@ class LlamaModel:
         logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype))
         return logits.astype(jnp.float32), {"k": k_out, "v": v_out}
 
+    # -- paged KV-cache path (llm/engine.py + llm/paged_cache.py) ---------
+    def init_kv_pool(self, num_blocks: int, block_size: int) -> Params:
+        """Block-pool cache: k/v [L, num_blocks, block_size, Hkv, D],
+        bf16 in HBM, shared by every slot via per-slot block tables."""
+        cfg = self.cfg
+        shape = (cfg.n_layers, num_blocks, block_size, cfg.n_kv_heads,
+                 cfg.head_dim)
+        return {"k": jnp.zeros(shape, cfg.dtype),
+                "v": jnp.zeros(shape, cfg.dtype)}
+
+    def decode_step_paged(self, params: Params, tokens: jax.Array,
+                          pool: Params, block_tables: jax.Array,
+                          offsets: jax.Array
+                          ) -> Tuple[jax.Array, Params]:
+        """One decode step for every slot against the block pool.
+
+        tokens [B] int32 (each slot's last sampled token)
+        pool   k/v [L, NB, bs, Hkv, D]
+        block_tables [B, MAXB] int32 physical ids (logical order)
+        offsets [B] tokens already cached per slot
+        Returns (logits [B, V], updated pool). Slots whose table rows
+        point at garbage simply compute garbage that the engine masks.
+        """
+        cfg = self.cfg
+        bs = pool["k"].shape[2]
+        dest_block = jnp.take_along_axis(
+            block_tables, (offsets // bs)[:, None], axis=1)[:, 0]  # [B]
+        dest_off = offsets % bs
+        lengths = offsets + 1
+        q_pos = offsets[:, None]                                   # [B, 1]
+        x = self._embed_lookup(params["embed"].astype(cfg.dtype),
+                               tokens[:, None])                    # [B,1,D]
+        impl = "pallas" if cfg.decode_attention == "pallas" else "xla"
+        from ray_tpu.ops.paged_attention import paged_decode_attention
+
+        def block(carry, layer_and_pool):
+            x = carry
+            layer, k_pool, v_pool = layer_and_pool
+            dt = cfg.dtype
+            h = rms_norm(x, layer["attn_norm"], eps=cfg.norm_eps)
+            q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
+            k_new = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(dt))
+            v_new = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(dt))
+            q = apply_rope(q, self._angles, q_pos)
+            k_new = apply_rope(k_new, self._angles, q_pos)
+            # each slot writes its own private tail block (refcount 1 —
+            # shared prefix blocks are never write targets)
+            k_pool = k_pool.at[dest_block, dest_off].set(
+                k_new[:, 0].astype(dt))
+            v_pool = v_pool.at[dest_block, dest_off].set(
+                v_new[:, 0].astype(dt))
+            o = paged_decode_attention(q[:, 0], k_pool, v_pool,
+                                       block_tables, lengths, impl=impl)
+            o = jnp.einsum("bhk,hkd->bd", o, layer["wo"].astype(dt))
+            x = x + o[:, None]
+            h = rms_norm(x, layer["mlp_norm"], eps=cfg.norm_eps)
+            gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(dt))
+            up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(dt))
+            down = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                              layer["w_down"].astype(dt))
+            return x + down, (k_pool, v_pool)
+
+        x, (k_out, v_out) = jax.lax.scan(
+            block, x, (params["layers"], pool["k"], pool["v"]))
+        x = rms_norm(x, params["norm_f"], eps=cfg.norm_eps)
+        head = (params["embed"].T if cfg.tie_embeddings
+                else params["lm_head"])
+        logits = jnp.einsum("bsd,dv->bsv", x, head.astype(cfg.dtype))
+        return logits[:, 0].astype(jnp.float32), {"k": k_out, "v": v_out}
+
+    def prefill_with_prefix(self, params: Params, tokens: jax.Array,
+                            prefix_k: jax.Array, prefix_v: jax.Array,
+                            prefix_len: jax.Array, lengths: jax.Array
+                            ) -> Tuple[jax.Array, Params]:
+        """Suffix prefill attending over a cached (shared) prefix.
+
+        tokens   [N, Tb] suffix tokens (right-padded)
+        prefix_k/v [L, N, Pmax, Hkv, D] dense prefix K/V gathered from
+                 the pool, right-padded past ``prefix_len``
+        prefix_len [N] valid prefix tokens
+        lengths  [N] valid suffix tokens
+        Returns (last-token logits [N, V], suffix K/V [L, N, Tb, Hkv, D])
+        — the caller scatters the suffix K/V into fresh pool blocks; the
+        prefix blocks are never copied or rewritten (prefix-reuse skips
+        their FLOPs entirely).
+        """
+        cfg = self.cfg
+        N, Tb = tokens.shape
+        Pmax = prefix_k.shape[2]
+        dt = cfg.dtype
+        # absolute positions: suffix token t sits at prefix_len + t;
+        # padded prefix rows get a position PAST every query so the
+        # causal mask drops them
+        pos_q = prefix_len[:, None] + jnp.arange(Tb)[None, :]       # [N,Tb]
+        far = jnp.int32(2 ** 30)
+        pos_prefix = jnp.where(
+            jnp.arange(Pmax)[None, :] < prefix_len[:, None],
+            jnp.arange(Pmax)[None, :], far)                          # [N,Pmax]
+        x = self._embed_lookup(params["embed"].astype(dt), tokens)
+
+        from ray_tpu.ops.attention import NEG_INF, _repeat_kv
+
+        def block(carry, layer_and_prefix):
+            x = carry
+            layer, kp, vp = layer_and_prefix       # kp/vp [N, Pmax, Hkv, D]
+            h = rms_norm(x, layer["attn_norm"], eps=cfg.norm_eps)
+            q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
+            k_new = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(dt))
+            v_new = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(dt))
+            q = apply_rope(q, self._angles, pos_q)
+            k_new = apply_rope(k_new, self._angles, pos_q)
+            k_all = jnp.concatenate([kp.astype(dt), k_new], axis=1)
+            v_all = jnp.concatenate([vp.astype(dt), v_new], axis=1)
+            pos_k = jnp.concatenate(
+                [pos_prefix, pos_q], axis=1)                        # [N,P+Tb]
+            # per-row positions (prefix_len varies by row) — masked
+            # attention inline; padded prefix rows have pos_k=2^30 so
+            # the causal test drops them
+            kk = _repeat_kv(k_all, cfg.n_heads)
+            vv = _repeat_kv(v_all, cfg.n_heads)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, kk,
+                           preferred_element_type=jnp.float32)
+            s = s * (cfg.head_dim ** -0.5)
+            mask = pos_q[:, None, :, None] >= pos_k[:, None, None, :]
+            s = jnp.where(mask, s, NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(dt), vv)
+            o = jnp.einsum("bshk,hkd->bsd", o, layer["wo"].astype(dt))
+            x = x + o
+            h = rms_norm(x, layer["mlp_norm"], eps=cfg.norm_eps)
+            gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(dt))
+            up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(dt))
+            down = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                              layer["w_down"].astype(dt))
+            return x + down, (k_new, v_new)
+
+        x, (k_out, v_out) = jax.lax.scan(
+            block, x, (params["layers"], prefix_k, prefix_v))
+        x = rms_norm(x, params["norm_f"], eps=cfg.norm_eps)
+        head = (params["embed"].T if cfg.tie_embeddings
+                else params["lm_head"])
+        last = jnp.take_along_axis(x, (lengths - 1)[:, None, None],
+                                   axis=1)[:, 0]                    # [N, D]
+        logits = jnp.einsum("bd,dv->bv", last, head.astype(dt))
+        return logits.astype(jnp.float32), {"k": k_out, "v": v_out}
+
     def loss(self, params: Params, tokens: jax.Array,
              targets: jax.Array,
              mask: Optional[jax.Array] = None) -> jax.Array:
