@@ -104,6 +104,10 @@ class ContinuousBatchingEngine:
         self.max_seq = max_seq
         self.buckets = tuple(b for b in sorted(prefill_buckets)
                              if b <= max_seq)
+        if not self.buckets:
+            raise ValueError(
+                f"no prefill bucket fits max_seq={max_seq}: "
+                f"{prefill_buckets}")
         if self.buckets:
             # prefill scatters whole buckets into blocks, so every
             # bucket must be block-aligned; shrink toward the smallest
@@ -181,12 +185,12 @@ class ContinuousBatchingEngine:
         return {"k": k, "v": v}
 
     def _gather_impl(self, pool, block_ids):
-        """Gather prefix blocks [Pb] -> dense [L, 1, Pb*bs, Hkv, D]."""
-        k = pool["k"][:, block_ids]          # [L, Pb, bs, Hkv, D]
+        """Gather prefix blocks [N, Pb] -> dense [L, N, Pb*bs, Hkv, D]."""
+        k = pool["k"][:, block_ids]          # [L, N, Pb, bs, Hkv, D]
         v = pool["v"][:, block_ids]
-        L, Pb, bs = k.shape[:3]
-        return (k.reshape(L, 1, Pb * bs, *k.shape[3:]),
-                v.reshape(L, 1, Pb * bs, *v.shape[3:]))
+        L, N, Pb, bs = k.shape[:4]
+        return (k.reshape(L, N, Pb * bs, *k.shape[4:]),
+                v.reshape(L, N, Pb * bs, *v.shape[4:]))
 
     def _sample_impl(self, logits, temps, top_ks, key):
         """logits [B, V] → tokens [B] on-device."""
@@ -211,8 +215,9 @@ class ContinuousBatchingEngine:
                sampling: Optional[SamplingParams] = None) -> Request:
         req = Request(prompt_tokens, sampling or SamplingParams())
         self.stats["requests"] += 1
-        with self._lock:
-            self.waiting.append(req)
+        # deque.append is atomic — submitters never contend on the
+        # engine-step lock (a step can span a whole prefill+decode)
+        self.waiting.append(req)
         return req
 
     def has_work(self) -> bool:
@@ -273,7 +278,26 @@ class ContinuousBatchingEngine:
             else:
                 by_bucket.setdefault(bucket, []).append(
                     (slot, req, alloc))
-        for slot, req, alloc, shared_tok in chunked_group:
+        # single-chunk prefix hits with identical padded shapes BATCH
+        # through prefill_with_prefix's N dimension (the common wave of
+        # same-prefix requests); multi-chunk contexts go one-by-one
+        big = self.buckets[-1]
+        by_shape: Dict[tuple, List] = {}
+        singles: List = []
+        for item in chunked_group:
+            _, req, alloc, shared_tok = item
+            suffix_len = len(req.cache_tokens()) - shared_tok
+            if 0 < shared_tok and suffix_len <= big:
+                pb_pad = self._pad_pow2(
+                    max(shared_tok // self.block_size, 1),
+                    self.blocks_per_slot)
+                key = (pb_pad, self._bucket_for(suffix_len))
+                by_shape.setdefault(key, []).append(item)
+            else:
+                singles.append(item)
+        for (pb_pad, s_bucket), group in by_shape.items():
+            self._admit_prefix_batch(pb_pad, s_bucket, group)
+        for slot, req, alloc, shared_tok in singles:
             self._admit_chunked(slot, req, alloc, shared_tok)
         for bucket, group in by_bucket.items():
             self._admit_bucket(bucket, group)
@@ -312,6 +336,44 @@ class ContinuousBatchingEngine:
             self._activate(slot, req, alloc, int(lengths[row]), now)
             self._emit(slot, int(toks_out[row]))
 
+    def _admit_prefix_batch(self, pb_pad: int, s_bucket: int,
+                            group: List) -> None:
+        """Batched suffix prefill for same-shape prefix hits: one
+        gather + one forward + one scatter + one sample for the wave."""
+        bs = self.block_size
+        nb = s_bucket // bs
+        n_pad = self._pad_pow2(len(group), self.max_slots)
+        ids = np.zeros((n_pad, pb_pad), np.int32)
+        toks = np.zeros((n_pad, s_bucket), np.int32)
+        plens = np.zeros(n_pad, np.int32)
+        slens = np.ones(n_pad, np.int32)
+        block_ids = np.full(n_pad * nb, self.num_blocks, np.int32)
+        for row, (slot, req, alloc, shared) in enumerate(group):
+            seq = req.cache_tokens()
+            pb = shared // bs
+            ids[row, :pb] = alloc.blocks[:pb]
+            suffix = seq[shared:]
+            toks[row, :len(suffix)] = suffix
+            plens[row] = shared
+            slens[row] = len(suffix)
+            avail = alloc.blocks[pb:pb + nb]
+            block_ids[row * nb:row * nb + len(avail)] = avail
+            self.stats["prefix_prefills"] += 1
+            self.stats["prefix_tokens_reused"] += shared
+        pk, pv = self._gather(self.kv, jnp.asarray(ids))
+        last_logits, small = self._prefill_prefix(
+            self.params, jnp.asarray(toks), pk, pv,
+            jnp.asarray(plens), jnp.asarray(slens))
+        self.kv = self._insert(self.kv, small, jnp.asarray(block_ids))
+        self.stats["prefills"] += 1
+        toks_out = self._sample_batch(last_logits,
+                                      [req for _, req, _, _ in group],
+                                      n_pad)
+        now = time.perf_counter()
+        for row, (slot, req, alloc, shared) in enumerate(group):
+            self._activate(slot, req, alloc, len(req.cache_tokens()), now)
+            self._emit(slot, int(toks_out[row]))
+
     def _prefill_chunk(self, alloc: SlotAllocation, seq: List[int],
                        pos: int, chunk_len: int):
         """Prefill ``seq[pos:pos+chunk_len]`` attending over the
@@ -325,8 +387,8 @@ class ContinuousBatchingEngine:
         # pad the gathered prefix to a power-of-two block count to bound
         # jit specializations; padded rows are position-masked
         pb_pad = self._pad_pow2(max(pb, 1), self.blocks_per_slot)
-        ids = np.zeros(pb_pad, np.int32)
-        ids[:pb] = alloc.blocks[:pb]
+        ids = np.zeros((1, pb_pad), np.int32)
+        ids[0, :pb] = alloc.blocks[:pb]
         pk, pv = self._gather(self.kv, jnp.asarray(ids))
         toks = np.zeros((1, s_bucket), np.int32)
         toks[0, :len(chunk)] = chunk
