@@ -10,6 +10,9 @@ gradient communication is XLA collectives over ICI, not NCCL.
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
                                   ScalingConfig)
+from ray_tpu.train.scaling_policy import (ElasticScalingPolicy,
+                                          FixedScalingPolicy,
+                                          ResizeDecision, ScalingPolicy)
 from ray_tpu.train.session import (get_checkpoint, get_context,
                                    get_dataset_shard, report)
 from ray_tpu.train.spmd import TrainStep, make_train_step, shard_batch
@@ -21,4 +24,6 @@ __all__ = [
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
     "report", "get_context", "get_checkpoint", "get_dataset_shard",
     "JaxTrainer", "Result",
+    "ScalingPolicy", "FixedScalingPolicy", "ElasticScalingPolicy",
+    "ResizeDecision",
 ]

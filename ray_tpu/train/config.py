@@ -26,6 +26,11 @@ class ScalingConfig:
     use_tpu: bool = False
     mesh: Optional[MeshSpec] = None
     placement_strategy: str = "PACK"
+    # (min, max): recover from worker failure by re-forming the group at
+    # the surviving capacity within this range instead of waiting for
+    # max hardware (reference: train v2 scaling_policy.py; see
+    # ray_tpu/train/scaling_policy.py)
+    elastic: Optional[tuple] = None
 
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker:

@@ -1,0 +1,113 @@
+"""Scaling policies: how the trainer sizes each worker-group (re)start.
+
+Reference capability: `python/ray/train/v2/_internal/execution/
+scaling_policy/scaling_policy.py` (ScalingPolicy → NoopDecision /
+ResizeDecision, with FixedScalingPolicy the default and elastic policies
+deciding a new world size after failures). TPU-native shape: the
+decision is a plain target WORLD SIZE — re-forming the group at size W
+re-forms the device mesh at W hosts, and the SPMD program re-shards its
+checkpointed state onto the smaller/larger mesh at restore (the "re-form
+a smaller mesh" hard part of SURVEY §7).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class ResizeDecision:
+    """Re-form the group at ``num_workers`` (== NoopDecision when it
+    matches the current size)."""
+
+    num_workers: int
+
+
+class ScalingPolicy:
+    """Decides the world size for every (re)start of the worker group."""
+
+    def initial_size(self) -> int:
+        raise NotImplementedError
+
+    def on_recovery(self, current_size: int,
+                    resources_per_worker: Dict[str, float],
+                    attempt: int) -> ResizeDecision:
+        """Called after a worker-group failure, before the retry."""
+        raise NotImplementedError
+
+
+class FixedScalingPolicy(ScalingPolicy):
+    """Always the configured size — a retry waits for the full gang to
+    be placeable again (the Train v1 behavior)."""
+
+    def __init__(self, num_workers: int):
+        self.num_workers = num_workers
+
+    def initial_size(self) -> int:
+        return self.num_workers
+
+    def on_recovery(self, current_size, resources_per_worker, attempt):
+        return ResizeDecision(self.num_workers)
+
+
+class ElasticScalingPolicy(ScalingPolicy):
+    """Re-form at the surviving capacity: after a failure, size the next
+    group to what the cluster can actually place NOW, clamped to
+    [min_workers, max_workers]. Training continues on the survivors from
+    the latest checkpoint instead of waiting for replacement hardware.
+
+    ``wait_s``: how long to wait for capacity >= min_workers before
+    giving the trainer a group it can still not place (whose failure
+    then counts against FailureConfig).
+    """
+
+    def __init__(self, min_workers: int, max_workers: int,
+                 wait_s: float = 10.0, poll_interval_s: float = 0.25):
+        if not 1 <= min_workers <= max_workers:
+            raise ValueError(
+                f"need 1 <= min_workers <= max_workers, got "
+                f"[{min_workers}, {max_workers}]")
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.wait_s = wait_s
+        self.poll_interval_s = poll_interval_s
+
+    def initial_size(self) -> int:
+        return self.max_workers
+
+    def _placeable_workers(self, resources_per_worker) -> int:
+        import ray_tpu
+
+        avail = ray_tpu.available_resources()
+        fits = math.inf
+        for key, per in resources_per_worker.items():
+            if per <= 0:
+                continue
+            fits = min(fits, avail.get(key, 0.0) // per)
+        return int(fits) if fits is not math.inf else self.max_workers
+
+    def on_recovery(self, current_size, resources_per_worker, attempt):
+        deadline = time.monotonic() + self.wait_s
+        while True:
+            n = self._placeable_workers(resources_per_worker)
+            if n >= self.min_workers or time.monotonic() >= deadline:
+                break
+            time.sleep(self.poll_interval_s)
+        n = max(self.min_workers, min(self.max_workers, n))
+        return ResizeDecision(n)
+
+
+def resolve_policy(scaling_config,
+                   policy: Optional[ScalingPolicy]) -> ScalingPolicy:
+    """Explicit policy wins; ``ScalingConfig(elastic=(min, max))``
+    builds an elastic one; otherwise fixed at num_workers."""
+    if policy is not None:
+        return policy
+    elastic = getattr(scaling_config, "elastic", None)
+    if elastic:
+        lo, hi = elastic
+        return ElasticScalingPolicy(lo, hi)
+    return FixedScalingPolicy(scaling_config.num_workers)

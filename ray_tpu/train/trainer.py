@@ -41,13 +41,16 @@ class JaxTrainer:
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
                  datasets: Optional[Dict[str, Any]] = None,
-                 resume_from_checkpoint: Optional[Checkpoint] = None):
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 scaling_policy=None):
+        from ray_tpu.train.scaling_policy import resolve_policy
         self.train_loop = train_loop_per_worker
         self.train_loop_config = train_loop_config
         self.scaling = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.datasets = datasets or {}
         self.resume_from_checkpoint = resume_from_checkpoint
+        self.scaling_policy = resolve_policy(self.scaling, scaling_policy)
 
     # ------------------------------------------------------------------
     def fit(self) -> Result:
@@ -89,12 +92,13 @@ class JaxTrainer:
         max_failures = self.run_config.failure_config.max_failures
         error: Optional[str] = None
 
+        world_size = self.scaling_policy.initial_size()
         while True:
             group = WorkerGroup(
-                self.scaling.num_workers, self.scaling.worker_resources(),
+                world_size, self.scaling.worker_resources(),
                 placement_strategy=self.scaling.placement_strategy,
                 experiment_name=self.run_config.name or "train_run")
-            shards = self._split_datasets()
+            shards = self._split_datasets(world_size)
             run_refs = group.start_run(
                 self.train_loop, self.train_loop_config,
                 latest_checkpoint=latest, dataset_shards=shards)
@@ -111,7 +115,14 @@ class JaxTrainer:
             if max_failures >= 0 and failures > max_failures:
                 error = err or "train workers failed"
                 break
-            # else: elastic retry — re-form the group from latest ckpt
+            # elastic retry: the policy picks the NEXT world size (e.g.
+            # the surviving hosts after a node death) and the group
+            # re-forms from the latest checkpoint at that size — the
+            # SPMD program re-shards its state onto the smaller mesh at
+            # restore time
+            decision = self.scaling_policy.on_recovery(
+                world_size, self.scaling.worker_resources(), failures)
+            world_size = decision.num_workers
 
         emit_export("TRAIN_RUN", name=self.run_config.name or "train_run",
                     state="ERRORED" if error else "FINISHED",
@@ -122,10 +133,9 @@ class JaxTrainer:
         return result
 
     # ------------------------------------------------------------------
-    def _split_datasets(self):
+    def _split_datasets(self, n: int):
         if not self.datasets:
             return None
-        n = self.scaling.num_workers
         shards: List[Dict[str, Any]] = [dict() for _ in range(n)]
         for name, ds in self.datasets.items():
             if hasattr(ds, "streaming_split"):
