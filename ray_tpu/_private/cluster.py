@@ -430,7 +430,8 @@ class RemoteStore:
             self._meta[object_id] = (daemon_key, nbytes)
 
     def put(self, object_id, value, nbytes: int = 0) -> None:
-        blob = cloudpickle.dumps(value)
+        from ray_tpu._private.device_objects import wire_dumps
+        blob = wire_dumps(value)
         key = b"put:" + object_id.binary()
         self.daemon.put_object_blob(key, blob)
         with self._lock:
@@ -513,10 +514,11 @@ class OwnerService:
             from ray_tpu._private.worker_process import dispatch_core_op
 
             try:
+                from ray_tpu._private.device_objects import wire_dumps
                 kw = cloudpickle.loads(msg["payload"])
                 value = dispatch_core_op(self.runtime, self.holder,
                                          msg["call"], kw, msg.get("task"))
-                conn.reply(rid, ok=True, value=cloudpickle.dumps(value))
+                conn.reply(rid, ok=True, value=wire_dumps(value))
             except BaseException as e:  # noqa: BLE001 — shipped back
                 try:
                     blob = cloudpickle.dumps(e)

@@ -1,0 +1,107 @@
+"""Cross-process device (HBM) objects: sharding-preserving wire format.
+
+Reference capability: ``python/ray/experimental/gpu_object_manager/
+gpu_object_manager.py:18`` — GPU tensors crossing process/host
+boundaries without losing their device placement (the reference moves
+them with NCCL; collective transport).
+
+TPU-native design: within one host process the object store keeps the
+LIVE ``jax.Array`` in its HBM tier and consumers get it zero-copy
+(``_private/object_store.py`` device tier). Only when a value crosses a
+PROCESS boundary (daemon worker -> driver, node -> node) does it pass
+through here: the array serializes as **(host bytes, dtype string,
+sharding meta)** and the consumer rematerializes it with
+``jax.device_put`` — re-sharded onto an equivalent local mesh when the
+consumer has enough devices, single-device otherwise. jax's built-in
+pickle reducer drops NamedShardings to SingleDeviceSharding; this one
+round-trips them.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Optional, Tuple
+
+
+def wire_dumps(value: Any) -> bytes:
+    """cloudpickle.dumps with the sharding-preserving jax.Array reducer,
+    SCOPED to this pickler only. Never touches copyreg's process-global
+    dispatch table — user code's pickle/copy.deepcopy semantics for
+    jax.Arrays stay exactly jax's own. Every ray_tpu wire boundary that
+    may carry user values must dump through here."""
+    import cloudpickle
+
+    buf = io.BytesIO()
+    pickler = cloudpickle.CloudPickler(buf, protocol=5)
+    # delegate to cloudpickle's own reducer_override — it is how local
+    # functions/classes get pickled; shadowing it outright breaks them
+    base = pickler.reducer_override
+
+    def reducer_override(obj):
+        if is_jax_array(obj):
+            return (rebuild_jax_array, (reduce_jax_array(obj),))
+        return base(obj)
+
+    pickler.reducer_override = reducer_override
+    pickler.dump(value)
+    return buf.getvalue()
+
+
+def is_jax_array(obj: Any) -> bool:
+    """Cheap check that avoids importing jax for non-jax values."""
+    if not type(obj).__module__.startswith(("jax", "jaxlib")):
+        return False
+    try:
+        import jax
+    except ImportError:
+        return False
+    return isinstance(obj, jax.Array)
+
+
+def _spec_to_wire(spec) -> Tuple:
+    """PartitionSpec entries are str | tuple[str, ...] | None — already
+    picklable; normalize to a plain tuple."""
+    return tuple(spec)
+
+
+def reduce_jax_array(arr) -> Tuple:
+    """(host_numpy, sharding_meta). The numpy payload carries the dtype
+    itself (ml_dtypes covers bf16). Raises for non-fully-addressable
+    arrays (a multi-host global array cannot be pulled to one process;
+    ship per-host shards instead)."""
+    import jax
+    import numpy as np
+
+    if not arr.is_fully_addressable:
+        raise ValueError(
+            "cannot serialize a non-fully-addressable jax.Array across "
+            "a process boundary; fetch per-host shards or use "
+            "multihost collectives")
+    meta: Optional[Tuple] = None
+    sh = arr.sharding
+    if isinstance(sh, jax.sharding.NamedSharding):
+        mesh = sh.mesh
+        meta = ("named", tuple(mesh.axis_names),
+                tuple(mesh.devices.shape), _spec_to_wire(sh.spec))
+    host = np.asarray(arr)        # device -> host copy (one transfer)
+    return host, meta
+
+
+def rebuild_jax_array(payload: Tuple):
+    """Rematerialize on the consumer: same named sharding when the
+    local device count allows, else default placement."""
+    host, meta = payload
+    import jax
+    import numpy as np
+
+    if meta is not None and meta[0] == "named":
+        _, axis_names, mesh_shape, spec = meta
+        need = int(np.prod(mesh_shape))
+        devs = jax.devices()
+        if len(devs) >= need:
+            mesh = jax.sharding.Mesh(
+                np.asarray(devs[:need]).reshape(mesh_shape), axis_names)
+            sharding = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(*spec))
+            return jax.device_put(host, sharding)
+    return jax.device_put(host)

@@ -22,6 +22,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 import cloudpickle
 
+from ray_tpu._private import device_objects
+
 
 @dataclass
 class SerializedValue:
@@ -70,6 +72,9 @@ class SerializationContext:
         )
 
         custom = self._custom_serializers
+        # delegate to cloudpickle's own reducer_override — it is how
+        # local functions/classes get pickled; shadowing it breaks them
+        base = pickler.reducer_override
 
         def reducer_override(obj):
             if isinstance(obj, ObjectRef):
@@ -79,7 +84,14 @@ class SerializationContext:
             if ser is not None:
                 serializer, deserializer = ser
                 return (_apply_deserializer, (deserializer, serializer(obj)))
-            return NotImplemented
+            # device (HBM) objects: jax's own pickle reducer collapses
+            # NamedShardings to a single device — ours round-trips the
+            # sharding meta so the consumer rematerializes on an
+            # equivalent mesh (_private/device_objects.py)
+            if device_objects.is_jax_array(obj):
+                return (device_objects.rebuild_jax_array,
+                        (device_objects.reduce_jax_array(obj),))
+            return base(obj)
 
         pickler.reducer_override = reducer_override
         pickler.dump(value)

@@ -142,7 +142,8 @@ def _dump_exc(e: BaseException) -> bytes:
 
 
 def _safe_dumps(value: Any) -> bytes:
-    return cloudpickle.dumps(value)
+    from ray_tpu._private.device_objects import wire_dumps
+    return wire_dumps(value)   # sharding-preserving jax wire format
 
 
 class _GeneratorStateProxy:
@@ -933,7 +934,7 @@ class WorkerClient:
             else:
                 value = self._core_dispatch(msg)
                 reply = {"op": "reply", "for": msg["id"], "ok": True,
-                         "value": cloudpickle.dumps(value)}
+                         "value": _safe_dumps(value)}
         except BaseException as e:  # noqa: BLE001 — shipped back
             try:
                 blob = cloudpickle.dumps(e)
@@ -1466,7 +1467,8 @@ class ProcessRouter:
             from ray_tpu import exceptions as exc
             raise exc.ActorDiedError(spec.actor_id,
                                      "actor worker process died")
-        args_blob = cloudpickle.dumps((args, kwargs))
+        from ray_tpu._private.device_objects import wire_dumps
+        args_blob = wire_dumps((args, kwargs))   # device args over wire
         try:
             return client.call_method(spec, node, args_blob)
         except WorkerCrashed as e:

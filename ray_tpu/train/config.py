@@ -31,6 +31,11 @@ class ScalingConfig:
     # max hardware (reference: train v2 scaling_policy.py; see
     # ray_tpu/train/scaling_policy.py)
     elastic: Optional[tuple] = None
+    # bound worker-group placement: a gang that cannot place within this
+    # window FAILS the attempt (counts against FailureConfig) instead of
+    # hanging. None = wait forever (fixed-size default); elastic runs
+    # default to 120s in the trainer.
+    placement_timeout_s: Optional[float] = None
 
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker:

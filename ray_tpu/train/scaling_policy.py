@@ -60,23 +60,32 @@ class ElasticScalingPolicy(ScalingPolicy):
     the latest checkpoint instead of waiting for replacement hardware.
 
     ``wait_s``: how long to wait for capacity >= min_workers before
-    giving the trainer a group it can still not place (whose failure
-    then counts against FailureConfig).
+    handing the trainer a group it may still not place. The trainer
+    bounds group placement with ScalingConfig.placement_timeout_s
+    (elastic default 120s) so an unplaceable group FAILS and counts
+    against FailureConfig instead of hanging forever.
     """
 
     def __init__(self, min_workers: int, max_workers: int,
-                 wait_s: float = 10.0, poll_interval_s: float = 0.25):
+                 wait_s: float = 10.0, poll_interval_s: float = 0.25,
+                 initial_workers: Optional[int] = None):
         if not 1 <= min_workers <= max_workers:
             raise ValueError(
                 f"need 1 <= min_workers <= max_workers, got "
                 f"[{min_workers}, {max_workers}]")
+        if initial_workers is not None and not (
+                min_workers <= initial_workers <= max_workers):
+            raise ValueError(
+                f"initial_workers={initial_workers} outside "
+                f"[{min_workers}, {max_workers}]")
         self.min_workers = min_workers
         self.max_workers = max_workers
+        self.initial_workers = initial_workers
         self.wait_s = wait_s
         self.poll_interval_s = poll_interval_s
 
     def initial_size(self) -> int:
-        return self.max_workers
+        return self.initial_workers or self.max_workers
 
     def _placeable_workers(self, resources_per_worker) -> int:
         import ray_tpu
@@ -103,11 +112,14 @@ class ElasticScalingPolicy(ScalingPolicy):
 def resolve_policy(scaling_config,
                    policy: Optional[ScalingPolicy]) -> ScalingPolicy:
     """Explicit policy wins; ``ScalingConfig(elastic=(min, max))``
-    builds an elastic one; otherwise fixed at num_workers."""
+    builds an elastic one (starting at num_workers when it falls in the
+    range, else at max); otherwise fixed at num_workers."""
     if policy is not None:
         return policy
     elastic = getattr(scaling_config, "elastic", None)
     if elastic:
         lo, hi = elastic
-        return ElasticScalingPolicy(lo, hi)
+        n = scaling_config.num_workers
+        return ElasticScalingPolicy(
+            lo, hi, initial_workers=n if lo <= n <= hi else None)
     return FixedScalingPolicy(scaling_config.num_workers)

@@ -75,7 +75,7 @@ class JaxTrainer:
         from ray_tpu.train.callbacks import invoke as _cb
         emit_export("TRAIN_RUN", name=self.run_config.name or "train_run",
                     state="RUNNING",
-                    num_workers=self.scaling.num_workers)
+                    num_workers=self.scaling_policy.initial_size())
         path = self.run_config.resolved_storage_path()
         _cb(self.run_config.callbacks, "on_run_start",
             self.run_config.name or "train_run", self.train_loop_config)
@@ -92,12 +92,30 @@ class JaxTrainer:
         max_failures = self.run_config.failure_config.max_failures
         error: Optional[str] = None
 
+        from ray_tpu.train.scaling_policy import ElasticScalingPolicy
+        placement_timeout = self.scaling.placement_timeout_s
+        if placement_timeout is None and isinstance(
+                self.scaling_policy, ElasticScalingPolicy):
+            # elastic promises failure-not-hang for unplaceable gangs
+            placement_timeout = 120.0
         world_size = self.scaling_policy.initial_size()
         while True:
-            group = WorkerGroup(
-                world_size, self.scaling.worker_resources(),
-                placement_strategy=self.scaling.placement_strategy,
-                experiment_name=self.run_config.name or "train_run")
+            try:
+                group = WorkerGroup(
+                    world_size, self.scaling.worker_resources(),
+                    placement_strategy=self.scaling.placement_strategy,
+                    experiment_name=self.run_config.name or "train_run",
+                    placement_timeout_s=placement_timeout)
+            except Exception as e:
+                failures += 1
+                if max_failures >= 0 and failures > max_failures:
+                    error = f"worker group unplaceable: {e!r}"
+                    break
+                decision = self.scaling_policy.on_recovery(
+                    world_size, self.scaling.worker_resources(),
+                    failures)
+                world_size = decision.num_workers
+                continue
             shards = self._split_datasets(world_size)
             run_refs = group.start_run(
                 self.train_loop, self.train_loop_config,

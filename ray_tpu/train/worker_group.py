@@ -73,13 +73,23 @@ class _TrainWorker:
 class WorkerGroup:
     def __init__(self, num_workers: int, resources_per_worker: Dict,
                  placement_strategy: str = "PACK",
-                 experiment_name: str = ""):
+                 experiment_name: str = "",
+                 placement_timeout_s: Optional[float] = None):
         self.num_workers = num_workers
         self.experiment_name = experiment_name
         self.pg = placement_group(
             [dict(resources_per_worker) for _ in range(num_workers)],
             strategy=placement_strategy)
-        ray_tpu.get(self.pg.ready())
+        try:
+            ray_tpu.get(self.pg.ready(), timeout=placement_timeout_s)
+        except Exception:
+            # unplaceable gang: release the pending PG request so the
+            # caller's retry (possibly at a smaller size) starts clean
+            try:
+                remove_placement_group(self.pg)
+            except Exception:
+                pass
+            raise
         worker_cls = ray_tpu.remote(_TrainWorker)
         from ray_tpu._private.task_spec import PlacementGroupSchedulingStrategy
         self.workers = [

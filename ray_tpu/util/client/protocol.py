@@ -13,7 +13,8 @@ MAX_FRAME = 1 << 34
 
 
 def send_msg(sock: socket.socket, obj: Any) -> None:
-    payload = cloudpickle.dumps(obj)
+    from ray_tpu._private.device_objects import wire_dumps
+    payload = wire_dumps(obj)   # sharding-preserving jax wire format
     sock.sendall(_HDR.pack(len(payload)) + payload)
 
 

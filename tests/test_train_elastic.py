@@ -43,6 +43,25 @@ def test_elastic_policy_clamps(ray_start_regular):
     assert d.num_workers == 1
 
 
+def test_unplaceable_gang_fails_not_hangs(ray_start_regular, tmp_path):
+    """A gang the cluster can never place must FAIL within the
+    placement timeout and count against FailureConfig — not hang in
+    pg.ready() forever."""
+    def train_fn(config):
+        train.report({"x": 1})
+
+    res = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(
+            num_workers=2, resources_per_worker={"CPU": 9.0},
+            placement_timeout_s=3.0),
+        run_config=RunConfig(
+            name="noplace", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1))).fit()
+    assert res.error is not None
+    assert "unplaceable" in res.error
+
+
 def test_elastic_resize_on_worker_failure(ray_start_regular, tmp_path):
     """Kill 1 of 2 workers mid-run: the gang fails, the policy re-forms
     at the surviving world=1, and training completes from the latest
