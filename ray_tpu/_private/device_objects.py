@@ -23,6 +23,12 @@ import io
 from typing import Any, Optional, Tuple
 
 
+def jax_reduce(obj) -> Tuple:
+    """The (callable, args) reduce tuple for a jax.Array — the single
+    definition shared by every ray_tpu pickler."""
+    return (rebuild_jax_array, (reduce_jax_array(obj),))
+
+
 def wire_dumps(value: Any) -> bytes:
     """cloudpickle.dumps with the sharding-preserving jax.Array reducer,
     SCOPED to this pickler only. Never touches copyreg's process-global
@@ -39,7 +45,7 @@ def wire_dumps(value: Any) -> bytes:
 
     def reducer_override(obj):
         if is_jax_array(obj):
-            return (rebuild_jax_array, (reduce_jax_array(obj),))
+            return jax_reduce(obj)
         return base(obj)
 
     pickler.reducer_override = reducer_override

@@ -89,8 +89,7 @@ class SerializationContext:
             # sharding meta so the consumer rematerializes on an
             # equivalent mesh (_private/device_objects.py)
             if device_objects.is_jax_array(obj):
-                return (device_objects.rebuild_jax_array,
-                        (device_objects.reduce_jax_array(obj),))
+                return device_objects.jax_reduce(obj)
             return base(obj)
 
         pickler.reducer_override = reducer_override

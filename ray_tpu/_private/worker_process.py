@@ -308,9 +308,10 @@ class _WorkerState:
         slot = [ev, True, None]
         with self._pending_lock:
             self._pending[rid] = slot
+        from ray_tpu._private.device_objects import wire_dumps
         self.send({"op": "core", "id": rid, "call": call,
                    "task": getattr(_current_rid, "rid", None),
-                   "payload": cloudpickle.dumps(kw)})
+                   "payload": wire_dumps(kw)})   # device args preserved
         ev.wait()
         if slot[1]:
             return slot[2]

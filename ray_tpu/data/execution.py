@@ -277,10 +277,6 @@ def _split_task(block: Block, n: int):
     return out if n > 1 else out[0]
 
 
-def _concat_task(*blocks: Block) -> Block:
-    return concat_blocks(blocks)
-
-
 def _sort_block_task(block: Block, key: str, descending: bool) -> Block:
     return block.sort_by([(key, "descending" if descending
                            else "ascending")])
@@ -341,34 +337,42 @@ def _shuffle_reduce_task(seed, part_idx, *blocks: Block) -> Block:
     return block.take(rng.permutation(block.num_rows))
 
 
+def _fin_concat(shards: Dict) -> Block:
+    return concat_blocks(shards.get("d", []))
+
+
+def _fin_shuffle(shards: Dict, seed, part_idx: int) -> Block:
+    return _shuffle_reduce_task(seed, part_idx, *shards.get("d", []))
+
+
+def _fin_sort(shards: Dict, key: str, descending: bool) -> Block:
+    return _sort_block_task(concat_blocks(shards.get("d", [])),
+                            key, descending)
+
+
 def run_all_to_all(op: L.AllToAll, block_refs: List[Any]) -> List[Any]:
-    """Execute a materializing all-to-all over already-computed blocks."""
+    """Execute an all-to-all over already-computed blocks: partition
+    shards stream into stateful aggregator actors as they land
+    (data/hash_shuffle.py), no barrier reduce with O(blocks) args."""
+    from ray_tpu.data.hash_shuffle import run_streaming_shuffle
     if not block_refs:
         return []
     n_out = op.num_outputs or len(block_refs)
     n_out = max(1, n_out)
-    split = ray_tpu.remote(_split_task)
-    concat = ray_tpu.remote(_concat_task)
 
     if op.kind == "repartition":
-        parts = [split.options(num_returns=n_out).remote(r, n_out)
-                 for r in block_refs]
-        parts = [p if isinstance(p, list) else [p] for p in parts]
-        return [concat.remote(*[parts[i][j] for i in range(len(parts))])
-                for j in range(n_out)]
+        return run_streaming_shuffle(
+            [("d", block_refs, _split_task, (n_out,))], n_out,
+            _fin_concat, lambda p: ())
 
     if op.kind == "shuffle":
-        perm = ray_tpu.remote(_perm_partition_task)
-        reduce = ray_tpu.remote(_shuffle_reduce_task)
-        parts = [perm.options(num_returns=n_out).remote(r, n_out, op.seed)
-                 for r in block_refs]
-        parts = [p if isinstance(p, list) else [p] for p in parts]
-        return [reduce.remote(op.seed, j,
-                              *[parts[i][j] for i in range(len(parts))])
-                for j in range(n_out)]
+        return run_streaming_shuffle(
+            [("d", block_refs, _perm_partition_task, (n_out, op.seed))],
+            n_out, _fin_shuffle, lambda p: (op.seed, p))
 
     if op.kind == "sort":
-        # Sample → pick boundaries → range partition → per-partition sort.
+        # Sample → pick boundaries → stream range partitions into
+        # per-range aggregators that sort on finalize.
         blocks = ray_tpu.get(list(block_refs))
         col = np.concatenate([
             b.column(op.key).to_numpy(zero_copy_only=False)
@@ -377,19 +381,12 @@ def run_all_to_all(op: L.AllToAll, block_refs: List[Any]) -> List[Any]:
             return block_refs
         quantiles = np.linspace(0, 1, n_out + 1)[1:-1]
         bounds = list(np.quantile(col, quantiles, method="nearest"))
-        rp = ray_tpu.remote(_range_partition_task)
-        sb = ray_tpu.remote(_sort_block_task)
         nparts = len(bounds) + 1
-        parts = [rp.options(num_returns=nparts).remote(
-            r, op.key, bounds, op.descending) for r in block_refs]
-        parts = [p if isinstance(p, list) else [p] for p in parts]
-        out = []
-        order = (range(nparts - 1, -1, -1) if op.descending
-                 else range(nparts))
-        for j in order:
-            merged = concat.remote(*[parts[i][j] for i in range(len(parts))])
-            out.append(sb.remote(merged, op.key, op.descending))
-        return out
+        out = run_streaming_shuffle(
+            [("d", block_refs, _range_partition_task,
+              (op.key, bounds, op.descending))], nparts,
+            _fin_sort, lambda p: (op.key, op.descending))
+        return out[::-1] if op.descending else out
 
     raise ValueError(f"unknown all-to-all kind {op.kind!r}")
 
@@ -435,52 +432,50 @@ def _join_partition_task(key: str, how: str, n_left: int,
                      right_suffix="_r")
 
 
+def _fin_join(shards: Dict, key: str, how: str) -> Block:
+    left = shards.get("l", [])
+    right = shards.get("r", [])
+    return _join_partition_task(key, how, len(left), *left, *right)
+
+
+def _fin_agg(shards: Dict, key, aggs, map_groups_fn,
+             batch_format) -> Block:
+    return _agg_partition_task(key, aggs, map_groups_fn, batch_format,
+                               *shards.get("d", []))
+
+
 def run_join(key: str, how: str, left_refs: List[Any],
              right_refs: List[Any],
              num_partitions: Optional[int] = None) -> List[Any]:
-    """Hash join (reference: `data/_internal/execution/operators/join.py`
-    — hash-partition both sides to aggregator partitions, join each)."""
+    """Streaming hash join (reference: `data/_internal/execution/
+    operators/join.py` — both sides hash-partition into the SAME
+    aggregator actors, tagged, each partition joined on finalize)."""
+    from ray_tpu.data.hash_shuffle import run_streaming_shuffle
     nparts = num_partitions or max(1, min(
         8, max(len(left_refs), len(right_refs))))
-    hp = ray_tpu.remote(_hash_partition_task)
-    jn = ray_tpu.remote(_join_partition_task)
-
-    def scatter(refs):
-        parts = [hp.options(num_returns=nparts).remote(r, key, nparts)
-                 for r in refs]
-        return [p if isinstance(p, list) else [p] for p in parts]
-
-    lparts = scatter(left_refs)
-    rparts = scatter(right_refs)
-    out = []
-    for j in range(nparts):
-        lcol = [lparts[i][j] for i in range(len(lparts))]
-        rcol = [rparts[i][j] for i in range(len(rparts))]
-        out.append(jn.remote(key, how, len(lcol), *lcol, *rcol))
-    return out
+    return run_streaming_shuffle(
+        [("l", left_refs, _hash_partition_task, (key, nparts)),
+         ("r", right_refs, _hash_partition_task, (key, nparts))],
+        nparts, _fin_join, lambda p: (key, how))
 
 
 def run_aggregate(op: L.Aggregate, block_refs: List[Any],
                   num_partitions: Optional[int] = None) -> List[Any]:
-    """Hash-shuffle aggregation (reference: SURVEY.md §8.7 —
-    `hash_shuffle.py` partition/streams → stateful aggregators)."""
+    """Streaming hash-shuffle aggregation (reference: SURVEY.md §8.7 —
+    `hash_shuffle.py` partition shards stream into stateful
+    aggregators that reduce on finalize)."""
+    from ray_tpu.data.hash_shuffle import run_streaming_shuffle
     if not block_refs:
         return []
     if op.key is None:
-        nparts = 1
-        parts = [[r] for r in block_refs]
         agg = ray_tpu.remote(_agg_partition_task)
         return [agg.remote(None, op.aggs, op.map_groups_fn, op.batch_format,
                            *block_refs)]
     nparts = num_partitions or min(len(block_refs), 8)
-    hp = ray_tpu.remote(_hash_partition_task)
-    agg = ray_tpu.remote(_agg_partition_task)
-    parts = [hp.options(num_returns=nparts).remote(r, op.key, nparts)
-             for r in block_refs]
-    parts = [p if isinstance(p, list) else [p] for p in parts]
-    return [agg.remote(op.key, op.aggs, op.map_groups_fn, op.batch_format,
-                       *[parts[i][j] for i in range(len(parts))])
-            for j in range(nparts)]
+    return run_streaming_shuffle(
+        [("d", block_refs, _hash_partition_task, (op.key, nparts))],
+        nparts, _fin_agg,
+        lambda p: (op.key, op.aggs, op.map_groups_fn, op.batch_format))
 
 
 # ---------------------------------------------------------------------------

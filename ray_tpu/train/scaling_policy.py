@@ -120,6 +120,10 @@ def resolve_policy(scaling_config,
     if elastic:
         lo, hi = elastic
         n = scaling_config.num_workers
+        # num_workers=1 is the dataclass default, i.e. "unset" — an
+        # elastic run then starts at max. An explicit initial size of 1
+        # is still expressible via ElasticScalingPolicy(initial_workers=1).
+        explicit = n != 1 and lo <= n <= hi
         return ElasticScalingPolicy(
-            lo, hi, initial_workers=n if lo <= n <= hi else None)
+            lo, hi, initial_workers=n if explicit else None)
     return FixedScalingPolicy(scaling_config.num_workers)

@@ -92,6 +92,7 @@ class JaxTrainer:
         max_failures = self.run_config.failure_config.max_failures
         error: Optional[str] = None
 
+        from ray_tpu import exceptions as _exc
         from ray_tpu.train.scaling_policy import ElasticScalingPolicy
         placement_timeout = self.scaling.placement_timeout_s
         if placement_timeout is None and isinstance(
@@ -106,7 +107,9 @@ class JaxTrainer:
                     placement_strategy=self.scaling.placement_strategy,
                     experiment_name=self.run_config.name or "train_run",
                     placement_timeout_s=placement_timeout)
-            except Exception as e:
+            except _exc.GetTimeoutError as e:
+                # placement timed out — everything else (actor-creation
+                # bugs etc.) propagates to the caller as before
                 failures += 1
                 if max_failures >= 0 and failures > max_failures:
                     error = f"worker group unplaceable: {e!r}"

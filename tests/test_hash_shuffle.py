@@ -1,0 +1,102 @@
+"""Streaming hash shuffle: aggregator actors, tagged sides, reaping.
+
+Reference capability: `python/ray/data/_internal/execution/operators/
+hash_shuffle.py:339` (stateful aggregating actors fed by streaming
+partition shards).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.data.block import block_from_rows, concat_blocks
+from ray_tpu.data.hash_shuffle import run_streaming_shuffle
+
+
+def _mk_block(vals):
+    return block_from_rows([{"v": int(v)} for v in vals])
+
+
+def _mod_partition(block, n):
+    col = block.column("v").to_numpy(zero_copy_only=False)
+    out = [block.take(np.nonzero(col % n == p)[0]) for p in range(n)]
+    return out if n > 1 else out[0]
+
+
+def _slow_mod_partition(block, n, delay_s):
+    time.sleep(delay_s)
+    return _mod_partition(block, n)
+
+
+def _fin_rows(shards):
+    return concat_blocks(shards.get("d", []))
+
+
+def test_streaming_shuffle_partitions_correctly(ray_start_regular):
+    refs = [ray_tpu.put(_mk_block(range(i * 10, i * 10 + 10)))
+            for i in range(4)]
+    outs = run_streaming_shuffle([("d", refs, _mod_partition, (3,))],
+                                 3, _fin_rows, lambda p: ())
+    blocks = ray_tpu.get(outs, timeout=120)
+    for p, b in enumerate(blocks):
+        vals = b.column("v").to_numpy(zero_copy_only=False)
+        assert all(v % 3 == p for v in vals)
+    total = sum(b.num_rows for b in blocks)
+    assert total == 40
+
+
+def test_streaming_shuffle_staggered_maps(ray_start_regular):
+    """Finalize must wait for every shard even when partition tasks
+    finish wildly out of order (explicit dataflow deps, not actor
+    submission order)."""
+    refs = [ray_tpu.put(_mk_block(range(i * 8, i * 8 + 8)))
+            for i in range(5)]
+    delays = [0.4, 0.0, 0.3, 0.05, 0.2]
+    sides = [("d", [r], _slow_mod_partition, (2, d))
+             for r, d in zip(refs, delays)]
+    outs = run_streaming_shuffle(sides, 2, _fin_rows, lambda p: ())
+    blocks = ray_tpu.get(outs, timeout=120)
+    assert sum(b.num_rows for b in blocks) == 40
+    evens = blocks[0].column("v").to_numpy(zero_copy_only=False)
+    assert len(evens) == 20 and all(v % 2 == 0 for v in evens)
+
+
+def test_streaming_shuffle_two_tagged_sides(ray_start_regular):
+    """Join-style: two sides land in the same aggregators, separated
+    by tag at finalize."""
+    left = [ray_tpu.put(_mk_block([0, 1, 2, 3]))]
+    right = [ray_tpu.put(_mk_block([2, 3, 4, 5]))]
+
+    def fin(shards):
+        l = concat_blocks(shards.get("l", []))
+        r = concat_blocks(shards.get("r", []))
+        return {"left": l.num_rows, "right": r.num_rows}
+
+    outs = run_streaming_shuffle(
+        [("l", left, _mod_partition, (2,)),
+         ("r", right, _mod_partition, (2,))],
+        2, fin, lambda p: ())
+    got = ray_tpu.get(outs, timeout=120)
+    assert got[0] == {"left": 2, "right": 2}   # evens both sides
+    assert got[1] == {"left": 2, "right": 2}
+
+
+def test_aggregator_actors_reaped(ray_start_regular):
+    """Once outputs materialize, the per-shuffle actors die."""
+    from ray_tpu.util.state.api import list_actors
+    refs = [ray_tpu.put(_mk_block(range(6)))]
+    before = len([a for a in list_actors()
+                  if a.get("state") == "ALIVE"])
+    outs = run_streaming_shuffle([("d", refs, _mod_partition, (2,))],
+                                 2, _fin_rows, lambda p: ())
+    ray_tpu.get(outs, timeout=120)
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        alive = len([a for a in list_actors()
+                     if a.get("state") == "ALIVE"])
+        if alive <= before:
+            break
+        time.sleep(0.2)
+    assert alive <= before, f"aggregators leaked: {alive} > {before}"
