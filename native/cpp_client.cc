@@ -13,7 +13,14 @@
 //   rtc_kv_put / rtc_kv_get            (head InternalKV)
 //   rtc_put_object / rtc_get_object    (daemon object table)
 //   rtc_ping                           (daemon_ping -> pid)
+//   rtc_submit_task                    (cross-language task by name)
+//   rtc_create_actor / rtc_call_actor  (cross-language Python actors)
 //   rtc_free                           (free buffers returned by _get)
+//
+// Cross-language calls reference functions/classes EXPORTED BY NAME from
+// Python (`ray_tpu.xlang.export_task` / `export_actor_class` -> head KV,
+// reference `cpp/include/ray/api.h` + `python/ray/cross_language.py`);
+// args and results are msgpack-plain values, no Python pickles.
 //
 // Build: `make` in native/ produces libray_tpu_cpp_client.so.
 
@@ -114,10 +121,69 @@ void pack_bool(std::string& out, bool v) {
 void pack_map_header(std::string& out, size_t n) {
   if (n < 16) {
     out.push_back(static_cast<char>(0x80 | n));
-  } else {
+  } else if (n <= 0xffff) {
     out.push_back(static_cast<char>(0xde));
     out.push_back(static_cast<char>(n >> 8));
     out.push_back(static_cast<char>(n));
+  } else {
+    out.push_back(static_cast<char>(0xdf));
+    for (int s = 24; s >= 0; s -= 8)
+      out.push_back(static_cast<char>(n >> s));
+  }
+}
+
+// Value -> msgpack bytes (results crossing back to the C caller).
+void pack_value(std::string& out, const Value& v) {
+  switch (v.kind) {
+    case Value::NIL: out.push_back(static_cast<char>(0xc0)); break;
+    case Value::BOOL: pack_bool(out, v.b); break;
+    case Value::INT:
+      if (v.i >= 0) {
+        pack_uint(out, static_cast<uint64_t>(v.i));
+      } else {
+        out.push_back(static_cast<char>(0xd3));
+        uint64_t u = static_cast<uint64_t>(v.i);
+        for (int s = 56; s >= 0; s -= 8)
+          out.push_back(static_cast<char>(u >> s));
+      }
+      break;
+    case Value::DBL: {
+      out.push_back(static_cast<char>(0xcb));
+      uint64_t u;
+      memcpy(&u, &v.d, 8);
+      for (int s = 56; s >= 0; s -= 8)
+        out.push_back(static_cast<char>(u >> s));
+      break;
+    }
+    case Value::STR: pack_str(out, v.s); break;
+    case Value::BIN:
+      pack_bin(out, reinterpret_cast<const uint8_t*>(v.s.data()),
+               v.s.size());
+      break;
+    case Value::ARR: {
+      size_t n = v.arr.size();
+      if (n < 16) {
+        out.push_back(static_cast<char>(0x90 | n));
+      } else if (n <= 0xffff) {
+        out.push_back(static_cast<char>(0xdc));
+        out.push_back(static_cast<char>(n >> 8));
+        out.push_back(static_cast<char>(n));
+      } else {
+        out.push_back(static_cast<char>(0xdd));
+        for (int s = 24; s >= 0; s -= 8)
+          out.push_back(static_cast<char>(n >> s));
+      }
+      for (const Value& e : v.arr) pack_value(out, e);
+      break;
+    }
+    case Value::MAP: {
+      pack_map_header(out, v.map.size());
+      for (const auto& kv : v.map) {
+        pack_str(out, kv.first);
+        pack_value(out, kv.second);
+      }
+      break;
+    }
   }
 }
 
@@ -407,6 +473,83 @@ int rtc_get_object(void* handle, const uint8_t* oid, int oid_len,
   if (blob == nullptr || blob->kind == Value::NIL) return 1;
   *out = dup_buffer(blob->s, out_len);
   return 0;
+}
+
+// -- cross-language tasks/actors (daemon) -----------------------------------
+
+namespace {  // shared reply handling for the xlang calls
+
+// 0 + *out(result msgpack) on ok; 1 + *out(error UTF-8) on app error;
+// -1 on transport error.
+int xlang_finish(Client* c, bool sent, const Value& reply,
+                 uint8_t** out, int64_t* out_len) {
+  if (!sent) return -1;
+  const Value* outcome = reply.get("outcome");
+  if (outcome != nullptr && outcome->s == "ok") {
+    const Value* result = reply.get("result");
+    std::string packed;
+    if (result != nullptr) {
+      pack_value(packed, *result);
+    } else {
+      packed.push_back(static_cast<char>(0xc0));  // nil
+    }
+    if (out != nullptr) *out = dup_buffer(packed, out_len);
+    return 0;
+  }
+  const Value* err = reply.get("error");
+  std::string e = err != nullptr ? err->s : "unknown xlang error";
+  c->last_error = e;
+  if (out != nullptr) *out = dup_buffer(e, out_len);
+  return 1;
+}
+
+}  // namespace
+
+int rtc_submit_task(void* handle, const char* name, const uint8_t* args,
+                    int args_len, uint8_t** out, int64_t* out_len) {
+  auto* c = static_cast<Client*>(handle);
+  std::string fields;
+  pack_str(fields, "name");
+  pack_str(fields, name);
+  pack_str(fields, "args");
+  fields.append(reinterpret_cast<const char*>(args),
+                static_cast<size_t>(args_len));  // pre-packed msgpack arr
+  Value reply;
+  bool sent = c->call("xlang_submit", fields, 2, &reply);
+  return xlang_finish(c, sent, reply, out, out_len);
+}
+
+int rtc_create_actor(void* handle, const char* cls, const char* name,
+                     const uint8_t* args, int args_len) {
+  auto* c = static_cast<Client*>(handle);
+  std::string fields;
+  pack_str(fields, "cls");
+  pack_str(fields, cls);
+  pack_str(fields, "name");
+  pack_str(fields, name);
+  pack_str(fields, "args");
+  fields.append(reinterpret_cast<const char*>(args),
+                static_cast<size_t>(args_len));
+  Value reply;
+  bool sent = c->call("xlang_create_actor", fields, 3, &reply);
+  return xlang_finish(c, sent, reply, nullptr, nullptr);
+}
+
+int rtc_call_actor(void* handle, const char* name, const char* method,
+                   const uint8_t* args, int args_len, uint8_t** out,
+                   int64_t* out_len) {
+  auto* c = static_cast<Client*>(handle);
+  std::string fields;
+  pack_str(fields, "name");
+  pack_str(fields, name);
+  pack_str(fields, "method");
+  pack_str(fields, method);
+  pack_str(fields, "args");
+  fields.append(reinterpret_cast<const char*>(args),
+                static_cast<size_t>(args_len));
+  Value reply;
+  bool sent = c->call("xlang_call_actor", fields, 3, &reply);
+  return xlang_finish(c, sent, reply, out, out_len);
 }
 
 // -- daemon ping ------------------------------------------------------------

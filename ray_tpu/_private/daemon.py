@@ -43,6 +43,7 @@ import cloudpickle
 from ray_tpu._private import rpc
 from ray_tpu._private.head import HeadClient, _hb_interval
 from ray_tpu._private.ids import ActorID, NodeID, TaskID
+from ray_tpu._private.task_spec import TaskKind, TaskSpec
 from ray_tpu._private.rpc import Client, Connection, Server, declare
 
 INLINE_RESULT = 100 * 1024  # reference: max_direct_call_object_size
@@ -68,6 +69,12 @@ declare("release_object", "oid")
 declare("free_objects", "oids")
 declare("pull_object", "oid", "from_addr", "priority")
 declare("daemon_ping")
+# cross-language tier (C++ clients): names resolve through the head KV,
+# args/results are plain msgpack values — no Python pickles cross the
+# language boundary (reference: ray cross_language function descriptors)
+declare("xlang_submit", "name", "args")
+declare("xlang_create_actor", "cls", "name", "args")
+declare("xlang_call_actor", "name", "method", "args")
 declare("daemon_stop")
 declare("daemon_stats")
 declare("core_op", "call", "payload", "task")
@@ -393,6 +400,10 @@ class DaemonService:
         self._task_rids: Dict[str, Tuple[Any, str]] = {}
         self._bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self._peers: Dict[Tuple[str, int], Client] = {}
+        # cross-language actors: name -> [actor_id, seqno]
+        self._xlang_actors: Dict[str, list] = {}
+        self.head_addr = None            # set by main() in daemon mode
+        self._xlang_head_client = None
         # Task bodies block on worker IPC, so the pool is sized well past
         # core count; reusing threads beats per-task spawn under GIL
         # contention (reference: raylet dispatches from its event loop).
@@ -874,6 +885,198 @@ class DaemonService:
             return {"missing": True}
         return {"blob": blob}
 
+    # -- cross-language tier (C++ API) ------------------------------------
+    # Reference capability: `cpp/include/ray/api.h` task/actor submission
+    # + `python/ray/cross_language.py` descriptors. Functions/classes are
+    # exported by NAME to the head KV from Python
+    # (`ray_tpu.xlang.export_task/export_actor_class`); C++ submits by
+    # name with msgpack args; execution happens on this daemon's pooled
+    # worker processes; results return as plain msgpack values.
+
+    def _xlang_head(self):
+        with self._lock:
+            if getattr(self, "_xlang_head_client", None) is None:
+                if getattr(self, "head_addr", None) is None:
+                    raise RuntimeError("daemon has no head address")
+                self._xlang_head_client = HeadClient(self.head_addr)
+            return self._xlang_head_client
+
+    @staticmethod
+    def _xlang_plain(value):
+        """Results crossing the language boundary must be msgpack-plain."""
+        import numpy as _np
+        if isinstance(value, (_np.integer,)):
+            return int(value)
+        if isinstance(value, (_np.floating,)):
+            return float(value)
+        if isinstance(value, _np.ndarray):
+            return value.tolist()
+        if isinstance(value, (list, tuple)):
+            return [DaemonService._xlang_plain(v) for v in value]
+        if isinstance(value, dict):
+            return {str(k): DaemonService._xlang_plain(v)
+                    for k, v in value.items()}
+        if value is None or isinstance(value, (bool, int, float, str,
+                                               bytes)):
+            return value
+        raise TypeError(
+            f"xlang result of type {type(value).__name__} cannot cross "
+            f"the language boundary; return msgpack-plain values")
+
+    def _xlang_kv_blob(self, kind: str, name: str):
+        return self._xlang_head().kv_get(
+            f"xlang:{kind}:{name}".encode())
+
+    def handle_xlang_submit(self, conn, rid, msg):
+        """One-shot cross-language task on a pooled worker."""
+        def run():
+            from ray_tpu._private import worker_process as wp
+            client = None
+            streaming = False
+            try:
+                blob = self._xlang_kv_blob("fn", msg["name"])
+                if blob is None:
+                    conn.reply(rid, outcome="err",
+                               error=f"no exported xlang function "
+                                     f"{msg['name']!r}")
+                    return
+                fid = wp.register_function_blob(blob)
+                spec = TaskSpec(
+                    task_id=TaskID.from_random(), kind=TaskKind.NORMAL,
+                    name=f"xlang:{msg['name']}", func=None)
+                args_blob = cloudpickle.dumps((tuple(msg["args"]), {}))
+                client = wp.acquire_worker()
+                # pooled workers may carry raw_outcomes=True from a
+                # prior driver-relay task — this handler decodes locally
+                client.raw_outcomes = False
+                client.runtime = self.runtime
+                client.node = self.node_stub
+                outcome = client.execute_task(spec, self.node_stub, fid,
+                                              args_blob)
+                if outcome[0] == "ok":
+                    conn.reply(rid, outcome="ok",
+                               result=self._xlang_plain(outcome[1]))
+                elif outcome[0] == "gen":
+                    # the worker is mid-stream: it must NOT return to
+                    # the idle pool while still producing
+                    streaming = True
+                    conn.reply(rid, outcome="err",
+                               error="xlang tasks cannot stream")
+                else:
+                    conn.reply(rid, outcome="err",
+                               error=repr(outcome[1]))
+            except BaseException as e:  # noqa: BLE001 — shipped back
+                conn.reply(rid, outcome="err", error=repr(e))
+            finally:
+                if client is not None:
+                    from ray_tpu._private import worker_process as wp
+                    if streaming:
+                        client.kill(expected=True)
+                    wp.release_worker(client)   # reaps killed workers
+
+        self._task_pool.submit(run)
+        return rpc.HOLD
+
+    def handle_xlang_create_actor(self, conn, rid, msg):
+        """Create a Python actor (class exported by name) on a pooled
+        worker, addressable by ``msg['name']`` for xlang_call_actor.
+        Reuses ProcessRouter.create_actor — one copy of the checkout/
+        registration protocol."""
+        def run():
+            from ray_tpu._private import worker_process as wp
+            try:
+                with self._lock:
+                    if msg["name"] in self._xlang_actors:
+                        conn.reply(rid, outcome="err",
+                                   error=f"xlang actor name "
+                                         f"{msg['name']!r} already taken")
+                        return
+                blob = self._xlang_kv_blob("actor", msg["cls"])
+                if blob is None:
+                    conn.reply(rid, outcome="err",
+                               error=f"no exported xlang actor class "
+                                     f"{msg['cls']!r}")
+                    return
+                fid = wp.register_function_blob(blob)
+                spec = TaskSpec(
+                    task_id=TaskID.from_random(),
+                    kind=TaskKind.ACTOR_CREATION,
+                    name=f"xlang:{msg['cls']}", func=None,
+                    actor_id=ActorID.from_random(),
+                    actor_name=msg["name"])
+                args_blob = cloudpickle.dumps((tuple(msg["args"]), {}))
+                router = self.runtime.process_router
+                router.create_actor(spec, self.node_stub,
+                                    (fid, args_blob))
+                with self._lock:
+                    if msg["name"] in self._xlang_actors:
+                        # lost a concurrent create race: kill ours
+                        with router._lock:
+                            dup = router._actor_workers.pop(
+                                spec.actor_id, None)
+                        if dup is not None:
+                            dup.kill(expected=True)
+                        conn.reply(rid, outcome="err",
+                                   error=f"xlang actor name "
+                                         f"{msg['name']!r} already taken")
+                        return
+                    self._xlang_actors[msg["name"]] = [spec.actor_id, 0]
+                conn.reply(rid, outcome="ok",
+                           actor_id=spec.actor_id.hex())
+            except BaseException as e:  # noqa: BLE001 — shipped back
+                conn.reply(rid, outcome="err", error=repr(e))
+
+        self._task_pool.submit(run)
+        return rpc.HOLD
+
+    def handle_xlang_call_actor(self, conn, rid, msg):
+        with self._lock:
+            entry = self._xlang_actors.get(msg["name"])
+        if entry is None:
+            return {"outcome": "err",
+                    "error": f"no xlang actor named {msg['name']!r}"}
+        actor_id, _ = entry
+        router = self.runtime.process_router
+        with router._lock:
+            client = router._actor_workers.get(actor_id)
+        if client is None or client.dead:
+            return {"outcome": "err", "error": "actor is dead"}
+
+        def run():
+            try:
+                with self._lock:
+                    entry[1] += 1
+                    seqno = entry[1]
+                spec = TaskSpec(
+                    task_id=TaskID.from_random(),
+                    kind=TaskKind.ACTOR_TASK,
+                    name=f"xlang:{msg['name']}.{msg['method']}",
+                    func=msg["method"], actor_id=actor_id,
+                    method_name=msg["method"], seqno=seqno)
+                args_blob = cloudpickle.dumps((tuple(msg["args"]), {}))
+                outcome = client.call_method(spec, self.node_stub,
+                                             args_blob)
+                # router-created actor workers run non-raw by default,
+                # but tolerate raw blobs (same-language daemon decodes)
+                if outcome[0] in ("ok", "ok_raw"):
+                    value = (cloudpickle.loads(outcome[1])
+                             if outcome[0] == "ok_raw" else outcome[1])
+                    conn.reply(rid, outcome="ok",
+                               result=self._xlang_plain(value))
+                elif outcome[0] == "err_raw":
+                    e, _tb = cloudpickle.loads(outcome[1])
+                    conn.reply(rid, outcome="err", error=repr(e))
+                elif outcome[0] == "err":
+                    conn.reply(rid, outcome="err", error=repr(outcome[1]))
+                else:
+                    conn.reply(rid, outcome="err",
+                               error=f"unsupported outcome {outcome[0]}")
+            except BaseException as e:  # noqa: BLE001 — shipped back
+                conn.reply(rid, outcome="err", error=repr(e))
+
+        self._task_pool.submit(run)
+        return rpc.HOLD
+
     # -- misc -------------------------------------------------------------
     def handle_core_release(self, conn, rid, msg):
         return {"ok": True}  # owner-side holds are driver-local
@@ -929,6 +1132,7 @@ def main() -> None:
 
     head_host, head_port = args.head.rsplit(":", 1)
     head_addr = (head_host, int(head_port))
+    service.head_addr = head_addr       # cross-language KV lookups
     labels = json.loads(args.labels)
     head = HeadClient(head_addr)
     head.register_node(args.node_id, resources, labels, server.addr)

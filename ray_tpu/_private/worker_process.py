@@ -117,6 +117,27 @@ def export_function(fn) -> Tuple[str, bytes]:
     return entry
 
 
+def _local_fn_blob(msg) -> Optional[bytes]:
+    """The blob for a worker fetch_function core op, if this process's
+    own table has it (payload is the pickled kw dict)."""
+    try:
+        kw = cloudpickle.loads(msg["payload"])
+        with _FN_TABLE_LOCK:
+            return _FN_TABLE.get(kw.get("fid"))
+    except Exception:
+        return None
+
+
+def register_function_blob(blob: bytes) -> str:
+    """Register an ALREADY-pickled callable (e.g. fetched from the head
+    KV by the cross-language tier) so pool workers can fetch it by id."""
+    fid = hashlib.sha1(blob).hexdigest()
+    with _FN_TABLE_LOCK:
+        _FN_TABLE[fid] = blob
+        _FN_REFS[fid] = _FN_REFS.get(fid, 0) + 1
+    return fid
+
+
 def fetch_function_blob(fid: str) -> bytes:
     with _FN_TABLE_LOCK:
         blob = _FN_TABLE.get(fid)
@@ -926,7 +947,17 @@ class WorkerClient:
     def _serve_core(self, msg: Dict[str, Any]) -> None:
         try:
             forward = getattr(self.runtime, "forward_core_op", None)
-            if forward is not None:
+            local_fn = (_local_fn_blob(msg)
+                        if (forward is not None
+                            and msg.get("call") == "fetch_function")
+                        else None)
+            if local_fn is not None:
+                # function blobs are content-addressed (sha1 fid): serve
+                # from this process's table when present — xlang fids
+                # only exist here, and it skips a driver round trip
+                reply = {"op": "reply", "for": msg["id"], "ok": True,
+                         "value": cloudpickle.dumps(local_fn)}
+            elif forward is not None:
                 # Daemon mode: raw round-trip to the owner (driver); the
                 # blob is already pickled at the owner's edge.
                 ok, blob = forward(msg)

@@ -52,6 +52,21 @@ def _load():
                                        ctypes.POINTER(ctypes.c_int64)]
         lib.rtc_ping.restype = ctypes.c_long
         lib.rtc_ping.argtypes = [ctypes.c_void_p]
+        lib.rtc_submit_task.restype = ctypes.c_int
+        lib.rtc_submit_task.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_char_p, ctypes.c_int,
+                                        ctypes.POINTER(u8p),
+                                        ctypes.POINTER(ctypes.c_int64)]
+        lib.rtc_create_actor.restype = ctypes.c_int
+        lib.rtc_create_actor.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_char_p, ctypes.c_char_p,
+                                         ctypes.c_int]
+        lib.rtc_call_actor.restype = ctypes.c_int
+        lib.rtc_call_actor.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_char_p, ctypes.c_char_p,
+                                       ctypes.c_int,
+                                       ctypes.POINTER(u8p),
+                                       ctypes.POINTER(ctypes.c_int64)]
         lib.rtc_last_error.restype = ctypes.c_char_p
         lib.rtc_last_error.argtypes = [ctypes.c_void_p]
         _lib = lib
@@ -123,6 +138,49 @@ class CppClient:
         if pid < 0:
             raise IOError(self.last_error())
         return int(pid)
+
+    # cross-language tasks/actors (daemon) --------------------------------
+    # Python exports by name (ray_tpu.xlang); the NATIVE library speaks
+    # the whole protocol — this wrapper only packs/unpacks msgpack args.
+    def _xlang_out(self, rc, out, n):
+        if rc == -1:
+            raise IOError(self.last_error())
+        payload = self._take(out, n)
+        if rc == 1:
+            raise RuntimeError(payload.decode(errors="replace"))
+        import msgpack
+        return msgpack.unpackb(payload, raw=False)
+
+    def submit_task(self, name: str, *args):
+        import msgpack
+        blob = msgpack.packb(list(args))
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_int64()
+        rc = self._lib.rtc_submit_task(self._handle(), name.encode(),
+                                       blob, len(blob), ctypes.byref(out),
+                                       ctypes.byref(n))
+        return self._xlang_out(rc, out, n)
+
+    def create_actor(self, cls_name: str, actor_name: str, *args) -> None:
+        import msgpack
+        blob = msgpack.packb(list(args))
+        rc = self._lib.rtc_create_actor(self._handle(), cls_name.encode(),
+                                        actor_name.encode(), blob,
+                                        len(blob))
+        if rc == -1:
+            raise IOError(self.last_error())
+        if rc == 1:
+            raise RuntimeError(self.last_error())
+
+    def call_actor(self, actor_name: str, method: str, *args):
+        import msgpack
+        blob = msgpack.packb(list(args))
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_int64()
+        rc = self._lib.rtc_call_actor(self._handle(), actor_name.encode(),
+                                      method.encode(), blob, len(blob),
+                                      ctypes.byref(out), ctypes.byref(n))
+        return self._xlang_out(rc, out, n)
 
     def last_error(self) -> str:
         return self._lib.rtc_last_error(self._h).decode(errors="replace")

@@ -1,0 +1,48 @@
+"""Cross-language API: export Python callables for non-Python clients.
+
+Reference capability: `python/ray/cross_language.py` + the C++ API's
+task/actor submission (`cpp/include/ray/api.h`). Functions and actor
+classes are exported under stable NAMES to the cluster KV; a C++ client
+(`native/cpp_client.cc`: rtc_submit_task / rtc_create_actor /
+rtc_call_actor) submits by name with msgpack-plain args and receives
+msgpack-plain results — no Python pickles ever cross the language
+boundary. Execution happens on the daemon's pooled Python workers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import cloudpickle
+
+
+def _head_client():
+    from ray_tpu._private import worker
+
+    rt = worker.global_runtime()
+    if rt is None:
+        raise RuntimeError("ray_tpu.init() first")
+    backend = getattr(rt, "cluster_backend", None)
+    return getattr(backend, "head", None)
+
+
+def _kv_put(key: str, blob: bytes) -> None:
+    head = _head_client()
+    if head is not None:          # daemons mode: the KV C++ clients see
+        head.kv_put(key.encode(), blob)
+        return
+    from ray_tpu._private import worker
+    worker.global_runtime().gcs.kv_put(key.encode(), blob)
+
+
+def export_task(name: str, fn: Callable) -> None:
+    """Make ``fn`` invocable by name from non-Python clients
+    (C++: ``rtc_submit_task(h, name, args_msgpack)``)."""
+    _kv_put(f"xlang:fn:{name}", cloudpickle.dumps(fn))
+
+
+def export_actor_class(name: str, cls: Any) -> None:
+    """Make ``cls`` instantiable by name from non-Python clients
+    (C++: ``rtc_create_actor(h, cls_name, actor_name, args)`` then
+    ``rtc_call_actor(h, actor_name, method, args)``)."""
+    _kv_put(f"xlang:actor:{name}", cloudpickle.dumps(cls))
