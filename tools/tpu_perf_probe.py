@@ -1,0 +1,180 @@
+"""Staged TPU perf probes: ONE measurement per process, flap-resilient.
+
+Usage: python tools/tpu_perf_probe.py <stage>
+
+Each stage opens the backend, runs one tightly-scoped measurement (1-3
+compiles max), prints one "PROBE <stage> <json>" line, and exits — so a
+flapping tunnel window can be milked stage by stage (driven by
+tools/tpu_profile_all.sh; results land in tools/evidence/).
+
+  matmul     raw bf16 matmul TFLOP/s (roofline sanity, 2 shapes)
+  dispatch   tiny-op round-trip latency
+  attn       flash vs xla attention forward at bench shapes
+  attn_bwd   flash-VJP (blockwise) vs xla attention backward
+  fwd        bench_400m forward loss
+  step       full train step (the bench measurement)
+  step_nr    train step with remat DISABLED (memory permitting)
+  step_xla   train step with attention_impl="xla"
+  step_b16   train step at batch 16 (remat on)
+
+Interpret against the v5e roofline: 394 bf16 TFLOP/s, 819 GB/s HBM.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import json
+import sys
+import time
+
+faulthandler.dump_traceback_later(270, exit=True)
+
+
+def timeit(fn, *args, n=5, warm=2):
+    import jax
+    for _ in range(warm):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def emit(stage, **kw):
+    print(f"PROBE {stage} {json.dumps(kw)}", flush=True)
+
+
+def stage_matmul():
+    import jax
+    import jax.numpy as jnp
+    out = {}
+    for m, k, n in ((8192, 8192, 8192), (16384, 1024, 4096)):
+        a = jnp.ones((m, k), jnp.bfloat16)
+        b = jnp.ones((k, n), jnp.bfloat16)
+        f = jax.jit(lambda a, b: a @ b)
+        dt = timeit(f, a, b, n=10)
+        out[f"{m}x{k}x{n}"] = round(2 * m * k * n / dt / 1e12, 1)
+    emit("matmul", tflops=out)
+
+
+def stage_dispatch():
+    import jax
+    import jax.numpy as jnp
+    tiny = jnp.ones((8, 8), jnp.float32)
+    f = jax.jit(lambda x: x + 1)
+    dt = timeit(f, tiny, n=20)
+    emit("dispatch", roundtrip_ms=round(dt * 1e3, 3))
+
+
+def _attn_inputs():
+    import jax.numpy as jnp
+    B, S, H, Hkv, D = 8, 2048, 8, 4, 128
+    q = jnp.ones((B, S, H, D), jnp.bfloat16)
+    k = jnp.ones((B, S, Hkv, D), jnp.bfloat16)
+    return q, k, k
+
+
+def stage_attn():
+    import jax
+    from ray_tpu.ops.attention import attention
+    q, k, v = _attn_inputs()
+    fl = jax.jit(lambda q, k, v: attention(q, k, v, causal=True,
+                                           use_flash=True))
+    xl = jax.jit(lambda q, k, v: attention(q, k, v, causal=True,
+                                           use_flash=False))
+    emit("attn",
+         flash_ms=round(timeit(fl, q, k, v, n=10) * 1e3, 2),
+         xla_ms=round(timeit(xl, q, k, v, n=10) * 1e3, 2))
+
+
+def stage_attn_bwd():
+    import jax
+    from ray_tpu.ops.attention import attention
+    q, k, v = _attn_inputs()
+    gfl = jax.jit(jax.grad(lambda q: attention(
+        q, k, v, causal=True, use_flash=True).astype('float32').sum()))
+    gxl = jax.jit(jax.grad(lambda q: attention(
+        q, k, v, causal=True, use_flash=False).astype('float32').sum()))
+    emit("attn_bwd",
+         flash_ms=round(timeit(gfl, q, n=5) * 1e3, 2),
+         xla_ms=round(timeit(gxl, q, n=5) * 1e3, 2))
+
+
+def _bench_model(remat=True, attn="flash", batch=8):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel
+    cfg = LlamaConfig.bench_400m()
+    object.__setattr__(cfg, "remat", remat)
+    object.__setattr__(cfg, "attention_impl", attn)
+    model = LlamaModel(cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, 2048)),
+                         jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    return cfg, model, tokens, targets
+
+
+def stage_fwd():
+    import jax
+    cfg, model, tokens, targets = _bench_model()
+    params = model.init(jax.random.key(0))
+    loss_fn = jax.jit(lambda p: model.loss(p, tokens, targets))
+    dt = timeit(loss_fn, params, n=5)
+    flops = 2 * cfg.num_params() * tokens.size
+    emit("fwd", ms=round(dt * 1e3, 1),
+         fwd_tflops=round(flops / dt / 1e12, 1))
+
+
+def run_step(stage, remat=True, attn="flash", batch=8):
+    import jax
+    from ray_tpu.train.spmd import make_train_step
+    cfg, model, tokens, targets = _bench_model(remat, attn, batch)
+    ts = make_train_step(model)
+    p, o = ts.init_fn(jax.random.key(0))
+    bt = (tokens, targets)
+    for _ in range(2):
+        p, o, m = ts.step_fn(p, o, bt)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(5):
+        p, o, m = ts.step_fn(p, o, bt)
+    jax.block_until_ready(m["loss"])
+    dt = (time.perf_counter() - t0) / 5
+    n = cfg.num_params()
+    mfu = (tokens.size / dt) * 6 * n / 394e12
+    emit(stage, step_ms=round(dt * 1e3, 1),
+         tok_s=round(tokens.size / dt, 1), mfu=round(mfu, 4))
+
+
+STAGES = {
+    "matmul": stage_matmul,
+    "dispatch": stage_dispatch,
+    "attn": stage_attn,
+    "attn_bwd": stage_attn_bwd,
+    "fwd": stage_fwd,
+    "step": lambda: run_step("step"),
+    "step_nr": lambda: run_step("step_nr", remat=False),
+    "step_xla": lambda: run_step("step_xla", attn="xla"),
+    "step_b16": lambda: run_step("step_b16", batch=16),
+}
+
+
+def main():
+    stage = sys.argv[1] if len(sys.argv) > 1 else "matmul"
+    if stage not in STAGES:
+        raise SystemExit(f"unknown stage {stage}; have {list(STAGES)}")
+    import jax
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        emit(stage, error="cpu backend, no TPU")
+        return 1
+    STAGES[stage]()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

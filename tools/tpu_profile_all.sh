@@ -1,0 +1,20 @@
+#!/bin/bash
+# Milk a TPU tunnel window: run every perf probe stage, each in its own
+# process under a timeout, appending results to tools/evidence/.
+# A stage that hangs (tunnel flap) is killed and the next one still runs
+# (the tunnel may come back). Exit code 0 if ANY stage produced data.
+cd "$(dirname "$0")/.."
+OUT=tools/evidence/tpu_perf_probes.log
+mkdir -p tools/evidence
+echo "=== $(date '+%F %T') profile run ===" >> "$OUT"
+got=1
+for stage in matmul dispatch attn attn_bwd fwd step step_nr step_xla step_b16; do
+  echo "--- $stage $(date '+%T')" >> "$OUT"
+  if timeout -k 5 300 python tools/tpu_perf_probe.py "$stage" >> "$OUT" 2>&1; then
+    got=0
+  else
+    echo "(stage $stage failed/timed out rc=$?)" >> "$OUT"
+  fi
+done
+tail -40 "$OUT"
+exit $got
