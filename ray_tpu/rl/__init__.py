@@ -6,6 +6,7 @@ EnvRunnerGroup rollout actors, jax Learners (PPO, DQN), env registry.
 
 from ray_tpu.rl.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rl.env import CartPoleEnv, EnvRunner, register_env
+from ray_tpu.rl.multi_agent import MultiAgentCartPole, MultiAgentEnvRunner
 
 __all__ = ["Algorithm", "AlgorithmConfig", "CartPoleEnv", "EnvRunner",
-           "register_env"]
+           "register_env", "MultiAgentCartPole", "MultiAgentEnvRunner"]

@@ -31,6 +31,11 @@ class AlgorithmConfig:
     # reference: rllib/connectors/)
     env_to_module_connector: Any = None
     module_to_env_connector: Any = None
+    # multi-agent (reference: algorithm_config.py .multi_agent()):
+    # policies maps policy_id -> per-policy learner_kwargs override (or
+    # None); policy_mapping_fn maps agent_id -> policy_id
+    policies: Optional[Dict[str, Any]] = None
+    policy_mapping_fn: Optional[Callable[[str], str]] = None
 
     # fluent API (reference AlgorithmConfig.environment/.env_runners/...)
     def environment(self, env) -> "AlgorithmConfig":
@@ -56,13 +61,60 @@ class AlgorithmConfig:
         self.module_to_env_connector = module_to_env
         return self
 
+    def multi_agent(self, policies: Dict[str, Any],
+                    policy_mapping_fn: Callable[[str], str]
+                    ) -> "AlgorithmConfig":
+        """Train several policies against a multi-agent env (reference
+        AlgorithmConfig.multi_agent). ``policies``: policy_id ->
+        learner_kwargs override dict (or None for defaults)."""
+        self.policies = dict(policies)
+        self.policy_mapping_fn = policy_mapping_fn
+        return self
+
     def build(self) -> "Algorithm":
         return Algorithm(self)
+
+
+def _build_learner(algo: str, obs_dim: int, n_actions: int, seed: int,
+                   learner_kwargs: Dict[str, Any]):
+    """(learner, policy_factory) for one policy of ``algo``."""
+    algo = algo.upper()
+    if algo == "PPO":
+        from ray_tpu.rl.ppo import ActorCriticPolicy, PPOLearner
+        learner = PPOLearner(obs_dim, n_actions, seed=seed,
+                             **learner_kwargs)
+        factory = lambda: ActorCriticPolicy(  # noqa: E731
+            obs_dim, n_actions, seed=seed)
+    elif algo == "DQN":
+        from ray_tpu.rl.dqn import DQNLearner, QPolicy
+        learner = DQNLearner(obs_dim, n_actions, seed=seed,
+                             **learner_kwargs)
+        factory = lambda: QPolicy(  # noqa: E731
+            obs_dim, n_actions, seed=seed)
+    elif algo in ("IMPALA", "APPO"):
+        from ray_tpu.rl.impala import APPOLearner, ImpalaLearner
+        from ray_tpu.rl.ppo import ActorCriticPolicy
+        cls = APPOLearner if algo == "APPO" else ImpalaLearner
+        learner = cls(obs_dim, n_actions, seed=seed, **learner_kwargs)
+        factory = lambda: ActorCriticPolicy(  # noqa: E731
+            obs_dim, n_actions, seed=seed)
+    elif algo == "SAC":
+        from ray_tpu.rl.sac import SACLearner, SACPolicy
+        learner = SACLearner(obs_dim, n_actions, seed=seed,
+                             **learner_kwargs)
+        factory = lambda: SACPolicy(  # noqa: E731
+            obs_dim, n_actions, seed=seed)
+    else:
+        raise ValueError(f"unknown algo {algo!r}")
+    return learner, factory
 
 
 class Algorithm:
     def __init__(self, config: AlgorithmConfig):
         self.config = config
+        if config.policies:
+            self._init_multi_agent()
+            return
         probe = make_env(config.env, seed=0)
         obs_dim = probe.obs_dim
         n_actions = probe.n_actions
@@ -73,38 +125,9 @@ class Algorithm:
             obs_dim = int(np.asarray(
                 probe_pipeline(probe.reset(seed=0)[0])).shape[-1])
 
-        if config.algo.upper() == "PPO":
-            from ray_tpu.rl.ppo import ActorCriticPolicy, PPOLearner
-            self.learner = PPOLearner(obs_dim, n_actions,
-                                      seed=config.seed,
-                                      **config.learner_kwargs)
-            policy_factory = lambda: ActorCriticPolicy(  # noqa: E731
-                obs_dim, n_actions, seed=config.seed)
-        elif config.algo.upper() == "DQN":
-            from ray_tpu.rl.dqn import DQNLearner, QPolicy
-            self.learner = DQNLearner(obs_dim, n_actions,
-                                      seed=config.seed,
-                                      **config.learner_kwargs)
-            policy_factory = lambda: QPolicy(  # noqa: E731
-                obs_dim, n_actions, seed=config.seed)
-        elif config.algo.upper() in ("IMPALA", "APPO"):
-            from ray_tpu.rl.impala import APPOLearner, ImpalaLearner
-            from ray_tpu.rl.ppo import ActorCriticPolicy
-            cls = (APPOLearner if config.algo.upper() == "APPO"
-                   else ImpalaLearner)
-            self.learner = cls(obs_dim, n_actions, seed=config.seed,
-                               **config.learner_kwargs)
-            policy_factory = lambda: ActorCriticPolicy(  # noqa: E731
-                obs_dim, n_actions, seed=config.seed)
-        elif config.algo.upper() == "SAC":
-            from ray_tpu.rl.sac import SACLearner, SACPolicy
-            self.learner = SACLearner(obs_dim, n_actions,
-                                      seed=config.seed,
-                                      **config.learner_kwargs)
-            policy_factory = lambda: SACPolicy(  # noqa: E731
-                obs_dim, n_actions, seed=config.seed)
-        else:
-            raise ValueError(f"unknown algo {config.algo!r}")
+        self.learner, policy_factory = _build_learner(
+            config.algo, obs_dim, n_actions, config.seed,
+            config.learner_kwargs)
 
         # Resolve string env specs against the DRIVER's registry before the
         # runners cross the process boundary (reference: RLlib ships the
@@ -133,8 +156,91 @@ class Algorithm:
         # calls.
         self._in_flight: Dict[Any, Any] = {}
 
+    # -- multi-agent (reference: rllib multi_agent_env_runner) ------------
+    def _init_multi_agent(self) -> None:
+        from ray_tpu.rl.multi_agent import MultiAgentEnvRunner
+        cfg = self.config
+        if cfg.algo.upper() in ("IMPALA", "APPO"):
+            raise ValueError(
+                "multi-agent training uses the synchronous path; "
+                "IMPALA/APPO async sampling is single-agent only")
+        if cfg.policy_mapping_fn is None:
+            raise ValueError("multi_agent() needs a policy_mapping_fn")
+        if (cfg.env_to_module_connector is not None
+                or cfg.module_to_env_connector is not None):
+            raise ValueError(
+                "connectors are not supported on the multi-agent path "
+                "yet — they would be silently ignored")
+        probe = make_env(cfg.env, seed=0)   # handles callables too
+        obs_dim, n_actions = probe.obs_dim, probe.n_actions
+        # config-time mapping validation: a bad policy_mapping_fn must
+        # fail HERE, not as a KeyError inside a remote runner
+        for aid in getattr(probe, "agent_ids", []):
+            pid = cfg.policy_mapping_fn(aid)
+            if pid not in cfg.policies:
+                raise ValueError(
+                    f"policy_mapping_fn({aid!r}) -> {pid!r}, which is "
+                    f"not in policies {sorted(cfg.policies)}")
+        self.learners: Dict[str, Any] = {}
+        factories: Dict[str, Any] = {}
+        for idx, (pid, overrides) in enumerate(cfg.policies.items()):
+            kw = dict(cfg.learner_kwargs)
+            kw.update(overrides or {})
+            # per-policy seed offset: distinct policies must not start
+            # bit-identical (self-play symmetry breaking)
+            self.learners[pid], factories[pid] = _build_learner(
+                cfg.algo, obs_dim, n_actions, cfg.seed + 1000 * idx, kw)
+        from ray_tpu.rl.env import ENV_REGISTRY
+        env_spec = cfg.env
+        if isinstance(env_spec, str) and env_spec in ENV_REGISTRY:
+            env_spec = ENV_REGISTRY[env_spec]
+        runner_cls = ray_tpu.remote(MultiAgentEnvRunner)
+        self.runners = [
+            runner_cls.remote(env_spec, factories, cfg.policy_mapping_fn,
+                              seed=cfg.seed + 1 + i)
+            for i in range(cfg.num_env_runners)]
+        self.learner = None
+        self._in_flight = {}
+        self._sync_weights()
+        self.iteration = 0
+
+    def _train_multi_agent(self) -> Dict[str, Any]:
+        cfg = self.config
+        metrics: Dict[str, Any] = {}
+        for _ in range(cfg.train_iterations_per_call):
+            sampled = ray_tpu.get([
+                r.sample.remote(cfg.rollout_fragment_length)
+                for r in self.runners])
+            by_policy: Dict[str, list] = {}
+            for batch in sampled:
+                for pid, frags in batch.items():
+                    by_policy.setdefault(pid, []).extend(frags)
+            for pid, frags in by_policy.items():
+                m = self.learners[pid].update(frags)
+                metrics.update({f"{pid}/{k}": v for k, v in m.items()})
+            self._sync_weights()
+        return self._finish_iteration(metrics)
+
+    def _finish_iteration(self, metrics: Dict[str, Any]) -> Dict[str, Any]:
+        """Shared per-train() tail: bump the counter, fold in episode
+        stats gathered from every runner."""
+        self.iteration += 1
+        returns = [x for r in self.runners
+                   for x in ray_tpu.get(r.episode_returns.remote())]
+        metrics.update({
+            "training_iteration": self.iteration,
+            "episode_return_mean": float(np.mean(returns))
+            if returns else float("nan"),
+            "num_episodes": len(returns),
+        })
+        return metrics
+
     def _sync_weights(self) -> None:
-        w = ray_tpu.put(self.learner.get_weights())
+        if self.config.policies:
+            w = ray_tpu.put({pid: ln.get_weights()
+                             for pid, ln in self.learners.items()})
+        else:
+            w = ray_tpu.put(self.learner.get_weights())
         ray_tpu.get([r.set_weights.remote(w) for r in self.runners])
 
     def _train_async(self) -> Dict[str, Any]:
@@ -159,20 +265,13 @@ class Algorithm:
                 ray_tpu.put(self.learner.get_weights()))
             self._in_flight[
                 runner.sample.remote(cfg.rollout_fragment_length)] = runner
-        self.iteration += 1
-        returns = [x for r in self.runners
-                   for x in ray_tpu.get(r.episode_returns.remote())]
-        metrics.update({
-            "training_iteration": self.iteration,
-            "episode_return_mean": float(np.mean(returns))
-            if returns else float("nan"),
-            "num_episodes": len(returns),
-        })
-        return metrics
+        return self._finish_iteration(metrics)
 
     def train(self) -> Dict[str, Any]:
         """One training iteration (reference Algorithm.step)."""
         cfg = self.config
+        if cfg.policies:
+            return self._train_multi_agent()
         if cfg.algo.upper() in ("IMPALA", "APPO"):
             return self._train_async()
         metrics: Dict[str, Any] = {}
@@ -182,16 +281,7 @@ class Algorithm:
                 for r in self.runners])
             metrics = self.learner.update(rollouts)
             self._sync_weights()
-        self.iteration += 1
-        returns = [x for r in self.runners
-                   for x in ray_tpu.get(r.episode_returns.remote())]
-        metrics.update({
-            "training_iteration": self.iteration,
-            "episode_return_mean": float(np.mean(returns))
-            if returns else float("nan"),
-            "num_episodes": len(returns),
-        })
-        return metrics
+        return self._finish_iteration(metrics)
 
     def stop(self) -> None:
         for r in self.runners:
