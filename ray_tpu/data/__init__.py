@@ -12,9 +12,9 @@ from ray_tpu.data.dataset import Dataset, GroupedData
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
                                    from_pandas, range, read_binary_files,
-                                  read_images, read_numpy,
-                                   read_csv, read_json, read_parquet,
-                                   read_text)
+                                   read_csv, read_images, read_json,
+                                   read_numpy, read_parquet, read_sql,
+                                   read_text, read_webdataset)
 
 __all__ = [
     "Dataset", "GroupedData", "DataIterator",
@@ -23,5 +23,7 @@ __all__ = [
     "read_binary_files",
     "read_images",
     "read_numpy",
+    "read_sql",
+    "read_webdataset",
     "Count", "Sum", "Min", "Max", "Mean", "Std",
 ]

@@ -26,6 +26,20 @@ from ray_tpu.data.execution import (StreamingExecutor, plan_chain,
 from ray_tpu.data.iterator import DataIterator
 
 
+def _json_default(o):
+    """numpy scalars/arrays inside rows -> plain JSON values."""
+    import numpy as _np
+    if isinstance(o, _np.integer):
+        return int(o)
+    if isinstance(o, _np.floating):
+        return float(o)
+    if isinstance(o, _np.ndarray):
+        return o.tolist()
+    if isinstance(o, bytes):
+        return o.decode(errors="replace")
+    raise TypeError(f"not JSON serializable: {type(o).__name__}")
+
+
 class Dataset:
     def __init__(self, root: L.LogicalOp):
         self._root = root
@@ -345,6 +359,73 @@ class Dataset:
             if block.num_rows:
                 with fs.open_output(f"{local}/part-{i:05d}.csv") as f:
                     pacsv.write_csv(block, f)
+
+    def write_json(self, path: str) -> None:
+        """One JSONL file per block (reference: Dataset.write_json)."""
+        import json as _json
+
+        from ray_tpu.data.filesystem import resolve_filesystem
+        fs, local = resolve_filesystem(path)
+        fs.makedirs(local)
+        for i, block in enumerate(self.iter_blocks()):
+            if block.num_rows:
+                lines = "\n".join(
+                    _json.dumps(row, default=_json_default)
+                    for row in block.to_pylist())
+                with fs.open_output(f"{local}/part-{i:05d}.json") as f:
+                    f.write((lines + "\n").encode())
+
+    def write_numpy(self, path: str, column: str) -> None:
+        """One .npy file per block from ``column`` (reference:
+        Dataset.write_numpy)."""
+        import io as _io
+
+        import numpy as _np
+
+        from ray_tpu.data.filesystem import resolve_filesystem
+        fs, local = resolve_filesystem(path)
+        fs.makedirs(local)
+        for i, block in enumerate(self.iter_blocks()):
+            if block.num_rows:
+                arr = _np.asarray(
+                    block.column(column).to_numpy(zero_copy_only=False))
+                buf = _io.BytesIO()
+                _np.save(buf, arr)
+                with fs.open_output(f"{local}/part-{i:05d}.npy") as f:
+                    f.write(buf.getvalue())
+
+    def write_webdataset(self, path: str) -> None:
+        """One WebDataset tar shard per block: each row becomes a
+        sample keyed by its ``__key__`` column (or the row index), with
+        every other column written as ``<key>.<column>`` (bytes/str
+        raw, everything else JSON — reference: Dataset.write_webdataset)."""
+        import io as _io
+        import json as _json
+        import tarfile
+
+        from ray_tpu.data.filesystem import resolve_filesystem
+        fs, local = resolve_filesystem(path)
+        fs.makedirs(local)
+        for i, block in enumerate(self.iter_blocks()):
+            if not block.num_rows:
+                continue
+            buf = _io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w") as tar:
+                for j, row in enumerate(block.to_pylist()):
+                    key = str(row.pop("__key__", f"{i:05d}{j:06d}"))
+                    for col, val in row.items():
+                        if isinstance(val, bytes):
+                            payload = val
+                        elif isinstance(val, str):
+                            payload = val.encode()
+                        else:
+                            payload = _json.dumps(
+                                val, default=_json_default).encode()
+                        info = tarfile.TarInfo(f"{key}.{col}")
+                        info.size = len(payload)
+                        tar.addfile(info, _io.BytesIO(payload))
+            with fs.open_output(f"{local}/shard-{i:05d}.tar") as f:
+                f.write(buf.getvalue())
 
     def stats(self) -> str:
         """Execution statistics summary (reference: Dataset.stats())."""

@@ -183,3 +183,77 @@ def read_numpy(paths, column: str = "data") -> Dataset:
             arr = np.load(fh)
         return block_from_batch({column: arr})
     return _file_read_dataset(paths, ".npy", reader, "read_numpy")
+
+
+def read_sql(sql: str, connection_factory, *,
+             parallelism: int = 1) -> Dataset:
+    """Rows of a SQL query as a dataset (reference: `data/read_api.py`
+    read_sql / SQLDatasource). ``connection_factory`` returns a DB-API
+    connection (e.g. ``lambda: sqlite3.connect(path)``) — it is called
+    INSIDE each read task, so the dataset ships the factory, never a
+    live connection. ``parallelism > 1`` pages the result set with
+    ORDER BY 1 + LIMIT/OFFSET across independent query executions: the
+    query's FIRST column must be a stable (ideally unique) key or rows
+    may repeat/drop across pages."""
+    def read_page(page: int, num_pages: int):
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            q = sql
+            if num_pages > 1:
+                cur.execute(f"SELECT COUNT(*) FROM ({sql}) AS __sub")
+                total = cur.fetchone()[0]
+                per = (total + num_pages - 1) // num_pages
+                q = (f"SELECT * FROM ({sql}) AS __sub ORDER BY 1 "
+                     f"LIMIT {per} OFFSET {page * per}")
+            cur.execute(q)
+            cols = [d[0] for d in cur.description]
+            rows = [dict(zip(cols, r)) for r in cur.fetchall()]
+            return block_from_rows(rows)
+        finally:
+            conn.close()
+
+    import builtins
+    n = max(1, parallelism)
+    tasks = [lambda p=p: read_page(p, n) for p in builtins.range(n)]
+    return Dataset(L.Read("read_sql", [], read_tasks=tasks))
+
+
+def read_webdataset(paths) -> Dataset:
+    """WebDataset tar shards: files grouped by basename stem into one
+    row per sample, keyed by extension (reference: `data/read_api.py`
+    read_webdataset). E.g. ``000.jpg`` + ``000.cls`` -> one row
+    ``{"__key__": "000", "jpg": b..., "cls": b...}``."""
+    import io
+    import tarfile
+
+    def reader(f):
+        with _seam_open(f) as fh:
+            data = fh.read()
+        samples: dict = {}
+        order: list = []
+        with tarfile.open(fileobj=io.BytesIO(data)) as tar:
+            for member in tar.getmembers():
+                if not member.isfile():
+                    continue
+                # WebDataset convention: key = path up to the FIRST dot
+                # of the basename; everything after is the (possibly
+                # multi-part) extension, e.g. 000.seg.png -> ("000",
+                # "seg.png")
+                prefix, _, base = member.name.rpartition("/")
+                stem, _, ext = base.partition(".")
+                key = f"{prefix}/{stem}" if prefix else stem
+                if key not in samples:
+                    samples[key] = {"__key__": key}
+                    order.append(key)
+                samples[key][ext] = tar.extractfile(member).read()
+        rows = [samples[k] for k in order]
+        # uniform column set: a sample missing an extension seen in
+        # others gets None (block_from_rows keys off the first row)
+        all_cols = {c for r in rows for c in r}
+        for r in rows:
+            for c in all_cols:
+                r.setdefault(c, None)
+        return block_from_rows(rows)
+
+    return _file_read_dataset(paths, ".tar", reader, "read_webdataset")
