@@ -198,6 +198,24 @@ class _GcsProxy:
         return self._state.call_host("gcs_get_named_actor", name=name,
                                      namespace=namespace)
 
+    # internal KV (debugger session registry, collectives, ...)
+    def kv_put(self, key, value, overwrite=True, namespace=b""):
+        return self._state.call_host("gcs_kv_put", key=key, value=value,
+                                     overwrite=overwrite,
+                                     namespace=namespace)
+
+    def kv_get(self, key, namespace=b""):
+        return self._state.call_host("gcs_kv_get", key=key,
+                                     namespace=namespace)
+
+    def kv_del(self, key, namespace=b""):
+        return self._state.call_host("gcs_kv_del", key=key,
+                                     namespace=namespace)
+
+    def kv_keys(self, prefix=b"", namespace=b""):
+        return self._state.call_host("gcs_kv_keys", prefix=prefix,
+                                     namespace=namespace)
+
 
 class _PgManagerProxy:
     """Worker-side pg_manager facade: returns a picklable clone of the
@@ -425,7 +443,8 @@ class _WorkerState:
             token = runtime_context._set_context(**ctx)
             try:
                 with apply_runtime_env(
-                        self._resolve_runtime_env(msg.get("runtime_env"))):
+                        self._resolve_runtime_env(msg.get("runtime_env"))), \
+                        _post_mortem_on_error():
                     if msg["op"] == "create_actor":
                         cls = self._fn(msg)
                         args, kwargs = cloudpickle.loads(msg["args_blob"])
@@ -492,6 +511,27 @@ class _WorkerState:
                 os._exit(1)
         finally:
             self._task_threads.pop(rid, None)
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _post_mortem_on_error():
+    """Distributed debugger hook (reference ray.util.rpdb): a crashing
+    task holds its frame open for an operator to attach. Must run
+    INSIDE apply_runtime_env so env_vars={"RAY_TPU_POST_MORTEM": "1"}
+    on the task enables it."""
+    try:
+        yield
+    except BaseException as e:  # noqa: BLE001 — re-raised below
+        from ray_tpu.util import rpdb
+        if rpdb.post_mortem_enabled():
+            try:
+                rpdb.post_mortem(e)
+            except Exception:
+                pass
+        raise
 
 
 def _child_main(conn) -> None:
@@ -778,6 +818,22 @@ def dispatch_core_op(rt, holder, call: str, kw: Dict[str, Any],
         return rt.gcs.get_actor_info(kw["actor_id"])
     if call == "gcs_get_named_actor":
         return rt.gcs.get_named_actor(kw["name"], kw["namespace"])
+    if call.startswith("gcs_kv_"):
+        # same store preference as ray_tpu.util.rpdb._kv: the head's KV
+        # when one exists (cross-process discoverable), else local gcs
+        backend = getattr(rt, "cluster_backend", None)
+        store = getattr(backend, "head", None) or rt.gcs
+        ns = kw.get("namespace", b"")
+        if call == "gcs_kv_put":
+            return store.kv_put(kw["key"], kw["value"],
+                                overwrite=kw.get("overwrite", True),
+                                namespace=ns)
+        if call == "gcs_kv_get":
+            return store.kv_get(kw["key"], namespace=ns)
+        if call == "gcs_kv_del":
+            return store.kv_del(kw["key"], namespace=ns)
+        if call == "gcs_kv_keys":
+            return store.kv_keys(kw["prefix"], namespace=ns)
     if call == "fetch_function":
         return fetch_function_blob(kw["fid"])
     if call == "fetch_runtime_pkg":

@@ -27,7 +27,9 @@ from ray_tpu.data.iterator import DataIterator
 
 
 def _json_default(o):
-    """numpy scalars/arrays inside rows -> plain JSON values."""
+    """numpy scalars/arrays inside rows -> plain JSON values. bytes are
+    REJECTED: lossy replace-decoding would silently corrupt binary
+    columns (use write_parquet or write_webdataset for those)."""
     import numpy as _np
     if isinstance(o, _np.integer):
         return int(o)
@@ -36,7 +38,9 @@ def _json_default(o):
     if isinstance(o, _np.ndarray):
         return o.tolist()
     if isinstance(o, bytes):
-        return o.decode(errors="replace")
+        raise TypeError(
+            "binary column in write_json — bytes do not round-trip "
+            "through JSON; use write_parquet or write_webdataset")
     raise TypeError(f"not JSON serializable: {type(o).__name__}")
 
 

@@ -195,17 +195,10 @@ def read_sql(sql: str, connection_factory, *,
     ORDER BY 1 + LIMIT/OFFSET across independent query executions: the
     query's FIRST column must be a stable (ideally unique) key or rows
     may repeat/drop across pages."""
-    def read_page(page: int, num_pages: int):
+    def run_query(q: str):
         conn = connection_factory()
         try:
             cur = conn.cursor()
-            q = sql
-            if num_pages > 1:
-                cur.execute(f"SELECT COUNT(*) FROM ({sql}) AS __sub")
-                total = cur.fetchone()[0]
-                per = (total + num_pages - 1) // num_pages
-                q = (f"SELECT * FROM ({sql}) AS __sub ORDER BY 1 "
-                     f"LIMIT {per} OFFSET {page * per}")
             cur.execute(q)
             cols = [d[0] for d in cur.description]
             rows = [dict(zip(cols, r)) for r in cur.fetchall()]
@@ -215,7 +208,24 @@ def read_sql(sql: str, connection_factory, *,
 
     import builtins
     n = max(1, parallelism)
-    tasks = [lambda p=p: read_page(p, n) for p in builtins.range(n)]
+    if n == 1:
+        tasks = [lambda: run_query(sql)]
+    else:
+        # count ONCE at plan-build time (not per task) to fix the page
+        # bounds; pages then run as independent LIMIT/OFFSET queries
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(f"SELECT COUNT(*) FROM ({sql}) AS __sub")
+            total = cur.fetchone()[0]
+        finally:
+            conn.close()
+        per = max(1, (total + n - 1) // n)
+        tasks = [
+            lambda p=p: run_query(
+                f"SELECT * FROM ({sql}) AS __sub ORDER BY 1 "
+                f"LIMIT {per} OFFSET {p * per}")
+            for p in builtins.range(n)]
     return Dataset(L.Read("read_sql", [], read_tasks=tasks))
 
 

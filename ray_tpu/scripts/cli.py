@@ -264,6 +264,48 @@ def cmd_job_submit(args) -> int:
     return 0 if status == "SUCCEEDED" else 1
 
 
+def cmd_debug(args) -> int:
+    """List active remote-debugger sessions or attach to one
+    (reference: the `ray debug` CLI over ray.util.rpdb). Listing reads
+    the RUNNING cluster's head KV (cluster file or --cluster), never a
+    fresh isolated runtime."""
+    from ray_tpu.util import rpdb
+    if args.session:
+        host, _, port = args.session.rpartition(":")
+        rpdb.connect(host or "127.0.0.1", int(port))
+        return 0
+    sessions = []
+    cluster = getattr(args, "cluster", "") or _try_cluster_address()
+    if cluster:
+        from ray_tpu._private.head import HeadClient
+        host, port = cluster.rsplit(":", 1)
+        head = HeadClient((host, int(port)))
+        try:
+            sessions = rpdb.sessions_from_kv(head)
+        finally:
+            head.close()
+    else:
+        # same-process fallback (tests / embedded drivers)
+        import ray_tpu
+        if ray_tpu.is_initialized():
+            sessions = rpdb.active_sessions()
+    if not sessions:
+        print("no active debugger sessions")
+        return 0
+    for s in sessions:
+        print(f"{s['host']}:{s['port']}  pid={s['pid']} "
+              f"task={s.get('task_id')}  {s.get('banner', '')}")
+    return 0
+
+
+def _try_cluster_address() -> str:
+    try:
+        with open(CLUSTER_FILE) as f:
+            return json.load(f)["address"]
+    except Exception:
+        return ""
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="ray-tpu", description="ray_tpu cluster CLI")
@@ -300,6 +342,11 @@ def main(argv=None) -> int:
     p = sub.add_parser("job-submit")
     p.add_argument("entrypoint")
     p.add_argument("--timeout", type=float, default=300.0)
+    p = sub.add_parser("debug")
+    p.add_argument("session", nargs="?", default="",
+                   help="host:port of a session to attach; empty = list")
+    p.add_argument("--cluster", default="",
+                   help="head host:port (default: the cluster file)")
 
     args = parser.parse_args(argv)
     handler = {
@@ -309,6 +356,7 @@ def main(argv=None) -> int:
         "memory": cmd_memory, "timeline": cmd_timeline,
         "microbenchmark": cmd_microbenchmark, "dashboard": cmd_dashboard,
         "serve-deploy": cmd_serve_deploy, "job-submit": cmd_job_submit,
+        "debug": cmd_debug,
     }[args.command]
     return handler(args)
 
