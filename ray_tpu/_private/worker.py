@@ -819,23 +819,11 @@ class Runtime:
             placement_group_id=spec.placement_group_id,
             pg_capture=spec.pg_capture)
         from ray_tpu.runtime_env import apply_runtime_env
+        from ray_tpu.util.rpdb import post_mortem_on_error
         try:
-            with apply_runtime_env(spec.runtime_env):
-                try:
-                    result = spec.func(*args, **kwargs)
-                except BaseException as e:  # noqa: BLE001
-                    # distributed debugger (reference ray.util.rpdb):
-                    # hold the crashed frame open for an operator to
-                    # attach — checked INSIDE the runtime env so
-                    # env_vars={"RAY_TPU_POST_MORTEM": "1"} works; a
-                    # debugger failure must never mask the user's error
-                    try:
-                        from ray_tpu.util import rpdb
-                        if rpdb.post_mortem_enabled():
-                            rpdb.post_mortem(e)
-                    except Exception:
-                        pass
-                    raise
+            with apply_runtime_env(spec.runtime_env), \
+                    post_mortem_on_error():
+                result = spec.func(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
             self._finish_task(spec, node,
                               error=exc.TaskError(e, spec.name))

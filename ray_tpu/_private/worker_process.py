@@ -513,25 +513,16 @@ class _WorkerState:
             self._task_threads.pop(rid, None)
 
 
-import contextlib
-
-
-@contextlib.contextmanager
 def _post_mortem_on_error():
-    """Distributed debugger hook (reference ray.util.rpdb): a crashing
-    task holds its frame open for an operator to attach. Must run
-    INSIDE apply_runtime_env so env_vars={"RAY_TPU_POST_MORTEM": "1"}
-    on the task enables it."""
+    """Distributed debugger hook — single definition lives in
+    ray_tpu.util.rpdb (shared with the in-process path); guarded so a
+    debugger-side import failure never masks the user's exception."""
+    import contextlib
     try:
-        yield
-    except BaseException as e:  # noqa: BLE001 — re-raised below
-        from ray_tpu.util import rpdb
-        if rpdb.post_mortem_enabled():
-            try:
-                rpdb.post_mortem(e)
-            except Exception:
-                pass
-        raise
+        from ray_tpu.util.rpdb import post_mortem_on_error
+        return post_mortem_on_error()
+    except Exception:
+        return contextlib.nullcontext()
 
 
 def _child_main(conn) -> None:

@@ -206,13 +206,12 @@ def read_sql(sql: str, connection_factory, *,
         finally:
             conn.close()
 
-    import builtins
-    n = max(1, parallelism)
-    if n == 1:
-        tasks = [lambda: run_query(sql)]
-    else:
-        # count ONCE at plan-build time (not per task) to fix the page
-        # bounds; pages then run as independent LIMIT/OFFSET queries
+    def read_page(p: int, n: int):
+        # each task counts then reads its page: the count is redundant
+        # across tasks, but the DRIVER never touches the database — a
+        # DB reachable only from workers (private subnet, worker-held
+        # credentials) still works, and the count subquery is cheap
+        # next to the page pull
         conn = connection_factory()
         try:
             cur = conn.cursor()
@@ -221,11 +220,16 @@ def read_sql(sql: str, connection_factory, *,
         finally:
             conn.close()
         per = max(1, (total + n - 1) // n)
-        tasks = [
-            lambda p=p: run_query(
-                f"SELECT * FROM ({sql}) AS __sub ORDER BY 1 "
-                f"LIMIT {per} OFFSET {p * per}")
-            for p in builtins.range(n)]
+        return run_query(
+            f"SELECT * FROM ({sql}) AS __sub ORDER BY 1 "
+            f"LIMIT {per} OFFSET {p * per}")
+
+    import builtins
+    n = max(1, parallelism)
+    if n == 1:
+        tasks = [lambda: run_query(sql)]
+    else:
+        tasks = [lambda p=p: read_page(p, n) for p in builtins.range(n)]
     return Dataset(L.Read("read_sql", [], read_tasks=tasks))
 
 

@@ -216,3 +216,37 @@ def enable_s3(**kwargs) -> S3FileSystem:
     fs = S3FileSystem(**kwargs)
     register_filesystem("s3", fs)
     return fs
+
+
+class GcsFileSystem(S3FileSystem):
+    """Google Cloud Storage via its documented XML interoperability API
+    (reference capability: gs:// datasources through pyarrow's GcsFileSystem).
+
+    GCS's interop mode speaks the same XML protocol and SigV4 HMAC
+    signing as S3 (https://cloud.google.com/storage/docs/interoperability),
+    so this is the S3 implementation pointed at
+    ``storage.googleapis.com`` with GCS HMAC credentials
+    (``GS_ACCESS_KEY_ID``/``GS_SECRET_ACCESS_KEY``, falling back to the
+    AWS names for mocks/MinIO-style endpoints). Anonymous requests work
+    for public buckets and test servers."""
+
+    scheme = "gs"
+
+    def __init__(self, endpoint_url: Optional[str] = None,
+                 region: str = "auto",
+                 access_key: Optional[str] = None,
+                 secret_key: Optional[str] = None):
+        super().__init__(
+            endpoint_url=endpoint_url or "https://storage.googleapis.com",
+            region=region,
+            access_key=access_key or os.environ.get("GS_ACCESS_KEY_ID"),
+            secret_key=secret_key or os.environ.get(
+                "GS_SECRET_ACCESS_KEY"))
+
+
+def enable_gs(**kwargs) -> GcsFileSystem:
+    """Register gs:// (and gcs://) with the data layer."""
+    fs = GcsFileSystem(**kwargs)
+    register_filesystem("gs", fs)
+    register_filesystem("gcs", fs)
+    return fs

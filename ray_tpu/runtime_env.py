@@ -57,10 +57,11 @@ def apply_runtime_env(runtime_env: Optional[Dict[str, Any]]):
         yield
         return
     if any(runtime_env.get(k) for k in
-           ("pip", "uv", "conda", "container", "image_uri")):
+           ("uv", "conda", "container", "image_uri")):
         warnings.warn(
-            "runtime_env package materialization (pip/uv/conda/container) "
-            "is a no-op in the single-image runtime", stacklevel=2)
+            "runtime_env materialization for uv/conda/container is a "
+            "no-op in the single-image runtime (pip IS materialized — "
+            "see _private/runtime_env_pip.py)", stacklevel=2)
     env_vars: Dict[str, str] = runtime_env.get("env_vars") or {}
 
     def _local(p: str) -> str:
@@ -78,6 +79,11 @@ def apply_runtime_env(runtime_env: Optional[Dict[str, Any]]):
         paths.append(_local(wd))
     for mod in runtime_env.get("py_modules") or []:
         paths.append(_local(mod))
+    if runtime_env.get("pip"):
+        # materialized pip env = an import path (same interpreter; the
+        # reference swaps worker interpreters instead — pip.py agent)
+        from ray_tpu._private.runtime_env_pip import materialize_pip
+        paths.append(materialize_pip(runtime_env["pip"]))
 
     with _env_lock:
         saved = {k: os.environ.get(k) for k in env_vars}

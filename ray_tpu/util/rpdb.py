@@ -17,6 +17,7 @@ needs no tty; sessions self-deregister when the debugger detaches
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import pdb
@@ -88,16 +89,22 @@ def active_sessions() -> List[Dict[str, Any]]:
     return sessions_from_kv(kv)
 
 
-def _advertise_host() -> str:
-    """A host other cluster nodes can route to (the docstring promises
-    'a task anywhere in the cluster'); loopback only as last resort."""
-    try:
-        host = socket.gethostbyname(socket.gethostname())
-        if host and not host.startswith("127."):
-            return host
-    except OSError:
-        pass
-    return "127.0.0.1"
+def _bind_and_advertise() -> tuple:
+    """(bind_host, advertise_host). SECURITY: a pdb session is arbitrary
+    code execution, so the DEFAULT binds loopback only (matching the
+    reference rpdb). Cross-node attachment is an explicit opt-in —
+    RAY_TPU_DEBUGGER_EXTERNAL=1 — which binds all interfaces and
+    advertises a routable address."""
+    if os.environ.get("RAY_TPU_DEBUGGER_EXTERNAL") == "1":
+        advertise = "127.0.0.1"
+        try:
+            host = socket.gethostbyname(socket.gethostname())
+            if host and not host.startswith("127."):
+                advertise = host
+        except OSError:
+            pass
+        return "0.0.0.0", advertise
+    return "127.0.0.1", "127.0.0.1"
 
 
 class _RemotePdb(pdb.Pdb):
@@ -157,10 +164,10 @@ def _open_session(banner: str) -> Optional[_RemotePdb]:
         return None
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind(("0.0.0.0", 0))
+    bind_host, host = _bind_and_advertise()
+    srv.bind((bind_host, 0))
     srv.listen(1)
     _, port = srv.getsockname()
-    host = _advertise_host()
     from ray_tpu._private import runtime_context
     try:
         ctx = runtime_context.get_runtime_context()
@@ -230,6 +237,25 @@ def post_mortem_enabled() -> bool:
     return os.environ.get("RAY_TPU_POST_MORTEM") == "1"
 
 
+@contextlib.contextmanager
+def post_mortem_on_error():
+    """THE task-execution hook (used by both the in-process and the
+    pooled-worker paths): on a task exception with post-mortem enabled,
+    hold the crashed frame open for an operator, then re-raise the
+    ORIGINAL error. Must run INSIDE apply_runtime_env so per-task
+    env_vars={"RAY_TPU_POST_MORTEM": "1"} works; a debugger-side
+    failure must never mask the user's exception."""
+    try:
+        yield
+    except BaseException as e:  # noqa: BLE001 — re-raised below
+        try:
+            if post_mortem_enabled():
+                post_mortem(e)
+        except Exception:
+            pass
+        raise
+
+
 # ---------------------------------------------------------------------------
 # client side
 # ---------------------------------------------------------------------------
@@ -241,9 +267,12 @@ def connect(host: str, port: int, *, commands: Optional[List[str]] = None,
     process's stdin/stdout to the session until it closes (the
     ``ray-tpu debug`` interactive path)."""
     sock = socket.create_connection((host, port), timeout=timeout)
-    sock.settimeout(timeout)
     if commands is None:
+        # interactive: the timeout applies to CONNECTING only — an
+        # operator reading code at the prompt must not be disconnected
+        sock.settimeout(None)
         return _bridge_tty(sock)
+    sock.settimeout(timeout)
     transcript = []
     io = sock.makefile("rw", buffering=1)
     try:
