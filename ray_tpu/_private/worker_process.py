@@ -335,6 +335,11 @@ class _WorkerState:
         self._fn_cache: Dict[str, Any] = {}
         self._gen_sems: Dict[str, threading.Semaphore] = {}
         self.proxy = WorkerProxyRuntime(self)
+        # compiled-DAG channel loop (dag_start/dag_stop ops)
+        self._dag_stop: Any = None
+        self._dag_thread: Any = None
+        self._dag_channels: Dict[str, Any] = {}
+        self._dag_gen: Any = None
 
     def send(self, msg: Dict[str, Any]) -> None:
         blob = cloudpickle.dumps(msg)
@@ -374,7 +379,7 @@ class _WorkerState:
                     slot[2] = cloudpickle.loads(msg["value"])
                     slot[0].set()
             elif op in ("execute_task", "create_actor", "call_method",
-                        "reset_actor"):
+                        "reset_actor", "dag_start", "dag_stop"):
                 t = threading.Thread(target=self._handle, args=(msg,),
                                      daemon=True,
                                      name=f"task-{msg['id']}")
@@ -421,6 +426,94 @@ class _WorkerState:
             out["py_modules"] = [resolve(m) for m in out["py_modules"]]
         return out
 
+    # -- compiled-DAG channel loop ---------------------------------------
+    # Reference capability: the accelerated-DAG per-actor execution loop
+    # (`python/ray/dag/compiled_dag_node.py` _do_exec_tasks) — after one
+    # dag_start RPC, every execute() flows ONLY through pre-allocated
+    # shm channels; no task submission, no object store.
+    def _dag_start(self, spec: Dict[str, Any]):
+        from ray_tpu.dag.shm_channel import ShmChannel
+        if self._dag_thread is not None:
+            # superseded binding (an abandoned CompiledDAG that was
+            # never torn down): stop the stale loop, serve the new one
+            self._dag_teardown()
+        channels = {name: ShmChannel(name=name)
+                    for name in spec["channels"]}
+        consts = spec["consts"]
+        stages = spec["stages"]
+        stop = threading.Event()
+
+        def loop():
+            import sys as _sys
+            import traceback as _tb
+
+            from ray_tpu.dag.shm_channel import ChannelClosed
+            while not stop.is_set():
+                try:
+                    for st in stages:
+                        self._dag_run_stage(st, channels, consts, stop)
+                except ChannelClosed:
+                    return
+                except Exception:
+                    # e.g. ChannelFull on an oversized stage output:
+                    # the channel chain cannot carry this — at least
+                    # leave a driver-visible diagnostic (worker logs
+                    # forward to the driver) before the loop dies
+                    print("[compiled-dag] worker loop died:\n"
+                          + _tb.format_exc(), file=_sys.stderr,
+                          flush=True)
+                    return
+
+        t = threading.Thread(target=loop, daemon=True, name="dag-loop")
+        self._dag_stop = stop
+        self._dag_thread = t
+        self._dag_channels = channels
+        self._dag_gen = spec.get("gen")
+        t.start()
+        return None
+
+    def _dag_run_stage(self, st, channels, consts, stop) -> None:
+        def fetch(src):
+            kind, key = src
+            if kind == "chan":
+                # idle waiting has NO deadline: a compiled DAG parked
+                # for hours must still answer the next execute(); the
+                # stop event is the only exit
+                return channels[key].read(stop=stop, timeout=None)
+            return ("ok", consts[key])
+
+        inputs = [fetch(s) for s in st["args"]]
+        kw_in = {k: fetch(s) for k, s in st["kwargs"].items()}
+        err = next((v for s, v in inputs if s != "ok"),
+                   next((v for s, v in kw_in.values() if s != "ok"),
+                        None))
+        if err is not None:
+            out = ("err", err)       # propagate upstream failure
+        else:
+            try:
+                method = getattr(self.actor_instance, st["method"])
+                out = ("ok", method(
+                    *[v for _, v in inputs],
+                    **{k: v for k, (_, v) in kw_in.items()}))
+            except BaseException as e:  # noqa: BLE001 — via channel
+                out = ("err", e)
+        for name in st["out"]:
+            channels[name].write(out[0], out[1], stop=stop,
+                                 timeout=3600.0)
+
+    def _dag_teardown(self):
+        if self._dag_stop is not None:
+            self._dag_stop.set()
+        if self._dag_thread is not None:
+            self._dag_thread.join(timeout=5)
+        for ch in self._dag_channels.values():
+            ch.close()
+        self._dag_stop = None
+        self._dag_thread = None
+        self._dag_channels = {}
+        self._dag_gen = None
+        return None
+
     def _fn(self, msg: Dict[str, Any]):
         if "fn_blob" in msg:
             return cloudpickle.loads(msg["fn_blob"])
@@ -454,7 +547,21 @@ class _WorkerState:
                         method = getattr(self.actor_instance, msg["method"])
                         args, kwargs = cloudpickle.loads(msg["args_blob"])
                         result = method(*args, **kwargs)
+                    elif msg["op"] == "dag_start":
+                        result = self._dag_start(
+                            cloudpickle.loads(msg["args_blob"]))
+                    elif msg["op"] == "dag_stop":
+                        gen = (cloudpickle.loads(msg["args_blob"])
+                               if msg.get("args_blob") else None)
+                        # generation-scoped: a STALE CompiledDAG being
+                        # GC'd must not kill a newer binding's loop
+                        if gen is None or gen == getattr(
+                                self, "_dag_gen", None):
+                            result = self._dag_teardown()
+                        else:
+                            result = None
                     elif msg["op"] == "reset_actor":
+                        self._dag_teardown()   # recycle = no stale loop
                         # Clean actor teardown: drop the instance so the
                         # process can be recycled into the idle pool
                         # (spawns are expensive; prestart can't keep up

@@ -29,9 +29,11 @@ class DAGNode:
         from ray_tpu.dag.compiled import _execute_dag
         return _execute_dag(self, input_args, input_kwargs)
 
-    def experimental_compile(self) -> "CompiledDAG":
+    def experimental_compile(self, *,
+                             buffer_size_bytes: int = 1 << 20
+                             ) -> "CompiledDAG":
         from ray_tpu.dag.compiled import CompiledDAG
-        return CompiledDAG(self)
+        return CompiledDAG(self, buffer_size_bytes=buffer_size_bytes)
 
     # -- traversal --------------------------------------------------------
     def topo_sort(self) -> List["DAGNode"]:
