@@ -10,6 +10,7 @@ time-to-first-token per request and aggregate decode throughput.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional
 
@@ -34,6 +35,12 @@ def run_serving_bench(error: Optional[str] = None) -> dict:
 
     if on_tpu:
         cfg = LlamaConfig.bench_400m(max_seq_len=1024)
+        if os.environ.get("BENCH_DECODE"):   # "pallas" = paged kernel
+            import dataclasses
+            # replace() re-runs __post_init__ validation: a typo'd
+            # kernel name must error, not silently bench the fallback
+            cfg = dataclasses.replace(
+                cfg, decode_attention=os.environ["BENCH_DECODE"])
         n_requests, max_tokens, max_slots = 96, 128, 32
         prompt_lo, prompt_hi = 32, 256
         n_prefix, prefix_len = 16, 128

@@ -1,0 +1,125 @@
+"""Tuned examples: curated known-good configs per algorithm.
+
+Reference capability: `rllib/tuned_examples/` — a registry of
+algorithm configs that demonstrably reach a target return on a named
+environment, runnable by name. Here each entry is an AlgorithmConfig
+factory plus its convergence contract (target return, iteration
+budget); ``run(name)`` trains until the target or the budget and
+reports whether the contract held.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rl.algorithm import AlgorithmConfig
+
+
+@dataclasses.dataclass
+class TunedExample:
+    make_config: Callable[[], AlgorithmConfig]
+    target_return: float
+    max_iterations: int
+    description: str = ""
+
+
+def _ppo_cartpole() -> AlgorithmConfig:
+    return (AlgorithmConfig(algo="PPO", seed=0)
+            .environment("CartPole-v1")
+            .env_runners(2, rollout_fragment_length=512)
+            .training(lr=3e-4, epochs=6, minibatch_size=128,
+                      ent_coef=0.01))
+
+
+def _dqn_cartpole() -> AlgorithmConfig:
+    return (AlgorithmConfig(algo="DQN", seed=0)
+            .environment("CartPole-v1")
+            .env_runners(2, rollout_fragment_length=256))
+
+
+def _impala_cartpole() -> AlgorithmConfig:
+    return (AlgorithmConfig(algo="IMPALA", seed=0)
+            .environment("CartPole-v1")
+            .env_runners(2, rollout_fragment_length=256))
+
+
+def _appo_cartpole() -> AlgorithmConfig:
+    return (AlgorithmConfig(algo="APPO", seed=0)
+            .environment("CartPole-v1")
+            .env_runners(2, rollout_fragment_length=256))
+
+
+def _sac_cartpole() -> AlgorithmConfig:
+    return (AlgorithmConfig(algo="SAC", seed=0)
+            .environment("CartPole-v1")
+            .env_runners(2, rollout_fragment_length=256))
+
+
+def _ppo_multi_agent() -> AlgorithmConfig:
+    from ray_tpu.rl.env import register_env
+    from ray_tpu.rl.multi_agent import MultiAgentCartPole
+    register_env("tuned/MultiCartPole-2",
+                 lambda seed=0: MultiAgentCartPole(2, seed=seed,
+                                                  max_steps=200))
+    return (AlgorithmConfig(algo="PPO", seed=0)
+            .environment("tuned/MultiCartPole-2")
+            .env_runners(2, rollout_fragment_length=256)
+            .training(epochs=4, minibatch_size=128)
+            .multi_agent(
+                policies={"p0": None, "p1": None},
+                policy_mapping_fn=lambda aid: (
+                    "p0" if aid.endswith("0") else "p1")))
+
+
+TUNED: Dict[str, TunedExample] = {
+    "ppo-cartpole": TunedExample(
+        _ppo_cartpole, target_return=200.0, max_iterations=40,
+        description="PPO reaches 200+ on CartPole within 40 iters"),
+    "dqn-cartpole": TunedExample(
+        _dqn_cartpole, target_return=80.0, max_iterations=40,
+        description="DQN clears 80 on CartPole within 40 iters"),
+    "impala-cartpole": TunedExample(
+        _impala_cartpole, target_return=100.0, max_iterations=40,
+        description="IMPALA (V-trace) clears 100 within 40 iters"),
+    "appo-cartpole": TunedExample(
+        _appo_cartpole, target_return=100.0, max_iterations=40,
+        description="APPO clears 100 within 40 iters"),
+    "sac-cartpole": TunedExample(
+        _sac_cartpole, target_return=40.0, max_iterations=40,
+        description="discrete SAC clears 40 within 40 iters"),
+    "ppo-multi-agent-cartpole": TunedExample(
+        _ppo_multi_agent, target_return=60.0, max_iterations=30,
+        description="2-policy PPO on MultiAgentCartPole clears 60"),
+}
+
+
+def run(name: str, max_iterations: Optional[int] = None,
+        target_return: Optional[float] = None) -> Dict[str, Any]:
+    """Train a tuned example until its target return (rolling best) or
+    the iteration budget; returns the final metrics plus
+    ``converged``/``best_return``."""
+    ex = TUNED[name]
+    target = target_return if target_return is not None \
+        else ex.target_return
+    budget = max_iterations if max_iterations is not None \
+        else ex.max_iterations
+    algo = ex.make_config().build()
+    best = float("-inf")
+    metrics: Dict[str, Any] = {}
+    try:
+        for _ in range(budget):
+            metrics = algo.train()
+            ret = metrics.get("episode_return_mean", float("nan"))
+            if np.isfinite(ret):
+                best = max(best, float(ret))
+            if best >= target:
+                break
+    finally:
+        algo.stop()
+    metrics["best_return"] = best
+    metrics["converged"] = best >= target
+    metrics["target_return"] = target
+    return metrics

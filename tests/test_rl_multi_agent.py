@@ -139,3 +139,25 @@ def test_multi_agent_rejects_async_algos():
                         policy_mapping_fn=lambda a: "p"))
     with pytest.raises(ValueError, match="single-agent only"):
         cfg.build()
+
+
+def test_tuned_examples_registry_builds(ray_start_regular):
+    """Every tuned example's config must at least build and train one
+    iteration (reference: tuned_examples as runnable contracts)."""
+    from ray_tpu.rl.tuned_examples import TUNED
+    assert len(TUNED) >= 6
+    for name, ex in TUNED.items():
+        algo = ex.make_config().build()
+        try:
+            m = algo.train()
+            assert m["training_iteration"] == 1, name
+        finally:
+            algo.stop()
+
+
+def test_tuned_example_contract_runs(ray_start_regular):
+    """One fast contract end-to-end: PPO improves toward its target."""
+    from ray_tpu.rl.tuned_examples import run
+    m = run("ppo-cartpole", max_iterations=6, target_return=60.0)
+    assert m["best_return"] > 20.0
+    assert m["training_iteration"] >= 1
