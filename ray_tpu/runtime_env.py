@@ -57,11 +57,12 @@ def apply_runtime_env(runtime_env: Optional[Dict[str, Any]]):
         yield
         return
     if any(runtime_env.get(k) for k in
-           ("uv", "conda", "container", "image_uri")):
+           ("conda", "container", "image_uri")):
         warnings.warn(
-            "runtime_env materialization for uv/conda/container is a "
-            "no-op in the single-image runtime (pip IS materialized — "
-            "see _private/runtime_env_pip.py)", stacklevel=2)
+            "runtime_env materialization for conda/container is a "
+            "no-op in the single-image runtime (pip and uv ARE "
+            "materialized — see _private/runtime_env_pip.py)",
+            stacklevel=2)
     env_vars: Dict[str, str] = runtime_env.get("env_vars") or {}
 
     def _local(p: str) -> str:
@@ -79,11 +80,14 @@ def apply_runtime_env(runtime_env: Optional[Dict[str, Any]]):
         paths.append(_local(wd))
     for mod in runtime_env.get("py_modules") or []:
         paths.append(_local(mod))
-    if runtime_env.get("pip"):
-        # materialized pip env = an import path (same interpreter; the
-        # reference swaps worker interpreters instead — pip.py agent)
+    pkgs = runtime_env.get("pip") or runtime_env.get("uv")
+    if pkgs:
+        # materialized package env = an import path (same interpreter;
+        # the reference swaps worker interpreters instead — pip.py/
+        # uv.py agents). uv specs are the same package list and
+        # materialize through the same installer.
         from ray_tpu._private.runtime_env_pip import materialize_pip
-        paths.append(materialize_pip(runtime_env["pip"]))
+        paths.append(materialize_pip(pkgs))
 
     with _env_lock:
         saved = {k: os.environ.get(k) for k in env_vars}

@@ -79,3 +79,15 @@ def test_pip_failure_is_loud(ray_start_regular, tmp_path):
 
     with pytest.raises(Exception, match="pip runtime_env"):
         ray_tpu.get(f.remote(), timeout=120)
+
+
+def test_uv_spec_materializes_like_pip(ray_start_regular, wheelhouse):
+    """uv package specs ride the same installer (reference: uv.py
+    agent; no uv binary in this image)."""
+    @ray_tpu.remote(runtime_env={"uv": {
+        "packages": ["rtpu_demo_pkg"], "find_links": wheelhouse}})
+    def use():
+        import rtpu_demo_pkg
+        return rtpu_demo_pkg.MAGIC
+
+    assert ray_tpu.get(use.remote(), timeout=120) == "from-the-wheel"
