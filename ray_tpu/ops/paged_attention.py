@@ -156,8 +156,13 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, block_tables,
 def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths, *,
                            impl: str = "xla",
                            scale: Optional[float] = None,
-                           interpret: bool = False):
+                           interpret: Optional[bool] = None):
     if impl == "pallas":
+        if interpret is None:
+            # same contract as the flash kernel: off-TPU the SAME
+            # kernel logic runs under the Pallas interpreter
+            from ray_tpu.ops.attention import _interpret_default
+            interpret = _interpret_default()
         return paged_decode_attention_pallas(
             q, k_pool, v_pool, block_tables, lengths, scale=scale,
             interpret=interpret)
