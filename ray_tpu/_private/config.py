@@ -101,6 +101,10 @@ FLAG_DEFS = [
     # -- bench --
     Flag("bench_total_deadline", int, 540, "bench.py total wall-clock "
          "budget (seconds)"),
+    # -- sanitizers (SURVEY §5.2: the reference's TSAN-in-CI role) --
+    Flag("lock_sanitizer", bool, False, "track runtime lock acquisition "
+         "order and warn on inversion cycles (potential deadlocks); "
+         "see _private/lock_sanitizer.py"),
 ]
 
 FLAGS: Dict[str, Flag] = {f.name: f for f in FLAG_DEFS}

@@ -1,0 +1,160 @@
+"""Lock-order sanitizer: deadlock-cycle detection for runtime locks.
+
+Reference capability: the reference runs TSAN builds in CI
+(`.buildkite/`, SURVEY §5.2) to catch lock-order inversions in the C++
+core. The Python runtime's equivalent discipline: an opt-in sanitizer
+(``RAY_TPU_LOCK_SANITIZER=1`` or ``_system_config={"lock_sanitizer":
+True}``) that wraps named runtime locks, records the per-thread
+held-lock set at every acquisition, builds the global acquired-before
+graph, and reports the FIRST cycle (a potential deadlock) with both
+acquisition stacks. Zero overhead when disabled — ``tracked_lock``
+returns a plain lock.
+
+Used by the core runtime's central locks (object store, refcount,
+scheduler); tests drive it directly and through the stress suite.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+
+def enabled() -> bool:
+    if os.environ.get("RAY_TPU_LOCK_SANITIZER") == "1":
+        return True
+    try:
+        from ray_tpu._private.config import cfg
+        return bool(cfg().lock_sanitizer)
+    except Exception:
+        return False
+
+
+class LockOrderViolation(RuntimeWarning):
+    pass
+
+
+class _Graph:
+    """acquired-before edges between lock CLASSES (names) + first-seen
+    stacks. Class-level like Linux lockdep: an inversion between any
+    two instances of two classes is a discipline violation even if
+    those exact instances never deadlock. Same-class nested acquisition
+    of DISTINCT instances is skipped (would need lockdep-style nesting
+    annotations to express)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._edges: Dict[str, Set[str]] = {}
+        self._stacks: Dict[Tuple[str, str], str] = {}
+        self._reported: Set[Tuple[str, str]] = set()
+        self.violations: List[str] = []
+
+    def add(self, held: List[Tuple[str, int]], acquiring: str,
+            acquiring_id: int) -> Optional[str]:
+        with self._lock:
+            for h_name, h_id in held:
+                if h_id == acquiring_id:
+                    continue            # true re-entrancy: same instance
+                if h_name == acquiring:
+                    continue            # same class, distinct instance
+                edge = (h_name, acquiring)
+                if acquiring not in self._edges.setdefault(h_name, set()):
+                    self._edges[h_name].add(acquiring)
+                    self._stacks[edge] = "".join(
+                        traceback.format_stack(limit=8)[:-2])
+                # cycle check: does a path acquiring -> ... -> h exist?
+                if self._reaches(acquiring, h_name):
+                    if edge in self._reported:
+                        continue        # dedupe: one report per edge
+                    self._reported.add(edge)
+                    report = self._report(h_name, acquiring)
+                    self.violations.append(report)
+                    return report
+        return None
+
+    def _reaches(self, src: str, dst: str) -> bool:
+        seen = set()
+        stack = [src]
+        while stack:
+            cur = stack.pop()
+            if cur == dst:
+                return True
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(self._edges.get(cur, ()))
+        return False
+
+    def _report(self, held: str, acquiring: str) -> str:
+        fwd = self._stacks.get((held, acquiring), "<first sighting>")
+        rev = self._stacks.get((acquiring, held), "<reverse edge on a path>")
+        return (f"lock-order inversion: {held!r} -> {acquiring!r} "
+                f"conflicts with an existing {acquiring!r} ->...-> "
+                f"{held!r} path\n--- this acquisition ---\n{fwd}"
+                f"--- conflicting order first seen ---\n{rev}")
+
+    def reset(self) -> None:
+        with self._lock:
+            self._edges.clear()
+            self._stacks.clear()
+            self._reported.clear()
+            self.violations.clear()
+
+
+GRAPH = _Graph()
+_tls = threading.local()
+
+
+def _held() -> List[str]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+class TrackedLock:
+    """Lock wrapper feeding the acquired-before graph. Violations are
+    recorded (and warned) rather than raised — the sanitizer must never
+    turn a latent hazard into a live crash."""
+
+    def __init__(self, name: str, reentrant: bool = True):
+        self.name = name
+        self._lock = (threading.RLock() if reentrant
+                      else threading.Lock())
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        report = GRAPH.add(_held(), self.name, id(self))
+        if report is not None:
+            import warnings
+            warnings.warn(report, LockOrderViolation, stacklevel=2)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            _held().append((self.name, id(self)))
+        return ok
+
+    def release(self) -> None:
+        held = _held()
+        key = (self.name, id(self))
+        for i in range(len(held) - 1, -1, -1):   # last occurrence
+            if held[i] == key:
+                del held[i]
+                break
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def tracked_lock(name: str, reentrant: bool = True):
+    """A named runtime lock: sanitized when enabled, plain otherwise.
+    ``reentrant=False`` preserves plain-Lock semantics on both paths."""
+    if enabled():
+        return TrackedLock(name, reentrant=reentrant)
+    return threading.RLock() if reentrant else threading.Lock()

@@ -117,7 +117,8 @@ class LocalObjectStore:
         self.node_id = node_id
         self.capacity_bytes = capacity_bytes
         self._spill_dir = spill_dir
-        self._lock = threading.RLock()
+        from ray_tpu._private.lock_sanitizer import tracked_lock
+        self._lock = tracked_lock("object_store")
         # insertion-ordered for LRU-ish spilling
         self._entries: "OrderedDict[ObjectID, ObjectEntry]" = OrderedDict()
         self._used = 0

@@ -36,7 +36,8 @@ class Reference:
 
 class ReferenceCounter:
     def __init__(self, on_zero: Optional[Callable[[ObjectID], None]] = None):
-        self._lock = threading.RLock()
+        from ray_tpu._private.lock_sanitizer import tracked_lock
+        self._lock = tracked_lock("refcount")
         self._refs: Dict[ObjectID, Reference] = {}
         self._on_zero = on_zero
         # Per-thread deferral queue: freeing an object can drop values whose

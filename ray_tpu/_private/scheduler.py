@@ -34,7 +34,8 @@ class SchedulingError(Exception):
 
 class ClusterScheduler:
     def __init__(self):
-        self._lock = threading.Lock()
+        from ray_tpu._private.lock_sanitizer import tracked_lock
+        self._lock = tracked_lock("scheduler", reentrant=False)
         self._spread_rr = 0  # round-robin cursor for SPREAD
 
     def pick_node(self, spec: TaskSpec, nodes: List[Node],

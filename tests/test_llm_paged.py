@@ -276,16 +276,24 @@ def test_paged_decode_step_pallas_matches_xla(tiny_model):
     cfg_p = dataclasses.replace(model.cfg, decode_attention="pallas")
     model_p = LlamaModel(cfg_p)
 
-    # build a real pool state by running the engine a few steps
+    # build LIVE pool state: submit long generations and stop mid-run so
+    # slots still own real multi-block tables (a finished slot's table
+    # resets to scratch and would compare degenerate inputs)
     eng = ContinuousBatchingEngine(model, params, max_slots=2, max_seq=64,
                                    prefill_buckets=(8, 16), block_size=8)
-    eng.generate([[3, 1, 4, 1, 5], [2, 7, 2, 7, 2, 7, 2, 7, 2]],
-                 SamplingParams(max_tokens=4))
-    # replay one decode step against the surviving pool with both kernels
+    eng.submit([3, 1, 4, 1, 5], SamplingParams(max_tokens=40))
+    eng.submit([2, 7, 2, 7, 2, 7, 2, 7, 2], SamplingParams(max_tokens=40))
+    for _ in range(12):                     # grow past one block each
+        eng.step()
+    assert all(r is not None for r in eng.slots[:2])
+    tables_np = np.array(eng._tables[:2])
+    offsets_np = np.array(eng.offsets[:2])
+    assert (offsets_np > 8).all()           # >1 live block per slot
+    assert len({int(x) for x in tables_np[:, :2].ravel()}) > 2
     pool = {"k": eng.kv["k"], "v": eng.kv["v"]}
     tokens = jnp.asarray([9, 11], jnp.int32)
-    tables = jnp.asarray(np.vstack([eng._tables[:2]]), jnp.int32)
-    offsets = jnp.asarray([9, 13], jnp.int32)
+    tables = jnp.asarray(tables_np, jnp.int32)
+    offsets = jnp.asarray(offsets_np, jnp.int32)
     lx, _ = model.decode_step_paged(params, tokens, pool, tables, offsets)
     lp, _ = model_p.decode_step_paged(params, tokens, pool, tables,
                                       offsets)
