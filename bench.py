@@ -110,11 +110,15 @@ def _run_train(error: str | None) -> dict:
         steps, warmup = 10, 2
         # A/B knobs (profiling evidence drives the committed defaults)
         batch = int(os.environ.get("BENCH_BATCH", batch))
+        import dataclasses
         if os.environ.get("BENCH_REMAT") == "0":
-            object.__setattr__(cfg, "remat", False)
+            cfg = dataclasses.replace(cfg, remat=False)
+        if os.environ.get("BENCH_REMAT_POLICY"):
+            cfg = dataclasses.replace(
+                cfg, remat_policy=os.environ["BENCH_REMAT_POLICY"])
         if os.environ.get("BENCH_ATTN"):
-            object.__setattr__(cfg, "attention_impl",
-                               os.environ["BENCH_ATTN"])
+            cfg = dataclasses.replace(
+                cfg, attention_impl=os.environ["BENCH_ATTN"])
     else:  # CPU smoke path so bench.py always emits a line
         cfg = LlamaConfig.debug(vocab_size=512, max_seq_len=256)
         batch, seq = 2, 256

@@ -45,6 +45,10 @@ class LlamaConfig:
     tie_embeddings: bool = False
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # remat policy: "full" recomputes everything (min HBM, +2N FLOPs);
+    # "dots" saves matmul outputs (recompute only elementwise — near-6N
+    # useful FLOPs at higher HBM); the standard TPU MFU/memory dial.
+    remat_policy: str = "full"
     # Attention implementation (SURVEY §5.7):
     # "ring" = ppermute K/V rotation CP (any head count, O(S/sp) memory);
     # "ulysses" = all-to-all head/seq swap CP (needs n_heads % sp == 0,
@@ -62,6 +66,10 @@ class LlamaConfig:
             raise ValueError(
                 f"attention_impl must be 'ring', 'ulysses' or 'flash', "
                 f"got {self.attention_impl!r}")
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"remat_policy must be 'full' or 'dots', "
+                f"got {self.remat_policy!r}")
         if self.decode_attention not in ("xla", "pallas"):
             raise ValueError(
                 f"decode_attention must be 'xla' or 'pallas', "
@@ -293,7 +301,11 @@ class LlamaModel:
 
         block = self._block
         if cfg.remat:
-            block = jax.checkpoint(block, static_argnums=())
+            if cfg.remat_policy == "dots":
+                block = jax.checkpoint(
+                    block, policy=jax.checkpoint_policies.dots_saveable)
+            else:
+                block = jax.checkpoint(block, static_argnums=())
 
         def scan_body(x, layer):
             return block(x, layer, positions), None

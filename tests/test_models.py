@@ -1,5 +1,6 @@
 """Model layer: Llama forward/loss/sharded training, MLP."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -102,3 +103,27 @@ def test_graft_entry_contract():
     out = jax.jit(fn)(*args)
     assert np.all(np.isfinite(np.asarray(out)))
     ge.dryrun_multichip(8)
+
+
+def test_llama_remat_policy_dots_matches_full():
+    """remat_policy='dots' (save matmul outputs) must be numerically
+    identical to full remat — it only changes what is recomputed."""
+    import dataclasses
+
+    import numpy as np
+
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel
+    cfg_full = LlamaConfig.debug(vocab_size=128, max_seq_len=32)
+    cfg_full = dataclasses.replace(cfg_full, remat=True)
+    cfg_dots = dataclasses.replace(cfg_full, remat_policy="dots")
+    m_full, m_dots = LlamaModel(cfg_full), LlamaModel(cfg_dots)
+    params = m_full.init(jax.random.key(0))
+    toks = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    tgts = jnp.roll(toks, -1, axis=1)
+    gf = jax.grad(lambda p: m_full.loss(p, toks, tgts))(params)
+    gd = jax.grad(lambda p: m_dots.loss(p, toks, tgts))(params)
+    for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gd)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+    with pytest.raises(ValueError, match="remat_policy"):
+        dataclasses.replace(cfg_full, remat_policy="bogus")
