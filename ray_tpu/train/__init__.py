@@ -7,7 +7,8 @@ for *host* orchestration (data ingest, checkpoints, elasticity), while
 gradient communication is XLA collectives over ICI, not NCCL.
 """
 
-from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.checkpoint import (AsyncCheckpointer, Checkpoint,
+                                      CheckpointManager)
 from ray_tpu.train.config import (CheckpointConfig, FailureConfig, RunConfig,
                                   ScalingConfig)
 from ray_tpu.train.scaling_policy import (ElasticScalingPolicy,
@@ -20,7 +21,7 @@ from ray_tpu.train.trainer import JaxTrainer, Result
 
 __all__ = [
     "TrainStep", "make_train_step", "shard_batch",
-    "Checkpoint", "CheckpointManager",
+    "Checkpoint", "CheckpointManager", "AsyncCheckpointer",
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
     "report", "get_context", "get_checkpoint", "get_dataset_shard",
     "JaxTrainer", "Result",

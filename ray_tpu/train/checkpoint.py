@@ -45,12 +45,11 @@ class Checkpoint:
 
     # -- pytree payloads ----------------------------------------------------
     @staticmethod
-    def from_pytree(tree: Any, path: Optional[str] = None) -> "Checkpoint":
-        """Save a jax/np pytree (params, opt state, ...) to a directory."""
+    def _gather_to_host(tree: Any):
+        """Device->host copy (the only part that must block the train
+        step — after it, params may be donated/mutated freely)."""
         import jax
 
-        path = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
-        os.makedirs(path, exist_ok=True)
         leaves, treedef = jax.tree.flatten(tree)
         arrays = {}
         scalars: Dict[str, Any] = {}
@@ -68,10 +67,22 @@ class Checkpoint:
                 arrays[f"a{i}"] = arr
             else:
                 scalars[f"a{i}"] = leaf
+        return arrays, {"treedef": treedef, "scalars": scalars,
+                        "dtypes": dtypes, "n_leaves": len(leaves)}
+
+    @staticmethod
+    def _write(path: str, arrays, meta) -> None:
+        os.makedirs(path, exist_ok=True)
         np.savez(os.path.join(path, "leaves.npz"), **arrays)
         with open(os.path.join(path, "treedef.pkl"), "wb") as f:
-            pickle.dump({"treedef": treedef, "scalars": scalars,
-                         "dtypes": dtypes, "n_leaves": len(leaves)}, f)
+            pickle.dump(meta, f)
+
+    @staticmethod
+    def from_pytree(tree: Any, path: Optional[str] = None) -> "Checkpoint":
+        """Save a jax/np pytree (params, opt state, ...) to a directory."""
+        path = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        arrays, meta = Checkpoint._gather_to_host(tree)
+        Checkpoint._write(path, arrays, meta)
         return Checkpoint(path)
 
     def to_pytree(self, shardings: Any = None) -> Any:
@@ -165,3 +176,67 @@ class CheckpointManager:
         dirs = sorted(d for d in os.listdir(root)
                       if d.startswith("checkpoint_"))
         return Checkpoint(os.path.join(root, dirs[-1])) if dirs else None
+
+
+class AsyncCheckpointer:
+    """Async checkpoint saves (reference capability: ray.train's
+    orbax-style async checkpointing / `AsyncCheckpointer`): ``save``
+    blocks ONLY for the device->host gather — the params may be donated
+    to the next step immediately — while serialization + disk IO run on
+    a background writer thread. ``wait_until_finished`` joins pending
+    writes (call before shutdown or before trusting the files); errors
+    surface there and on the returned checkpoint's ``result()``.
+    """
+
+    def __init__(self, max_pending: int = 2):
+        import queue as _queue
+        import threading as _threading
+
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=max_pending)
+        self._errors: list = []
+        self._idle = _threading.Event()
+        self._idle.set()
+
+        def writer():
+            while True:
+                item = self._q.get()
+                if item is None:
+                    return
+                path, arrays, meta, done = item
+                try:
+                    Checkpoint._write(path, arrays, meta)
+                except BaseException as e:  # noqa: BLE001 — surfaced
+                    self._errors.append(e)
+                finally:
+                    done.set()
+                    if self._q.empty():
+                        self._idle.set()
+
+        self._thread = _threading.Thread(target=writer, daemon=True,
+                                         name="async-ckpt-writer")
+        self._thread.start()
+
+    def save(self, tree, path: Optional[str] = None) -> Checkpoint:
+        """Gather to host synchronously, enqueue the write, return the
+        (pending) checkpoint handle immediately."""
+        import tempfile as _tempfile
+        import threading as _threading
+
+        path = path or _tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        arrays, meta = Checkpoint._gather_to_host(tree)
+        done = _threading.Event()
+        self._idle.clear()
+        self._q.put((path, arrays, meta, done))
+        ckpt = Checkpoint(path)
+        ckpt._pending = done       # to_pytree/result can wait on it
+        return ckpt
+
+    def wait_until_finished(self, timeout: Optional[float] = None) -> None:
+        if not self._idle.wait(timeout):
+            raise TimeoutError("async checkpoint writes still pending")
+        if self._errors:
+            raise self._errors[0]
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=10)
