@@ -14,7 +14,8 @@ from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
                                    from_pandas, range, read_binary_files,
                                    read_csv, read_images, read_json,
                                    read_numpy, read_parquet, read_sql,
-                                   read_text, read_webdataset)
+                                   read_text, read_tfrecords,
+                                   read_webdataset)
 
 __all__ = [
     "Dataset", "GroupedData", "DataIterator",
@@ -24,6 +25,7 @@ __all__ = [
     "read_images",
     "read_numpy",
     "read_sql",
+    "read_tfrecords",
     "read_webdataset",
     "Count", "Sum", "Min", "Max", "Mean", "Std",
 ]

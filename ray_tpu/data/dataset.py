@@ -398,6 +398,23 @@ class Dataset:
                 with fs.open_output(f"{local}/part-{i:05d}.npy") as f:
                     f.write(buf.getvalue())
 
+    def write_tfrecords(self, path: str) -> None:
+        """One TFRecord shard per block, rows as tf.train.Example
+        (crc32c-framed; no TensorFlow — data/tfrecords.py)."""
+        from ray_tpu.data.filesystem import resolve_filesystem
+        from ray_tpu.data.tfrecords import (encode_example,
+                                            write_tfrecord_frame)
+        fs, local = resolve_filesystem(path)
+        fs.makedirs(local)
+        for i, block in enumerate(self.iter_blocks()):
+            if not block.num_rows:
+                continue
+            frames = b"".join(
+                write_tfrecord_frame(encode_example(row))
+                for row in block.to_pylist())
+            with fs.open_output(f"{local}/part-{i:05d}.tfrecord") as f:
+                f.write(frames)
+
     def write_webdataset(self, path: str) -> None:
         """One WebDataset tar shard per block: each row becomes a
         sample keyed by its ``__key__`` column (or the row index), with

@@ -233,6 +233,30 @@ def read_sql(sql: str, connection_factory, *,
     return Dataset(L.Read("read_sql", [], read_tasks=tasks))
 
 
+def read_tfrecords(paths) -> Dataset:
+    """TFRecord shards of tf.train.Example protos, WITHOUT TensorFlow
+    (reference: `data/read_api.py` read_tfrecords imports TF; this
+    image has none — `data/tfrecords.py` speaks the framing + proto
+    wire format directly, crc-checked)."""
+    from ray_tpu.data.tfrecords import (decode_example,
+                                        read_tfrecord_frames)
+
+    def reader(f):
+        with _seam_open(f) as fh:
+            blob = fh.read()
+        rows = [decode_example(p) for p in read_tfrecord_frames(blob)]
+        # features stay LISTS (proto semantics): any unwrap heuristic
+        # is per-file and would disagree across shards of one dataset
+        all_cols = {c for r in rows for c in r}
+        for r in rows:
+            for c in all_cols:
+                r.setdefault(c, None)
+        return block_from_rows(rows)
+
+    return _file_read_dataset(paths, ".tfrecord", reader,
+                              "read_tfrecords")
+
+
 def read_webdataset(paths) -> Dataset:
     """WebDataset tar shards: files grouped by basename stem into one
     row per sample, keyed by extension (reference: `data/read_api.py`

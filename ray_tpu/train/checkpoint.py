@@ -26,8 +26,23 @@ import numpy as np
 class Checkpoint:
     """A handle to a checkpoint directory."""
 
+    # async-save state (set by AsyncCheckpointer.save)
+    _pending = None
+    _pending_error: Optional[BaseException] = None
+
     def __init__(self, path: str):
         self.path = os.path.abspath(path)
+
+    def result(self, timeout: Optional[float] = None) -> "Checkpoint":
+        """Wait for a pending async write; raises its error if it
+        failed. Synchronous checkpoints return immediately."""
+        if self._pending is not None:
+            if not self._pending.wait(timeout):
+                raise TimeoutError(
+                    f"checkpoint write to {self.path} still pending")
+            if self._pending_error is not None:
+                raise self._pending_error
+        return self
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
@@ -87,8 +102,11 @@ class Checkpoint:
 
     def to_pytree(self, shardings: Any = None) -> Any:
         """Restore; with ``shardings`` (matching pytree of NamedSharding)
-        leaves are placed sharded directly."""
+        leaves are placed sharded directly. A pending async write is
+        joined first — never reads half-written files."""
         import jax
+
+        self.result()
 
         with open(os.path.join(self.path, "treedef.pkl"), "rb") as f:
             meta = pickle.load(f)
@@ -194,23 +212,28 @@ class AsyncCheckpointer:
 
         self._q: "_queue.Queue" = _queue.Queue(maxsize=max_pending)
         self._errors: list = []
-        self._idle = _threading.Event()
-        self._idle.set()
+        # pending counter under one lock — no Event/empty() TOCTOU:
+        # wait_until_finished must never vouch for an unwritten ckpt
+        self._cond = _threading.Condition()
+        self._pending_count = 0
 
         def writer():
             while True:
                 item = self._q.get()
                 if item is None:
                     return
-                path, arrays, meta, done = item
+                ckpt, arrays, meta = item
                 try:
-                    Checkpoint._write(path, arrays, meta)
+                    Checkpoint._write(ckpt.path, arrays, meta)
                 except BaseException as e:  # noqa: BLE001 — surfaced
-                    self._errors.append(e)
+                    ckpt._pending_error = e
+                    with self._cond:
+                        self._errors.append(e)
                 finally:
-                    done.set()
-                    if self._q.empty():
-                        self._idle.set()
+                    ckpt._pending.set()
+                    with self._cond:
+                        self._pending_count -= 1
+                        self._cond.notify_all()
 
         self._thread = _threading.Thread(target=writer, daemon=True,
                                          name="async-ckpt-writer")
@@ -224,18 +247,26 @@ class AsyncCheckpointer:
 
         path = path or _tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
         arrays, meta = Checkpoint._gather_to_host(tree)
-        done = _threading.Event()
-        self._idle.clear()
-        self._q.put((path, arrays, meta, done))
         ckpt = Checkpoint(path)
-        ckpt._pending = done       # to_pytree/result can wait on it
+        ckpt._pending = _threading.Event()
+        with self._cond:
+            self._pending_count += 1
+        self._q.put((ckpt, arrays, meta))
         return ckpt
 
     def wait_until_finished(self, timeout: Optional[float] = None) -> None:
-        if not self._idle.wait(timeout):
-            raise TimeoutError("async checkpoint writes still pending")
-        if self._errors:
-            raise self._errors[0]
+        """Join all writes enqueued so far; raises the FIRST error since
+        the last call (then clears it — a later successful save is not
+        poisoned by an old failure)."""
+        with self._cond:
+            if not self._cond.wait_for(
+                    lambda: self._pending_count == 0, timeout):
+                raise TimeoutError(
+                    "async checkpoint writes still pending")
+            if self._errors:
+                err = self._errors[0]
+                self._errors.clear()
+                raise err
 
     def close(self) -> None:
         self._q.put(None)

@@ -97,3 +97,54 @@ def test_write_numpy_roundtrip(ray_start_regular, tmp_path):
     back = data.read_numpy(out + "/*.npy", column="v").take_all()
     got = np.sort(np.asarray([r["v"] for r in back]))
     np.testing.assert_array_equal(got, arr)
+
+
+def test_tfrecords_roundtrip(ray_start_regular, tmp_path):
+    """TFRecord write/read without TensorFlow: bytes/float/int features,
+    scalars and lists, crc-framed."""
+    out = str(tmp_path / "tfr")
+    rows = [{"name": f"r{i}", "img": bytes([i, i + 1]),
+             "score": i * 0.5, "labels": [i, i * 2], "neg": -i}
+            for i in range(8)]
+    data.from_items(rows).write_tfrecords(out)
+    back = sorted(data.read_tfrecords(out + "/*.tfrecord").take_all(),
+                  key=lambda r: r["name"])
+    assert len(back) == 8
+    r3 = back[3]
+    # features are ALWAYS lists (proto semantics, shard-consistent)
+    assert r3["name"] == [b"r3"]          # strings round-trip as bytes
+    assert r3["img"] == [bytes([3, 4])]
+    assert abs(r3["score"][0] - 1.5) < 1e-6
+    assert r3["labels"] == [3, 6]
+    assert r3["neg"] == [-3]               # negative int64 varint
+
+
+def test_tfrecords_crc_detects_corruption(tmp_path):
+    from ray_tpu.data.tfrecords import (decode_example, encode_example,
+                                        read_tfrecord_frames,
+                                        write_tfrecord_frame)
+    payload = encode_example({"a": 1})
+    frame = bytearray(write_tfrecord_frame(payload))
+    assert decode_example(next(read_tfrecord_frames(bytes(frame)))) == \
+        {"a": [1]}                         # decode keeps proto lists
+    frame[14] ^= 0xFF                      # flip a payload byte
+    with pytest.raises(ValueError, match="crc"):
+        list(read_tfrecord_frames(bytes(frame)))
+    # truncation raises the same error family, not struct.error
+    with pytest.raises(ValueError, match="truncated"):
+        list(read_tfrecord_frames(bytes(
+            write_tfrecord_frame(payload))[:-2]))
+    # out-of-int64-range values are rejected, not silently wrapped
+    with pytest.raises(ValueError, match="int64"):
+        encode_example({"x": 2 ** 63})
+
+
+def test_tfrecords_ragged_list_column(ray_start_regular, tmp_path):
+    """A column mixing 1-element and longer lists stays ALL lists
+    (per-row unwrapping would crash Arrow on mixed types)."""
+    out = str(tmp_path / "ragged")
+    data.from_items([{"labels": [5]}, {"labels": [1, 2]}]
+                    ).write_tfrecords(out)
+    back = data.read_tfrecords(out + "/*.tfrecord").take_all()
+    assert sorted(back, key=lambda r: len(r["labels"])) == \
+        [{"labels": [5]}, {"labels": [1, 2]}]

@@ -197,3 +197,27 @@ def test_async_checkpointer_overlaps_and_roundtrips(tmp_path):
         assert (tmp_path / "b" / "leaves.npz").exists()
     finally:
         ck.close()
+
+
+def test_async_checkpointer_error_handling(tmp_path):
+    """A failed write surfaces on result() and once on
+    wait_until_finished, without poisoning later successful saves."""
+    from ray_tpu.train import AsyncCheckpointer
+
+    ck = AsyncCheckpointer()
+    try:
+        blocked = tmp_path / "blocked"
+        blocked.write_text("a file where a directory must go")
+        bad = ck.save({"w": jnp.ones(4)}, str(blocked / "sub"))
+        with pytest.raises(Exception):
+            bad.result(timeout=30)
+        with pytest.raises(Exception):
+            ck.wait_until_finished(timeout=30)
+        # error cleared: a later good save is not poisoned
+        good = ck.save({"w": jnp.ones(4)}, str(tmp_path / "good"))
+        ck.wait_until_finished(timeout=30)
+        assert good.result().path
+        np.testing.assert_array_equal(
+            np.asarray(good.to_pytree()["w"]), np.ones(4))
+    finally:
+        ck.close()
