@@ -54,18 +54,23 @@ class LlamaConfig:
     # "ulysses" = all-to-all head/seq swap CP (needs n_heads % sp == 0,
     # local full-sequence attention so any local kernel applies);
     # "flash" = single-device Pallas flash kernel (ops/attention.py) —
-    # the MFU path for sp==1 (bench default); interpret-mode on CPU.
+    # the MFU path for sp==1 (bench default); interpret-mode on CPU;
+    # "xla" = blockwise online-softmax in pure XLA (O(S·block) memory)
+    # — the A/B baseline the Pallas kernel must beat.
     attention_impl: str = "ring"
+    # Pallas flash tile sizes (the per-grid-step overhead vs VMEM dial)
+    flash_block_q: int = 128
+    flash_block_k: int = 128
     # KV-cache decode attention: "xla" masked fallback or the "pallas"
     # ragged kernel (skips KV blocks past each slot's length —
     # ops/decode_attention.py).
     decode_attention: str = "xla"
 
     def __post_init__(self):
-        if self.attention_impl not in ("ring", "ulysses", "flash"):
+        if self.attention_impl not in ("ring", "ulysses", "flash", "xla"):
             raise ValueError(
-                f"attention_impl must be 'ring', 'ulysses' or 'flash', "
-                f"got {self.attention_impl!r}")
+                f"attention_impl must be 'ring', 'ulysses', 'flash' or "
+                f"'xla', got {self.attention_impl!r}")
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
                 f"remat_policy must be 'full' or 'dots', "
@@ -263,12 +268,21 @@ class LlamaModel:
                                                  causal=True)
             from ray_tpu.ops.ring_attention import ring_attention_sharded
             return ring_attention_sharded(q, k, v, self.mesh, causal=True)
-        # sp==1: "flash" forces the Pallas kernel (interpret-mode off-TPU);
-        # otherwise the dispatcher auto-selects by platform/shape.
-        use_flash = (True if (self.cfg.attention_impl == "flash"
-                              and positions is None) else None)
+        # sp==1: "flash" forces the Pallas kernel (interpret-mode
+        # off-TPU) with the config's tile sizes; "xla" forces the
+        # blockwise online-softmax fallback; otherwise the dispatcher
+        # auto-selects by platform/shape.
+        cfg = self.cfg
+        if cfg.attention_impl == "flash" and positions is None:
+            from ray_tpu.ops.attention import flash_attention
+            # positional: custom_vjp functions reject keyword args
+            return flash_attention(q, k, v, True, cfg.flash_block_q,
+                                   cfg.flash_block_k)
+        if cfg.attention_impl == "xla" and positions is None:
+            from ray_tpu.ops.attention import blockwise_attention
+            return blockwise_attention(q, k, v, causal=True)
         return attention(q, k, v, causal=True, positions_q=positions,
-                         positions_k=positions, use_flash=use_flash)
+                         positions_k=positions, use_flash=None)
 
     def _block(self, x, layer: Params, positions):
         cfg = self.cfg

@@ -102,14 +102,20 @@ def stage_attn_bwd():
          xla_ms=round(timeit(gxl, q, n=5) * 1e3, 2))
 
 
-def _bench_model(remat=True, attn="flash", batch=8):
+def _bench_model(remat=True, attn="flash", batch=8, fb=None,
+                 remat_policy="full"):
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
     import numpy as np
     from ray_tpu.models.llama import LlamaConfig, LlamaModel
     cfg = LlamaConfig.bench_400m()
-    object.__setattr__(cfg, "remat", remat)
-    object.__setattr__(cfg, "attention_impl", attn)
+    cfg = dataclasses.replace(cfg, remat=remat, attention_impl=attn,
+                              remat_policy=remat_policy)
+    if fb:
+        cfg = dataclasses.replace(cfg, flash_block_q=fb,
+                                  flash_block_k=fb)
     model = LlamaModel(cfg)
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, 2048)),
@@ -129,10 +135,12 @@ def stage_fwd():
          fwd_tflops=round(flops / dt / 1e12, 1))
 
 
-def run_step(stage, remat=True, attn="flash", batch=8):
+def run_step(stage, remat=True, attn="flash", batch=8, fb=None,
+             remat_policy="full"):
     import jax
     from ray_tpu.train.spmd import make_train_step
-    cfg, model, tokens, targets = _bench_model(remat, attn, batch)
+    cfg, model, tokens, targets = _bench_model(remat, attn, batch, fb,
+                                               remat_policy)
     ts = make_train_step(model)
     p, o = ts.init_fn(jax.random.key(0))
     bt = (tokens, targets)
@@ -160,6 +168,9 @@ STAGES = {
     "step_nr": lambda: run_step("step_nr", remat=False),
     "step_xla": lambda: run_step("step_xla", attn="xla"),
     "step_b16": lambda: run_step("step_b16", batch=16),
+    "step_fb256": lambda: run_step("step_fb256", fb=256),
+    "step_fb512": lambda: run_step("step_fb512", fb=512),
+    "step_dots": lambda: run_step("step_dots", remat_policy="dots"),
 }
 
 

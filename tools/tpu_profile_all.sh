@@ -8,7 +8,7 @@ OUT=tools/evidence/tpu_perf_probes.log
 mkdir -p tools/evidence
 echo "=== $(date '+%F %T') profile run ===" >> "$OUT"
 got=1
-for stage in matmul dispatch attn attn_bwd fwd step step_nr step_xla step_b16; do
+for stage in matmul dispatch attn attn_bwd fwd step step_xla step_fb256 step_fb512 step_dots step_nr step_b16; do
   echo "--- $stage $(date '+%T')" >> "$OUT"
   if timeout -k 5 300 python tools/tpu_perf_probe.py "$stage" >> "$OUT" 2>&1; then
     got=0
