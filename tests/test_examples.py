@@ -37,3 +37,10 @@ def test_serve_llama_example(ray_start_regular):
     import serve_llama
     out = serve_llama.main()
     assert out["usage"]["completion_tokens"] == 8
+
+
+def test_compiled_dag_pipeline_example(ray_start_regular):
+    import compiled_dag_pipeline
+    outs = compiled_dag_pipeline.main(rounds=20)
+    assert len(outs) == 20
+    assert all(isinstance(o, float) for o in outs)
