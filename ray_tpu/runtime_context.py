@@ -1,0 +1,7 @@
+"""Runtime context — the reference's import path (`ray.runtime_context`)
+re-exporting the canonical implementation."""
+
+from ray_tpu._private.runtime_context import (RuntimeContext,
+                                              get_runtime_context)
+
+__all__ = ["RuntimeContext", "get_runtime_context"]

@@ -1,0 +1,9 @@
+"""Scheduling strategies — the reference's import path
+(`ray.util.scheduling_strategies`) re-exporting the canonical classes
+from the task-spec module (where the scheduler consumes them)."""
+
+from ray_tpu._private.task_spec import (NodeAffinitySchedulingStrategy,
+                                        PlacementGroupSchedulingStrategy)
+
+__all__ = ["NodeAffinitySchedulingStrategy",
+           "PlacementGroupSchedulingStrategy"]
