@@ -70,6 +70,32 @@ def run_microbenchmarks(duration_s: float = 2.0) -> List[Dict]:
     results.append(_timeit("put_get_1MiB_per_second", put_get_1mb,
                            duration_s))
 
+    # compiled-DAG shm-channel rounds (zero-RPC steady state) through a
+    # 2-stage process-worker pipeline; in daemons mode the actors are
+    # daemon-remote so the DAG legitimately falls back to the dynamic
+    # schedule — the row then measures THAT path (labeled by mode).
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class _Stage:
+        def f(self, x):
+            return x + 1
+
+    s1, s2 = _Stage.remote(), _Stage.remote()
+    ray_tpu.get([s1.f.remote(0), s2.f.remote(0)])
+    with InputNode() as inp:
+        dag = s2.f.bind(s1.f.bind(inp))
+    compiled = dag.experimental_compile()
+
+    def dag_rounds():
+        refs = [compiled.execute(i) for i in range(50)]
+        for r in refs:
+            ray_tpu.get(r)
+        return 50
+    results.append(_timeit("compiled_dag_execs_per_second", dag_rounds,
+                           duration_s))
+    compiled.teardown()
+
     if own:
         ray_tpu.shutdown()
     return results
