@@ -144,6 +144,9 @@ def main() -> int:
         print(json.dumps(row))
         sys.stdout.flush()
     print(json.dumps(queued_task_drain(drain_n)))
+    sys.stdout.flush()
+    # scaling TREND: does the drain rate hold at 3x the backlog?
+    print(json.dumps(queued_task_drain(3 * drain_n)))
     return 0
 
 
