@@ -8,7 +8,10 @@ OUT=tools/evidence/tpu_perf_probes.log
 mkdir -p tools/evidence
 echo "=== $(date '+%F %T') profile run ===" >> "$OUT"
 got=1
-for stage in matmul dispatch attn attn_bwd fwd step step_xla step_fb256 step_fb512 step_dots step_nr step_b16; do
+# MFU localizers first: a tunnel window may be short (the 03:18 window
+# lasted ~25 min) — matmul roofline, attention split, and the three
+# biggest step A/Bs must land before the nice-to-haves.
+for stage in matmul attn attn_bwd step step_fb512 step_xla step_fb256 step_dots fwd dispatch step_nr step_b16; do
   echo "--- $stage $(date '+%T')" >> "$OUT"
   if timeout -k 5 300 python tools/tpu_perf_probe.py "$stage" >> "$OUT" 2>&1; then
     got=0
