@@ -1,0 +1,41 @@
+"""bench.py harness smoke: the CPU paths must keep emitting valid
+JSON lines (the driver runs these on real hardware — a harness
+regression would silently cost the round its headline numbers)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+
+def test_serving_bench_cpu_smoke():
+    from ray_tpu.llm.bench import run_serving_bench
+
+    out = run_serving_bench()
+    assert out["metric"] == "llm_serve_output_tokens_per_sec"
+    assert out["value"] > 0
+    d = out["detail"]
+    assert d["requests"] == 6 and d["output_tokens"] > 0
+    assert d["prefix_prefills"] >= 1          # prefix phase exercised
+    assert d["prefix_tokens_reused"] > 0
+    assert np.isfinite(d["ttft_prefix_hit_p50_ms"])
+
+
+def test_train_bench_child_cpu_smoke():
+    """The --child CPU fallback end-to-end in a fresh process (what the
+    driver's last-resort path runs)."""
+    env = dict(os.environ)
+    env["BENCH_FORCE_CPU"] = "1"
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "--child"],
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    line = [l for l in proc.stdout.splitlines() if l.strip()][-1]
+    out = json.loads(line)
+    assert out["metric"] == "llama_train_tokens_per_sec_per_chip"
+    assert out["value"] > 0
+    assert out["detail"]["config"] == "debug"
