@@ -28,6 +28,11 @@ class LLMConfig:
     num_replicas: int = 1
     max_ongoing_requests: int = 64
     seed: int = 0
+    # paged KV pool (reference: vLLM cache config surface,
+    # `vllm_models.py:126-207`): block granularity and total pool size;
+    # num_blocks=None sizes the pool to max_slots * max_seq
+    block_size: int = 32
+    num_blocks: Optional[int] = None
 
 
 class LLMServer:
@@ -47,7 +52,8 @@ class LLMServer:
                           if config.tokenizer else ByteTokenizer())
         self.engine = ContinuousBatchingEngine(
             self.model, params, max_slots=config.max_slots,
-            max_seq=config.max_seq)
+            max_seq=config.max_seq, block_size=config.block_size,
+            num_blocks=config.num_blocks)
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self.engine.run_forever, args=(self._stop,), daemon=True)
