@@ -36,7 +36,9 @@ class PrefillServer:
         from ray_tpu.llm.engine import ContinuousBatchingEngine
         model, params = _build_model(config)
         self.engine = ContinuousBatchingEngine(
-            model, params, max_slots=1, max_seq=config.max_seq)
+            model, params, max_slots=1, max_seq=config.max_seq,
+            block_size=config.block_size,
+            num_blocks=config.num_blocks)
         self.tokenizer = (load_tokenizer(config.tokenizer)
                           if config.tokenizer else ByteTokenizer())
 
@@ -55,7 +57,8 @@ class DecodeServer:
         model, params = _build_model(config)
         self.engine = ContinuousBatchingEngine(
             model, params, max_slots=config.max_slots,
-            max_seq=config.max_seq)
+            max_seq=config.max_seq, block_size=config.block_size,
+            num_blocks=config.num_blocks)
         self.tokenizer = (load_tokenizer(config.tokenizer)
                           if config.tokenizer else ByteTokenizer())
         self._stop = threading.Event()

@@ -108,11 +108,18 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"no prefill bucket fits max_seq={max_seq}: "
                 f"{prefill_buckets}")
-        if self.buckets:
+        if self.buckets and block_size > self.buckets[0]:
             # prefill scatters whole buckets into blocks, so every
             # bucket must be block-aligned; shrink toward the smallest
-            # bucket rather than reject tiny test configs
-            block_size = min(block_size, self.buckets[0])
+            # bucket rather than reject tiny test configs — LOUDLY,
+            # because a caller who sized num_blocks for the requested
+            # block_size would otherwise get half the KV pool silently
+            import warnings
+            warnings.warn(
+                f"block_size={block_size} exceeds the smallest prefill "
+                f"bucket {self.buckets[0]}; using {self.buckets[0]} — "
+                f"resize num_blocks accordingly", stacklevel=2)
+            block_size = self.buckets[0]
         for b in self.buckets:
             if b % block_size != 0:
                 raise ValueError(
