@@ -96,7 +96,7 @@ class ContinuousBatchingEngine:
     def __init__(self, model, params, *, max_slots: int = 32,
                  max_seq: int = 1024,
                  prefill_buckets: tuple = (32, 64, 128, 256, 512),
-                 block_size: int = 32,
+                 block_size: Optional[int] = None,
                  num_blocks: Optional[int] = None):
         self.model = model
         self.params = params
@@ -108,17 +108,21 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"no prefill bucket fits max_seq={max_seq}: "
                 f"{prefill_buckets}")
+        requested = block_size
+        block_size = requested if requested is not None else 32
         if self.buckets and block_size > self.buckets[0]:
             # prefill scatters whole buckets into blocks, so every
             # bucket must be block-aligned; shrink toward the smallest
-            # bucket rather than reject tiny test configs — LOUDLY,
-            # because a caller who sized num_blocks for the requested
-            # block_size would otherwise get half the KV pool silently
-            import warnings
-            warnings.warn(
-                f"block_size={block_size} exceeds the smallest prefill "
-                f"bucket {self.buckets[0]}; using {self.buckets[0]} — "
-                f"resize num_blocks accordingly", stacklevel=2)
+            # bucket. LOUD only for an EXPLICIT request — a caller who
+            # sized num_blocks for that granularity would otherwise get
+            # half the KV pool silently (the default just adapts).
+            if requested is not None:
+                import warnings
+                warnings.warn(
+                    f"block_size={requested} exceeds the smallest "
+                    f"prefill bucket {self.buckets[0]}; using "
+                    f"{self.buckets[0]} — resize num_blocks "
+                    f"accordingly", stacklevel=2)
             block_size = self.buckets[0]
         for b in self.buckets:
             if b % block_size != 0:

@@ -31,7 +31,7 @@ class LLMConfig:
     # paged KV pool (reference: vLLM cache config surface,
     # `vllm_models.py:126-207`): block granularity and total pool size;
     # num_blocks=None sizes the pool to max_slots * max_seq
-    block_size: int = 32
+    block_size: Optional[int] = None   # None -> engine default (32)
     num_blocks: Optional[int] = None
 
 
