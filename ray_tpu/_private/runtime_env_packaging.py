@@ -128,6 +128,14 @@ _PREPARED: Dict[int, Tuple[tuple, dict, float]] = {}
 _PREPARED_TTL = 0.25   # seconds between tree re-validations
 
 
+def _job_default_runtime_env():
+    from ray_tpu._private import worker
+
+    rt = worker.global_runtime()
+    jc = getattr(rt, "job_config", None) if rt is not None else None
+    return jc.runtime_env if jc is not None else None
+
+
 def prepare_runtime_env(runtime_env):
     """Driver-side, at submission: package directory-valued
     working_dir/py_modules into pkg:// URIs so the env materializes on
@@ -141,6 +149,11 @@ def prepare_runtime_env(runtime_env):
     mtime and skips re-zipping when unchanged) can see them. The TTL
     amortizes that walk over hot submission loops without letting
     workers run stale code for the process lifetime."""
+    if not runtime_env:
+        # job-level default (reference: JobConfig.runtime_env applied
+        # when a task/actor declares none — job_config.py serialize ->
+        # worker.py connect)
+        runtime_env = _job_default_runtime_env()
     if not runtime_env:
         return runtime_env
     import time as _time

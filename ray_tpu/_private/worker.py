@@ -137,10 +137,21 @@ class Runtime:
                  namespace: Optional[str] = None,
                  session_dir: Optional[str] = None,
                  cluster: Optional[str] = None,
-                 address: Optional[str] = None):
+                 address: Optional[str] = None,
+                 job_config=None):
         self.job_id = JobID.from_random()
         self.worker_id = WorkerID.from_random()
         self.namespace = namespace or self.job_id.hex()
+        # per-job config (reference: JobConfig serialized at connect —
+        # worker.py:2347): job-default runtime env consumed by
+        # prepare_runtime_env; code_search_path joins sys.path
+        self.job_config = job_config
+        if job_config is not None:
+            import sys as _sys
+            for p in job_config.code_search_path:
+                p = os.path.abspath(p)
+                if p not in _sys.path:
+                    _sys.path.insert(0, p)
         self.session_dir = session_dir or os.path.join(
             "/tmp", "ray_tpu", f"session_{self.job_id.hex()}")
         os.makedirs(self.session_dir, exist_ok=True)

@@ -3,7 +3,9 @@
 from the task-spec module (where the scheduler consumes them)."""
 
 from ray_tpu._private.task_spec import (NodeAffinitySchedulingStrategy,
+                                        NodeLabelSchedulingStrategy,
                                         PlacementGroupSchedulingStrategy)
 
 __all__ = ["NodeAffinitySchedulingStrategy",
+           "NodeLabelSchedulingStrategy",
            "PlacementGroupSchedulingStrategy"]
