@@ -132,8 +132,7 @@ def _job_default_runtime_env():
     from ray_tpu._private import worker
 
     rt = worker.global_runtime()
-    jc = getattr(rt, "job_config", None) if rt is not None else None
-    return jc.runtime_env if jc is not None else None
+    return getattr(rt, "_job_default_env", None)
 
 
 def prepare_runtime_env(runtime_env):

@@ -144,14 +144,25 @@ class Runtime:
         self.namespace = namespace or self.job_id.hex()
         # per-job config (reference: JobConfig serialized at connect —
         # worker.py:2347): job-default runtime env consumed by
-        # prepare_runtime_env; code_search_path joins sys.path
+        # prepare_runtime_env. code_search_path rides that env as
+        # py_modules — PRE-EXISTING pool workers (forked before this
+        # init) never see driver sys.path edits, but py_modules
+        # materialize per task in any worker; it also joins the
+        # driver's own sys.path for local imports.
         self.job_config = job_config
+        self._job_default_env = None
         if job_config is not None:
             import sys as _sys
-            for p in job_config.code_search_path:
-                p = os.path.abspath(p)
-                if p not in _sys.path:
-                    _sys.path.insert(0, p)
+            env = dict(job_config.runtime_env or {})
+            if job_config.code_search_path:
+                paths = [os.path.abspath(p)
+                         for p in job_config.code_search_path]
+                env["py_modules"] = (list(env.get("py_modules") or [])
+                                     + paths)
+                for p in paths:
+                    if p not in _sys.path:
+                        _sys.path.insert(0, p)
+            self._job_default_env = env or None
         self.session_dir = session_dir or os.path.join(
             "/tmp", "ray_tpu", f"session_{self.job_id.hex()}")
         os.makedirs(self.session_dir, exist_ok=True)
