@@ -372,6 +372,24 @@ class CompiledDAG:
         proc["rounds"] = rounds
         outputs = proc["outputs"]
 
+        def read_output(ch):
+            # short-poll reads + liveness checks: a DEAD stage worker
+            # must fail the round promptly, not after a 300s channel
+            # timeout
+            deadline = 300
+            waited = 0.0
+            while True:
+                try:
+                    return ch.read(timeout=2.0)
+                except TimeoutError:
+                    waited += 2.0
+                    for instance in proc["actors"]:
+                        if instance._client.dead:
+                            raise exc.ActorDiedError(
+                                None, "compiled-DAG stage worker died")
+                    if waited >= deadline:
+                        raise
+
         def run():
             rt = worker.global_runtime()
             while True:
@@ -380,7 +398,7 @@ class CompiledDAG:
                     return
                 oid, multi = item
                 try:
-                    got = [ch.read() for ch in outputs]
+                    got = [read_output(ch) for ch in outputs]
                     err = next((v for s, v in got if s != "ok"), None)
                     if err is not None:
                         raise err

@@ -155,6 +155,34 @@ def test_superseding_compile_and_gc(ray_start_regular):
     c2.teardown()
 
 
+def test_dead_stage_worker_fails_round_promptly(ray_start_regular):
+    """SIGKILL a stage's worker process mid-DAG: the pending round must
+    fail with ActorDiedError within seconds, not a 300s channel
+    timeout."""
+    import os
+    import signal
+    import time
+
+    from ray_tpu._private import worker as _w
+
+    a, b = Stage.remote(1), Stage.remote(10)
+    with InputNode() as inp:
+        dag = b.f.bind(a.f.bind(inp))
+    c = dag.experimental_compile()
+    assert c._proc is not None
+    assert ray_tpu.get(c.execute(1), timeout=60) == 12
+
+    rt = _w.global_runtime()
+    client = rt._actor_executors[a._actor_id].instance._client
+    os.kill(client.proc.pid, signal.SIGKILL)
+    t0 = time.time()
+    ref = c.execute(2)
+    with pytest.raises(Exception, match="died"):
+        ray_tpu.get(ref, timeout=120)
+    assert time.time() - t0 < 60          # prompt, not channel-timeout
+    c.teardown()
+
+
 def test_stage_error_propagates(ray_start_regular):
     a, b = Stage.remote(1), Stage.remote(2)
     with InputNode() as inp:
