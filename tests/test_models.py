@@ -127,3 +127,25 @@ def test_llama_remat_policy_dots_matches_full():
                                    atol=1e-5, rtol=1e-5)
     with pytest.raises(ValueError, match="remat_policy"):
         dataclasses.replace(cfg_full, remat_policy="bogus")
+
+
+def test_llama_attention_impl_parity():
+    """ring / xla-blockwise / flash (custom tile sizes) must agree on
+    the loss (f32 so near-ties cannot hide real divergence)."""
+    import dataclasses
+
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel
+    base = dataclasses.replace(
+        LlamaConfig.debug(vocab_size=128, max_seq_len=64),
+        dtype=jnp.float32)
+    toks = jnp.asarray([[1, 2, 3, 4, 5, 6, 7, 8] * 8], jnp.int32)
+    tgts = jnp.roll(toks, -1, axis=1)
+    losses = {}
+    for impl in ("ring", "xla", "flash"):
+        cfg = dataclasses.replace(base, attention_impl=impl,
+                                  flash_block_q=32, flash_block_k=32)
+        m = LlamaModel(cfg)
+        p = m.init(jax.random.key(0))
+        losses[impl] = float(m.loss(p, toks, tgts))
+    assert abs(losses["xla"] - losses["ring"]) < 1e-4, losses
+    assert abs(losses["flash"] - losses["ring"]) < 1e-3, losses
