@@ -96,11 +96,36 @@ class _DashboardHandler(BaseHTTPRequestHandler):
                     "stats": dict(rt.stats),
                     "task_summary": state_api.summarize_tasks(),
                 })
+            elif path == "/api/serve":
+                # library observability (reference: dashboard serve
+                # module): live application/deployment state
+                try:
+                    from ray_tpu import serve as _serve
+                    self._json(_serve.status())
+                except Exception:
+                    self._json({"applications": {}})
+            elif path == "/api/train":
+                # train-run lifecycle (reference: dashboard train
+                # module over export_train_state.proto): export events
+                # when enabled, else a hint
+                from ray_tpu._private.export_events import \
+                    get_export_logger
+                logger = get_export_logger()
+                events = (logger.read("TRAIN_RUN")
+                          if logger is not None else None)
+                self._json({"train_runs": events or [],
+                            "export_events_enabled": logger is not None})
+            elif path == "/api/data":
+                # per-dataset operator metrics (reference: dashboard
+                # data module over StatsManager)
+                from ray_tpu.data.context import DatasetStats
+                self._json({"datasets": DatasetStats.recent()})
             elif path == "/api":
                 self._json({"endpoints": [
                     "/api/nodes", "/api/tasks", "/api/actors",
                     "/api/placement_groups", "/api/objects",
                     "/api/cluster_status", "/api/timeline", "/api/config",
+                    "/api/serve", "/api/train", "/api/data",
                     "/api/profile/cpu", "/api/profile/memory",
                     "/metrics", "/"]})
             else:

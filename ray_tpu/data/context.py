@@ -6,7 +6,8 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, List, Optional
 
 
 @dataclasses.dataclass
@@ -37,11 +38,26 @@ class DataContext:
 
 
 class DatasetStats:
-    """Per-dataset execution statistics (operator timings, block counts)."""
+    """Per-dataset execution statistics (operator timings, block counts).
+
+    Every instance also registers in a process-global ring so the
+    dashboard can surface live per-dataset operator metrics (reference:
+    `data/_internal/stats.py` StatsManager -> dashboard data module)."""
+
+    _RECENT: "List[DatasetStats]" = []
+    _RECENT_CAP = 50
 
     def __init__(self):
         self._lock = threading.Lock()
         self.operators: Dict[str, Dict[str, float]] = {}
+        self.created_at = time.time()
+        DatasetStats._RECENT.append(self)
+        del DatasetStats._RECENT[:-DatasetStats._RECENT_CAP]
+
+    @classmethod
+    def recent(cls) -> List[Dict[str, Any]]:
+        return [{"created_at": s.created_at, "operators": s.operators}
+                for s in cls._RECENT if s.operators]
 
     def record(self, op_name: str, *, blocks: int = 0, rows: int = 0,
                seconds: float = 0.0) -> None:

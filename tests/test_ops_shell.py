@@ -92,6 +92,17 @@ def test_dashboard_endpoints(ray_start_regular):
         config = json.loads(get("/api/config"))
         assert config["pull_chunk"]["value"] == cfg().pull_chunk
         assert "source" in config["memory_monitor"]
+        # library observability endpoints (reference: dashboard
+        # serve/train/data modules)
+        from ray_tpu import data as _data
+        (_data.from_items([{"x": i} for i in range(6)])
+         .map(lambda r: r).take_all())   # executor path records stats
+        ds_stats = json.loads(get("/api/data"))
+        assert ds_stats["datasets"], "dataset stats not surfaced"
+        train = json.loads(get("/api/train"))
+        assert "train_runs" in train
+        serve_state = json.loads(get("/api/serve"))
+        assert "applications" in serve_state or serve_state == {}
     finally:
         stop_dashboard()
 
