@@ -98,23 +98,33 @@ class _DashboardHandler(BaseHTTPRequestHandler):
                 })
             elif path == "/api/serve":
                 # library observability (reference: dashboard serve
-                # module): live application/deployment state
+                # module): live application/deployment state. ONLY a
+                # missing controller maps to the empty state — a
+                # failing controller surfaces as the usual 500.
+                from ray_tpu.serve.api import _get_controller
                 try:
-                    from ray_tpu import serve as _serve
-                    self._json(_serve.status())
+                    controller = _get_controller(create=False)
                 except Exception:
                     self._json({"applications": {}})
+                else:
+                    import ray_tpu
+                    self._json(ray_tpu.get(controller.status.remote(),
+                                           timeout=10))
             elif path == "/api/train":
                 # train-run lifecycle (reference: dashboard train
                 # module over export_train_state.proto): export events
-                # when enabled, else a hint
-                from ray_tpu._private.export_events import \
-                    get_export_logger
-                logger = get_export_logger()
-                events = (logger.read("TRAIN_RUN")
-                          if logger is not None else None)
-                self._json({"train_runs": events or [],
-                            "export_events_enabled": logger is not None})
+                # when the FLAG enables emission, else a hint — and no
+                # side-effectful logger creation when disabled
+                from ray_tpu._private.export_events import (
+                    export_enabled, get_export_logger)
+                enabled = export_enabled()
+                events = []
+                if enabled:
+                    logger = get_export_logger()
+                    if logger is not None:
+                        events = logger.read("TRAIN_RUN")
+                self._json({"train_runs": events,
+                            "export_events_enabled": enabled})
             elif path == "/api/data":
                 # per-dataset operator metrics (reference: dashboard
                 # data module over StatsManager)

@@ -51,16 +51,32 @@ class DatasetStats:
         self._lock = threading.Lock()
         self.operators: Dict[str, Dict[str, float]] = {}
         self.created_at = time.time()
-        DatasetStats._RECENT.append(self)
-        del DatasetStats._RECENT[:-DatasetStats._RECENT_CAP]
+        self._registered = False
+
+    def _register(self) -> None:
+        # ring membership starts at the FIRST record(): every lazy
+        # transform builds a Dataset (and stats) that never executes —
+        # registering at __init__ would evict the executed ones
+        if not self._registered:
+            self._registered = True
+            DatasetStats._RECENT.append(self)
+            del DatasetStats._RECENT[:-DatasetStats._RECENT_CAP]
 
     @classmethod
     def recent(cls) -> List[Dict[str, Any]]:
-        return [{"created_at": s.created_at, "operators": s.operators}
-                for s in cls._RECENT if s.operators]
+        out = []
+        for s in list(cls._RECENT):
+            with s._lock:                       # snapshot: record() may
+                ops = {k: dict(v)               # be mutating mid-dump
+                       for k, v in s.operators.items()}
+            if ops:
+                out.append({"created_at": s.created_at,
+                            "operators": ops})
+        return out
 
     def record(self, op_name: str, *, blocks: int = 0, rows: int = 0,
                seconds: float = 0.0) -> None:
+        self._register()
         with self._lock:
             entry = self.operators.setdefault(
                 op_name, {"blocks": 0, "rows": 0, "seconds": 0.0})
