@@ -204,7 +204,10 @@ def main() -> int:
     serve_mode = os.environ.get("BENCH_SERVE") == "1"
     total = int(os.environ.get("BENCH_TOTAL_DEADLINE", "540"))
     attempt_cap = int(os.environ.get("BENCH_TIMEOUT", "300"))
-    attempts = int(os.environ.get("BENCH_ATTEMPTS", "1"))
+    # two preflight-gated attempts: a DOWN tunnel short-circuits both in
+    # seconds, a FLAPPING one gets a second chance (r4 evidence: the
+    # relay goes half-up and comes back)
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "2"))
     deadline = time.monotonic() + total
     cmd = [sys.executable, os.path.abspath(__file__), "--child"]
 
