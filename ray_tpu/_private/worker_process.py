@@ -600,6 +600,7 @@ class _WorkerState:
                                     sem.acquire()
                                 self.send({"id": rid, "op": "yield",
                                            "blob": _safe_dumps(item)})
+                            self._flush_metrics()   # before release
                             self.send({"id": rid, "op": "result",
                                        "ok": True,
                                        "blob": _safe_dumps(None)})
@@ -608,16 +609,32 @@ class _WorkerState:
                         return
             finally:
                 runtime_context._reset_context(token)
+            # flush BEFORE the result send: once the host sees the
+            # result it may release (or kill) this worker, and a flush
+            # in flight after that is lost
+            self._flush_metrics()
             self.send({"id": rid, "op": "result", "ok": True,
                        "blob": _safe_dumps(result)})
         except BaseException as e:  # noqa: BLE001 — shipped to host
             try:
+                self._flush_metrics()
                 self.send({"id": rid, "op": "result", "ok": False,
                            "blob": _dump_exc(e)})
             except (BrokenPipeError, OSError):
                 os._exit(1)
         finally:
             self._task_threads.pop(rid, None)
+
+    def _flush_metrics(self) -> None:
+        """User metrics created in THIS worker flow to the driver's
+        Prometheus endpoint (reference: worker -> agent -> exporter)."""
+        try:
+            from ray_tpu.util import metrics as _metrics
+            deltas = _metrics.drain_deltas()
+            if deltas:
+                self.call_host("metrics_push", entries=deltas)
+        except Exception:
+            pass
 
 
 def _post_mortem_on_error():
@@ -934,6 +951,10 @@ def dispatch_core_op(rt, holder, call: str, kw: Dict[str, Any],
             return store.kv_keys(kw["prefix"], namespace=ns)
     if call == "fetch_function":
         return fetch_function_blob(kw["fid"])
+    if call == "metrics_push":
+        from ray_tpu.util import metrics as _metrics
+        _metrics.merge_deltas(kw["entries"])
+        return True
     if call == "fetch_runtime_pkg":
         from ray_tpu._private.runtime_env_packaging import fetch_pkg_blob
         return fetch_pkg_blob(kw["uri"])
@@ -1140,10 +1161,17 @@ class WorkerClient:
         self._holds.setdefault(key, []).append(obj)
 
     def _core_dispatch(self, msg: Dict[str, Any]) -> Any:
+        kw = cloudpickle.loads(msg["payload"])
+        if msg["call"] == "metrics_push":
+            # process-global registry, no runtime binding needed — the
+            # post-task flush legitimately races release_worker()'s
+            # runtime reset
+            from ray_tpu.util import metrics as _metrics
+            _metrics.merge_deltas(kw["entries"])
+            return True
         rt = self.runtime
         if rt is None:
             raise RuntimeError("worker not bound to a runtime")
-        kw = cloudpickle.loads(msg["payload"])
         return dispatch_core_op(rt, self, msg["call"], kw, msg.get("task"))
 
     def _request(self, msg: Dict[str, Any]) -> Tuple[str, _Pending]:

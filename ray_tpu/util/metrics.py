@@ -83,6 +83,95 @@ class Histogram(Metric):
             self._totals[key] = self._totals.get(key, 0) + 1
 
 
+# ---------------------------------------------------------------------------
+# cross-process flow: pool workers drain deltas after each task; the
+# driver merges them so user metrics from ANY process surface on the
+# one Prometheus endpoint (reference: workers -> agent -> exporter)
+# ---------------------------------------------------------------------------
+
+_FLUSH_STATE: Dict[str, Dict] = {}
+
+
+def drain_deltas() -> List[Dict]:
+    """Changes since the last drain, as plain picklable entries.
+    Counters/histograms ship DELTAS (mergeable across workers); gauges
+    ship absolute values (last writer wins)."""
+    out: List[Dict] = []
+    for name, m in registry().items():
+        if m.kind == "histogram":
+            prev = _FLUSH_STATE.get(name, {})
+            hist = {}
+            with m._lock:
+                for key, counts in m._counts.items():
+                    p = prev.get(key, ([0] * len(counts), 0.0, 0))
+                    dc = [c - pc for c, pc in zip(counts, p[0])]
+                    ds = m._sums.get(key, 0.0) - p[1]
+                    dt = m._totals.get(key, 0) - p[2]
+                    if dt:
+                        hist[key] = (dc, ds, dt)
+                _FLUSH_STATE[name] = {
+                    key: (list(c), m._sums.get(key, 0.0),
+                          m._totals.get(key, 0))
+                    for key, c in m._counts.items()}
+            if hist:
+                out.append({"name": name, "kind": "histogram",
+                            "description": m.description,
+                            "tag_keys": m.tag_keys,
+                            "boundaries": m.boundaries,
+                            "hist": hist})
+            continue
+        prev = _FLUSH_STATE.get(name, {})
+        cur = dict(m.samples())
+        if m.kind == "counter":
+            samples = [(k, v - prev.get(k, 0.0)) for k, v in cur.items()
+                       if v != prev.get(k, 0.0)]
+        else:
+            samples = [(k, v) for k, v in cur.items()
+                       if v != prev.get(k)]
+        _FLUSH_STATE[name] = cur
+        if samples:
+            out.append({"name": name, "kind": m.kind,
+                        "description": m.description,
+                        "tag_keys": m.tag_keys, "samples": samples})
+    return out
+
+
+def merge_deltas(entries: List[Dict]) -> None:
+    """Apply another process's drained deltas to this registry."""
+    for e in entries:
+        with _REG_LOCK:
+            m = _REGISTRY.get(e["name"])
+        if m is None:
+            if e["kind"] == "counter":
+                m = Counter(e["name"], e["description"],
+                            tag_keys=e.get("tag_keys", ()))
+            elif e["kind"] == "gauge":
+                m = Gauge(e["name"], e["description"],
+                          tag_keys=e.get("tag_keys", ()))
+            elif e["kind"] == "histogram":
+                m = Histogram(e["name"], e["description"],
+                              boundaries=e.get("boundaries",
+                                               (0.01, 0.1, 1, 10, 100)),
+                              tag_keys=e.get("tag_keys", ()))
+            else:
+                continue
+        if e["kind"] == "histogram":
+            with m._lock:
+                for key, (dc, ds, dt) in e["hist"].items():
+                    counts = m._counts.setdefault(
+                        key, [0] * (len(m.boundaries) + 1))
+                    for i, d in enumerate(dc[:len(counts)]):
+                        counts[i] += d
+                    m._sums[key] = m._sums.get(key, 0.0) + ds
+                    m._totals[key] = m._totals.get(key, 0) + dt
+        elif e["kind"] == "counter":
+            for key, v in e["samples"]:
+                m._add(key, v)
+        else:
+            for key, v in e["samples"]:
+                m._set(key, v)
+
+
 def registry() -> Dict[str, Metric]:
     with _REG_LOCK:
         return dict(_REGISTRY)

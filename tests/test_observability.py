@@ -69,3 +69,33 @@ def test_export_events_disabled_by_default(tmp_path):
     finally:
         ray_tpu.shutdown()
         reset_export_logger()
+
+
+def test_worker_metrics_flow_to_driver(ray_start_regular):
+    """User metrics created inside pool workers surface on the driver's
+    Prometheus endpoint (reference: worker -> agent -> exporter flow);
+    counters merge across workers, histograms merge bucket counts."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.util.metrics import prometheus_text
+
+    @ray_tpu.remote
+    def work(i):
+        from ray_tpu.util.metrics import Counter, Histogram
+        Counter("xproc_events", "events").inc(5)
+        Histogram("xproc_lat", "lat", boundaries=(1, 10)).observe(i)
+        return 1
+
+    assert ray_tpu.get([work.remote(i) for i in range(3)],
+                       timeout=60) == [1, 1, 1]
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        text = prometheus_text()
+        lines = [l for l in text.splitlines()
+                 if l.startswith("xproc_events ")]
+        if lines and lines[0].endswith("15.0"):
+            break
+        time.sleep(0.2)
+    assert lines and lines[0].endswith("15.0"), lines
+    assert "xproc_lat_count 3" in text
