@@ -90,12 +90,21 @@ class Histogram(Metric):
 # ---------------------------------------------------------------------------
 
 _FLUSH_STATE: Dict[str, Dict] = {}
+# one drainer at a time per process (concurrent task threads would read
+# the same snapshot and double-count), and one merger at a time on the
+# receiving side (check-then-create on first sight of a metric)
+_FLUSH_LOCK = threading.Lock()
 
 
 def drain_deltas() -> List[Dict]:
     """Changes since the last drain, as plain picklable entries.
     Counters/histograms ship DELTAS (mergeable across workers); gauges
     ship absolute values (last writer wins)."""
+    with _FLUSH_LOCK:
+        return _drain_deltas_locked()
+
+
+def _drain_deltas_locked() -> List[Dict]:
     out: List[Dict] = []
     for name, m in registry().items():
         if m.kind == "histogram":
@@ -138,6 +147,11 @@ def drain_deltas() -> List[Dict]:
 
 def merge_deltas(entries: List[Dict]) -> None:
     """Apply another process's drained deltas to this registry."""
+    with _FLUSH_LOCK:                 # serialize check-then-create
+        _merge_deltas_locked(entries)
+
+
+def _merge_deltas_locked(entries: List[Dict]) -> None:
     for e in entries:
         with _REG_LOCK:
             m = _REGISTRY.get(e["name"])
@@ -156,6 +170,15 @@ def merge_deltas(entries: List[Dict]) -> None:
             else:
                 continue
         if e["kind"] == "histogram":
+            if tuple(e.get("boundaries", ())) != tuple(m.boundaries):
+                import warnings
+                warnings.warn(
+                    f"histogram {e['name']!r}: incoming boundaries "
+                    f"{e.get('boundaries')} != registered "
+                    f"{m.boundaries}; dropping this batch (a truncated "
+                    f"merge would corrupt the exposition)",
+                    stacklevel=2)
+                continue
             with m._lock:
                 for key, (dc, ds, dt) in e["hist"].items():
                     counts = m._counts.setdefault(
@@ -178,8 +201,12 @@ def registry() -> Dict[str, Metric]:
 
 
 def clear_registry() -> None:
-    with _REG_LOCK:
-        _REGISTRY.clear()
+    with _FLUSH_LOCK:
+        with _REG_LOCK:
+            _REGISTRY.clear()
+        # a metric re-created with the same name must not drain against
+        # stale baselines (negative counter deltas break monotonicity)
+        _FLUSH_STATE.clear()
 
 
 def _fmt_labels(key: Tuple) -> str:

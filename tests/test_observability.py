@@ -99,3 +99,6 @@ def test_worker_metrics_flow_to_driver(ray_start_regular):
         time.sleep(0.2)
     assert lines and lines[0].endswith("15.0"), lines
     assert "xproc_lat_count 3" in text
+    # don't pollute later tests' prometheus_text in this process
+    from ray_tpu.util.metrics import clear_registry
+    clear_registry()
