@@ -1,0 +1,14 @@
+"""TPU pod helpers — the reference's import path (`ray.util.tpu` +
+`util/accelerators/tpu.py:14-33`): pod identity, per-host worker index,
+chip detection, and visibility control, re-exported from the
+accelerator-management layer (`_private/accelerators.py`) plus the ICI
+topology model (`parallel/topology.py`)."""
+
+from ray_tpu._private.accelerators import (detect_tpu_chips,
+                                           get_accelerator_type,
+                                           get_pod_name, get_worker_id,
+                                           set_visible_chips)
+from ray_tpu.parallel.topology import TpuTopology
+
+__all__ = ["detect_tpu_chips", "get_accelerator_type", "get_pod_name",
+           "get_worker_id", "set_visible_chips", "TpuTopology"]
