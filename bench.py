@@ -65,6 +65,25 @@ def _tunnel_up(timeout: float = 3.0) -> bool:
     return True
 
 
+def _backend_up(timeout_s: int = 60) -> bool:
+    """Deep preflight: actually initialize the PJRT backend in a
+    throwaway child. The r4 evidence shows a HALF-UP relay that accepts
+    TCP while PJRT init hangs forever — this catches it for ~60s
+    instead of burning a whole 300s attempt (healthy cost ~10-15s)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices()[0]; "
+             "assert d.platform != 'cpu'"],
+            timeout=timeout_s, capture_output=True,
+            start_new_session=True)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+    except Exception:
+        return False
+
+
 def _hb(stage: str) -> None:
     """Heartbeat on stderr: survives in the captured tail if we get killed."""
     print(f"HB {time.strftime('%H:%M:%S')} {stage}", file=sys.stderr, flush=True)
@@ -279,6 +298,14 @@ def main() -> int:
         budget = min(attempt_cap, remaining() - _CPU_RESERVE)
         if budget < 30:  # not enough room left for a real attempt
             err = err or "no budget left for accelerator attempt"
+            break
+        if not _backend_up(min(60, int(budget) // 2)):
+            err = ("tunnel half-up: TCP ports accept but PJRT backend "
+                   "init hangs (see tools/evidence/tpu_tunnel_flap_r4"
+                   ".log)")
+            if attempt + 1 < attempts and remaining() > _CPU_RESERVE + 45:
+                time.sleep(10)
+                continue
             break
         line, err, retryable = try_once(os.environ.copy(), budget)
         if line is not None:
