@@ -160,10 +160,6 @@ class Algorithm:
     def _init_multi_agent(self) -> None:
         from ray_tpu.rl.multi_agent import MultiAgentEnvRunner
         cfg = self.config
-        if cfg.algo.upper() in ("IMPALA", "APPO"):
-            raise ValueError(
-                "multi-agent training uses the synchronous path; "
-                "IMPALA/APPO async sampling is single-agent only")
         if cfg.policy_mapping_fn is None:
             raise ValueError("multi_agent() needs a policy_mapping_fn")
         if (cfg.env_to_module_connector is not None
@@ -206,6 +202,8 @@ class Algorithm:
 
     def _train_multi_agent(self) -> Dict[str, Any]:
         cfg = self.config
+        if cfg.algo.upper() in ("IMPALA", "APPO"):
+            return self._train_multi_agent_async()
         metrics: Dict[str, Any] = {}
         for _ in range(cfg.train_iterations_per_call):
             sampled = ray_tpu.get([
@@ -219,6 +217,42 @@ class Algorithm:
                 m = self.learners[pid].update(frags)
                 metrics.update({f"{pid}/{k}": v for k, v in m.items()})
             self._sync_weights()
+        return self._finish_iteration(metrics)
+
+    def _train_multi_agent_async(self) -> Dict[str, Any]:
+        """Multi-agent IMPALA/APPO: each delivered batch updates every
+        policy it contains; ONLY those policies' fresh weights go back
+        to the delivering runner (set_weights takes partial dicts) —
+        V-trace corrects the per-policy sampler lag."""
+        def consume(batch, metrics):
+            payload = {}
+            for pid, frags in batch.items():
+                m = self.learners[pid].update(frags)
+                metrics.update({f"{pid}/{k}": v for k, v in m.items()})
+                payload[pid] = self.learners[pid].get_weights()
+            return payload
+
+        return self._run_async_loop(consume)
+
+    def _run_async_loop(self, consume) -> Dict[str, Any]:
+        """Shared IMPALA-style skeleton: one sample per runner stays in
+        flight; ``consume(result, metrics)`` applies the update and
+        returns the weights payload for the delivering runner."""
+        cfg = self.config
+        if not self._in_flight:
+            self._in_flight = {
+                r.sample.remote(cfg.rollout_fragment_length): r
+                for r in self.runners}
+        metrics: Dict[str, Any] = {}
+        updates = cfg.train_iterations_per_call * len(self.runners)
+        for _ in range(updates):
+            done, _ = ray_tpu.wait(list(self._in_flight), num_returns=1)
+            runner = self._in_flight.pop(done[0])
+            result = ray_tpu.get(done[0])
+            payload = consume(result, metrics)
+            runner.set_weights.remote(ray_tpu.put(payload))
+            self._in_flight[
+                runner.sample.remote(cfg.rollout_fragment_length)] = runner
         return self._finish_iteration(metrics)
 
     def _finish_iteration(self, metrics: Dict[str, Any]) -> Dict[str, Any]:
@@ -249,23 +283,11 @@ class Algorithm:
         fragment and pushes fresh weights only to the runner that just
         delivered (reference: IMPALA's actor-learner queue — samplers
         run on stale weights, V-trace corrects the lag)."""
-        cfg = self.config
-        if not self._in_flight:
-            self._in_flight = {
-                r.sample.remote(cfg.rollout_fragment_length): r
-                for r in self.runners}
-        metrics: Dict[str, Any] = {}
-        updates = cfg.train_iterations_per_call * len(self.runners)
-        for _ in range(updates):
-            done, _ = ray_tpu.wait(list(self._in_flight), num_returns=1)
-            runner = self._in_flight.pop(done[0])
-            rollout = ray_tpu.get(done[0])
-            metrics = self.learner.update([rollout])
-            runner.set_weights.remote(
-                ray_tpu.put(self.learner.get_weights()))
-            self._in_flight[
-                runner.sample.remote(cfg.rollout_fragment_length)] = runner
-        return self._finish_iteration(metrics)
+        def consume(rollout, metrics):
+            metrics.update(self.learner.update([rollout]))
+            return self.learner.get_weights()
+
+        return self._run_async_loop(consume)
 
     def train(self) -> Dict[str, Any]:
         """One training iteration (reference Algorithm.step)."""

@@ -133,12 +133,33 @@ def test_mapping_validated_at_build(ray_start_regular):
         cfg.build()
 
 
-def test_multi_agent_rejects_async_algos():
-    cfg = (AlgorithmConfig(algo="IMPALA")
-           .multi_agent(policies={"p": None},
-                        policy_mapping_fn=lambda a: "p"))
-    with pytest.raises(ValueError, match="single-agent only"):
-        cfg.build()
+def test_multi_agent_impala_async_path(ray_start_regular):
+    """Multi-agent IMPALA: fragments stream through the async
+    actor-learner loop, one V-trace learner per policy."""
+    from ray_tpu.rl import register_env
+
+    register_env("MultiCartPole-2a",
+                 lambda seed=0: MultiAgentCartPole(2, seed=seed,
+                                                  max_steps=80))
+    algo = (AlgorithmConfig(algo="IMPALA", seed=0)
+            .environment("MultiCartPole-2a")
+            .env_runners(2, rollout_fragment_length=64)
+            .multi_agent(
+                policies={"p0": None, "p1": None},
+                policy_mapping_fn=lambda aid: (
+                    "p0" if aid == "agent_0" else "p1"))
+            .build())
+    try:
+        m = None
+        for _ in range(2):
+            m = algo.train()
+        assert any(k.startswith("p0/") for k in m)
+        assert any(k.startswith("p1/") for k in m)
+        assert m["training_iteration"] == 2
+        assert np.isfinite(m["episode_return_mean"]) or \
+            m["num_episodes"] == 0
+    finally:
+        algo.stop()
 
 
 def test_tuned_examples_registry_builds(ray_start_regular):
