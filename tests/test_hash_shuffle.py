@@ -19,19 +19,42 @@ def _mk_block(vals):
     return block_from_rows([{"v": int(v)} for v in vals])
 
 
-def _mod_partition(block, n):
-    col = block.column("v").to_numpy(zero_copy_only=False)
-    out = [block.take(np.nonzero(col % n == p)[0]) for p in range(n)]
-    return out if n > 1 else out[0]
+# Closures, not module functions: cloudpickle ships them BY VALUE, so
+# daemons-mode workers need no importable test module.
+def _make_mod_partition():
+    def _mod_partition(block, n):
+        import numpy as np
+        out = [block.take(np.nonzero(
+            block.column("v").to_numpy(zero_copy_only=False)
+            % n == p)[0]) for p in range(n)]
+        return out if n > 1 else out[0]
+    return _mod_partition
 
 
-def _slow_mod_partition(block, n, delay_s):
-    time.sleep(delay_s)
-    return _mod_partition(block, n)
+_mod_partition = _make_mod_partition()
 
 
-def _fin_rows(shards):
-    return concat_blocks(shards.get("d", []))
+def _make_slow_mod_partition():
+    inner = _make_mod_partition()
+
+    def _slow_mod_partition(block, n, delay_s):
+        import time
+        time.sleep(delay_s)
+        return inner(block, n)
+    return _slow_mod_partition
+
+
+_slow_mod_partition = _make_slow_mod_partition()
+
+
+def _make_fin_rows():
+    def _fin_rows(shards):
+        from ray_tpu.data.block import concat_blocks
+        return concat_blocks(shards.get("d", []))
+    return _fin_rows
+
+
+_fin_rows = _make_fin_rows()
 
 
 def test_streaming_shuffle_partitions_correctly(ray_start_regular):

@@ -13,4 +13,8 @@ echo "=== wire-protocol topology (RAY_TPU_CLUSTER=daemons) ==="
 RAY_TPU_CLUSTER=daemons python -m pytest \
     tests/test_core_tasks.py tests/test_actors.py \
     tests/test_placement_group.py tests/test_serve.py \
-    tests/test_train.py tests/test_data.py -q
+    tests/test_train.py tests/test_data.py \
+    tests/test_hash_shuffle.py tests/test_train_elastic.py -q
+# daemon-dependent suites manage their own clusters (xlang C++ tier,
+# sharded device objects across real processes)
+python -m pytest tests/test_cpp_client.py tests/test_device_objects.py -q
