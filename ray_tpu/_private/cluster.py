@@ -144,6 +144,12 @@ class DaemonHandle:
         self.on_actor_worker_died = None  # set by the backend
         self.client = Client(addr, timeout=None, on_push=self._on_push)
         self.dead = False
+        # fast lane: direct submit to the daemon's native (C++) core
+        self.fast_port: Optional[int] = None
+        self._fast = None
+        self._fast_lock = threading.Lock()
+        self._fast_rids: Dict[str, int] = {}   # task hex -> lane rid
+        self.runtime = None                    # bound by the backend
 
     # -- push demux -------------------------------------------------------
     def _on_push(self, method: str, msg: Dict[str, Any]) -> None:
@@ -171,6 +177,9 @@ class DaemonHandle:
             streams = list(self._streams.values())
         for stream in streams:
             stream.q.put(_STREAM_DEAD)
+        fl = self._fast
+        if fl is not None:
+            fl.close()
 
     def _call(self, method: str, **kw) -> Dict[str, Any]:
         if self.dead:
@@ -183,9 +192,80 @@ class DaemonHandle:
 
     # -- wiring -----------------------------------------------------------
     def hello(self, owner_addr: Tuple[str, int], job_id, namespace: str):
-        return self._call("hello_driver", owner_addr=list(owner_addr),
-                          job_id=cloudpickle.dumps(job_id),
-                          namespace=namespace)
+        out = self._call("hello_driver", owner_addr=list(owner_addr),
+                         job_id=cloudpickle.dumps(job_id),
+                         namespace=namespace)
+        self.fast_port = out.get("fast_port")
+        self._job_id = job_id
+        return out
+
+    def _fast_client(self):
+        """Lazily-connected fast-lane client; None when unavailable."""
+        if self.fast_port is None or self.dead:
+            return None
+        fl = self._fast
+        if fl is not None and not fl.dead:
+            return fl
+        with self._fast_lock:
+            if self._fast is None or self._fast.dead:
+                from ray_tpu._private.fast_lane import FastLaneClient
+                try:
+                    self._fast = FastLaneClient(
+                        (self.addr[0], self.fast_port))
+                except OSError:
+                    self.fast_port = None    # core gone: stop retrying
+                    return None
+            return self._fast
+
+    def _execute_fast(self, fl, spec, fid: str, args_blob: bytes):
+        """One frame out, one frame in — the daemon's Python never sees
+        the task. Outcome contract matches execute_task's; returns None
+        when the caller should take the classic path instead (and the
+        task did NOT run here)."""
+        from ray_tpu._private import fast_lane as _fle
+        payload = _fle.build_payload(spec, fid, args_blob,
+                                     getattr(self, "_job_id", None),
+                                     self.node_id)
+        try:
+            rid, slot = fl.submit(payload)
+        except _fle.FastLaneError:
+            # nothing was submitted: safe to fall back
+            if self.dead:
+                raise DaemonCrashed("daemon died (fast lane)")
+            return None
+        task_hex = spec.task_id.hex()
+        with self._fast_lock:
+            self._fast_rids[task_hex] = rid
+        try:
+            kind, blob = fl.wait(slot)
+        except _fle.FastLaneError as e:
+            # submitted but the lane died before the outcome: the task
+            # may have executed — surface as a worker crash so the
+            # driver's retry accounting (max_retries) decides, never a
+            # silent duplicate run
+            if self.dead:
+                raise DaemonCrashed(str(e))
+            raise RemoteWorkerCrashed(f"fast lane died mid-task: {e}")
+        finally:
+            with self._fast_lock:
+                self._fast_rids.pop(task_hex, None)
+        if kind == _fle.KIND_OK:
+            return ("ok", cloudpickle.loads(blob))
+        if kind == _fle.KIND_ERR:
+            e, tb = cloudpickle.loads(blob)
+            setattr(e, "_remote_traceback", tb)
+            return ("err", e)
+        if kind == _fle.KIND_GEN_FALLBACK:
+            # the function returned a live generator (no body code ran
+            # for a generator function): stream it via the classic path
+            return None
+        if kind == _fle.KIND_CANCELLED:
+            # same surface as a classic soft cancel: the driver maps a
+            # cancelled in-flight KeyboardInterrupt to TaskCancelledError
+            return ("err", KeyboardInterrupt())
+        if kind == _fle.KIND_CRASHED:
+            raise RemoteWorkerCrashed(blob.decode(errors="replace"))
+        raise RuntimeError(f"unknown fast-lane outcome kind {kind}")
 
     # -- fused task submit ------------------------------------------------
     def execute_task(self, spec, fid: str, args_blob: bytes):
@@ -194,7 +274,29 @@ class DaemonHandle:
         until drained). Returns the same (kind, value) contract as
         ProcessRouter.execute_task. The explicit lease protocol
         (request_worker_lease/push_task/return_worker) stays on the wire
-        for callers that pin a worker across calls."""
+        for callers that pin a worker across calls.
+
+        Plain tasks (NORMAL, single return, no runtime env, not a
+        generator function) ride the fast lane — the daemon's native
+        C++ core routes them to a dedicated worker with zero daemon
+        Python per task."""
+        import inspect as _inspect
+
+        from ray_tpu._private.task_spec import TaskKind as _TK
+        if (spec.kind == _TK.NORMAL and spec.num_returns == 1
+                and not spec.runtime_env
+                and not (spec.func is not None
+                         and _inspect.isgeneratorfunction(spec.func))):
+            fl = self._fast_client()
+            if fl is not None:
+                out = self._execute_fast(fl, spec, fid, args_blob)
+                if out is not None:
+                    return out
+                # None = lane declined (submit failed, or the function
+                # returned a live generator): classic path below. A
+                # lane failure AFTER submit never lands here — it
+                # raises RemoteWorkerCrashed so the retry accounting
+                # (max_retries) applies instead of a silent re-run.
         task_hex = spec.task_id.hex()
         stream = _Stream()
         with self._slock:
@@ -288,8 +390,19 @@ class DaemonHandle:
             pass
 
     def cancel_task(self, task_id, force: bool) -> bool:
+        task_hex = task_id.hex()
+        with self._fast_lock:
+            rid = self._fast_rids.get(task_hex)
+            fl = self._fast
+        if rid is not None and fl is not None and not fl.dead:
+            # fast-lane task: the C++ core drops it if still queued;
+            # running → soft interrupt, or force → the lane worker
+            # exits (surfacing as a crash, which a cancelled task maps
+            # to TaskCancelledError — the classic force-kill contract)
+            fl.cancel(rid, force=force)
+            return True
         try:
-            return self._call("cancel_task", task_id=task_id.hex(),
+            return self._call("cancel_task", task_id=task_hex,
                               force=force)["found"]
         except DaemonCrashed:
             return False

@@ -380,7 +380,8 @@ class DaemonRuntime:
 
 class DaemonService:
     def __init__(self, node_id_hex: str, resources: Dict[str, float],
-                 object_store_bytes: int, persist: bool = False):
+                 object_store_bytes: int, persist: bool = False,
+                 host: str = "127.0.0.1"):
         self.node_id = NodeID.from_hex(node_id_hex)
         self.resources = resources
         # persist=True (cluster started via `ray-tpu start`): survive
@@ -414,6 +415,32 @@ class DaemonService:
         from ray_tpu._private.thread_pool import DaemonThreadPool
         self._task_pool = DaemonThreadPool(1024, name="daemon-task")
         self.pulls = PullManager(self.objects, self._peer)
+        # Native daemon core (native/daemon_core.cc): the C++ event loop
+        # that owns the plain-task hot path — drivers submit straight to
+        # it, it leases a dedicated worker, forwards the payload, routes
+        # the outcome back; zero Python per task (reference: the raylet's
+        # C++ lease/dispatch loop, node_manager.cc). This Python service
+        # remains the policy shell (actors, PGs, runtime envs, objects).
+        self.fast_core = None
+        self.fast_port: Optional[int] = None
+        self._fast_host = host
+        self._fast_workers: list = []
+        self._fast_max = max(1, min(16, int(resources.get("CPU", 2) or 2)))
+        try:
+            from ray_tpu._private.fast_lane import CoreHandle
+            core = CoreHandle()
+            # bind exactly where the daemon's RPC server binds: a
+            # loopback daemon must not open a network-reachable
+            # task-submission (= code execution) port
+            port = core.start(host, 0)
+            if port:
+                self.fast_core = core
+                self.fast_port = port
+                threading.Thread(target=self._fast_pool_loop,
+                                 daemon=True,
+                                 name="fastlane-pool").start()
+        except Exception:
+            self.fast_core = None
         # Worker log capture: this daemon's workers write per-pid files;
         # the monitor forwards new lines to the driver (worker_log push).
         from ray_tpu._private import log_monitor as _lm
@@ -421,6 +448,54 @@ class DaemonService:
         if _lm.log_to_driver_enabled():
             self._log_monitor = _lm.LogMonitor(
                 _lm.session_log_dir(), self._forward_worker_log)
+
+    # -- fast lane (native core) workers --------------------------------
+    def _fast_dedicate_worker(self):
+        """Spawn a worker dedicated to the native core's task lane. Its
+        mp channel stays open for host ops (fetch_function, nested core
+        ops, metrics); it never enters the classic idle pool."""
+        from ray_tpu._private import worker_process as wp
+
+        w = wp._spawn_worker()
+        w._checked_out = True
+        w.raw_outcomes = True
+        w.runtime = self.runtime
+        w.node = self.node_stub
+        lane_host = ("127.0.0.1" if self._fast_host in ("0.0.0.0", "")
+                     else self._fast_host)
+        rid, pend = w._request({
+            "op": "join_fast_lane",
+            "addr": [lane_host, self.fast_port]})
+        out = w._wait_outcome(rid, pend)
+        if out[0] not in ("ok", "ok_raw"):
+            try:
+                w.kill(expected=True)
+            except Exception:
+                pass
+            raise RuntimeError(f"fast-lane join failed: {out!r}")
+        return w
+
+    def _fast_pool_loop(self) -> None:
+        """Queue-depth-driven sizing of the dedicated fast-lane workers:
+        at least one alive; grow one at a time while the core reports a
+        backlog, up to the node's CPU capacity (reference: worker-pool
+        prestart + autoscaling-by-demand)."""
+        while True:
+            try:
+                alive = [w for w in self._fast_workers if w.alive()]
+                self._fast_workers = alive
+                stats = (self.fast_core.stats()
+                         if self.fast_core is not None else {})
+                grow = (not alive
+                        or (stats.get("queued", 0) > 0
+                            and len(alive) < self._fast_max))
+                if grow:
+                    self._fast_workers.append(
+                        self._fast_dedicate_worker())
+                    continue   # re-check immediately while backlogged
+            except Exception:
+                time.sleep(1.0)
+            time.sleep(0.25)
 
     def _forward_worker_log(self, pid: int, stream: str,
                             line: str) -> None:
@@ -465,7 +540,8 @@ class DaemonService:
                 if wp._IDLE:
                     break
             time.sleep(0.02)
-        return {"ok": True, "pid": os.getpid()}
+        return {"ok": True, "pid": os.getpid(),
+                "fast_port": self.fast_port}
 
     def notify_driver(self, kind: str, **kw) -> None:
         conn = self.driver_conn
@@ -1020,7 +1096,8 @@ class DaemonService:
                                    error=f"xlang actor name "
                                          f"{msg['name']!r} already taken")
                         return
-                    self._xlang_actors[msg["name"]] = [spec.actor_id, 0]
+                    self._xlang_actors[msg["name"]] = [
+                        spec.actor_id, 0, threading.Lock()]
                 conn.reply(rid, outcome="ok",
                            actor_id=spec.actor_id.hex())
             except BaseException as e:  # noqa: BLE001 — shipped back
@@ -1035,7 +1112,7 @@ class DaemonService:
         if entry is None:
             return {"outcome": "err",
                     "error": f"no xlang actor named {msg['name']!r}"}
-        actor_id, _ = entry
+        actor_id = entry[0]
         router = self.runtime.process_router
         with router._lock:
             client = router._actor_workers.get(actor_id)
@@ -1044,18 +1121,26 @@ class DaemonService:
 
         def run():
             try:
-                with self._lock:
-                    entry[1] += 1
-                    seqno = entry[1]
-                spec = TaskSpec(
-                    task_id=TaskID.from_random(),
-                    kind=TaskKind.ACTOR_TASK,
-                    name=f"xlang:{msg['name']}.{msg['method']}",
-                    func=msg["method"], actor_id=actor_id,
-                    method_name=msg["method"], seqno=seqno)
-                args_blob = cloudpickle.dumps((tuple(msg["args"]), {}))
-                outcome = client.call_method(spec, self.node_stub,
-                                             args_blob)
+                # Per-actor submission lock: actors guarantee
+                # serialized, seqno-ordered method execution. Two C++
+                # clients hitting the same named actor from different
+                # pool threads must not run (or be delivered)
+                # concurrently — hold the actor lock across seqno
+                # assignment AND the call itself.
+                with entry[2]:
+                    with self._lock:
+                        entry[1] += 1
+                        seqno = entry[1]
+                    spec = TaskSpec(
+                        task_id=TaskID.from_random(),
+                        kind=TaskKind.ACTOR_TASK,
+                        name=f"xlang:{msg['name']}.{msg['method']}",
+                        func=msg["method"], actor_id=actor_id,
+                        method_name=msg["method"], seqno=seqno)
+                    args_blob = cloudpickle.dumps(
+                        (tuple(msg["args"]), {}))
+                    outcome = client.call_method(spec, self.node_stub,
+                                                 args_blob)
                 # router-created actor workers run non-raw by default,
                 # but tolerate raw blobs (same-language daemon decodes)
                 if outcome[0] in ("ok", "ok_raw"):
@@ -1088,9 +1173,15 @@ class DaemonService:
         with self._lock:
             leases = len(self._leases)
             running = len(self._task_rids)
+        fast = (self.fast_core.stats()
+                if self.fast_core is not None else {})
+        # "running" covers BOTH planes: classic daemon-Python tasks and
+        # fast-lane tasks in the native core (queued or executing)
+        running += fast.get("inflight", 0) + fast.get("queued", 0)
         return {"leases": leases, "running": running,
                 "store_used": self.objects.used_bytes(),
                 "pull_stats": dict(self.pulls.stats),
+                "fast_lane": fast,
                 "actors": len(
                     self.runtime.process_router._actor_workers)}
 
@@ -1124,7 +1215,7 @@ def main() -> None:
     resources = json.loads(args.resources)
     service = DaemonService(args.node_id, resources,
                             args.object_store_bytes,
-                            persist=args.persist)
+                            persist=args.persist, host=args.host)
     server = Server(service, host=args.host, port=0).start()
     if args.announce_fd >= 0:
         os.write(args.announce_fd, f"{server.addr[1]}\n".encode())

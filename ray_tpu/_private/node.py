@@ -20,6 +20,7 @@ import heapq
 import queue
 import threading
 import time
+from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional
 
 from ray_tpu._private.gcs import NodeInfo
@@ -303,7 +304,15 @@ class Node:
         self.actors: Dict[ActorID, ActorExecutor] = {}
         self._actors_lock = threading.Lock()
         self._queue: "queue.Queue[Optional[TaskSpec]]" = queue.Queue()
-        self._backlog: List[TaskSpec] = []
+        # Backlog bucketed by exact resource shape: one dispatch pass
+        # is O(#shapes), not O(#queued tasks) — with a deep uniform
+        # backlog (the reference's 1M+ queued-task envelope) a flat
+        # list degrades quadratically (every completion rescans every
+        # queued task). FIFO order holds within a shape; across shapes
+        # there is no ordering contract (the flat scan also launched
+        # whichever task fit first).
+        self._backlog: "OrderedDict[tuple, deque]" = OrderedDict()
+        self._backlog_n = 0
         # Demand of enqueued-but-not-yet-admitted tasks; lets the cluster
         # scheduler see load before the dispatch loop acquires resources
         # (reference: ReportWorkerBacklog, node_manager.proto:421).
@@ -359,14 +368,19 @@ class Node:
 
     def _dispatch_loop(self) -> None:
         while True:
-            # Move newly queued tasks into the backlog.
+            # Move newly queued tasks into the backlog buckets.
             try:
-                timeout = 0.0 if self._backlog else _DISPATCH_POLL_S
+                timeout = 0.0 if self._backlog_n else _DISPATCH_POLL_S
                 while True:
                     spec = self._queue.get(timeout=timeout)
                     if spec is None:
                         return
-                    self._backlog.append(spec)
+                    key = tuple(sorted(spec.resources.items()))
+                    bucket = self._backlog.get(key)
+                    if bucket is None:
+                        bucket = self._backlog[key] = deque()
+                    bucket.append(spec)
+                    self._backlog_n += 1
                     timeout = 0.0
             except queue.Empty:
                 pass
@@ -374,10 +388,15 @@ class Node:
                 self._fail_backlog()
                 continue
             progressed = False
-            remaining: List[TaskSpec] = []
             self.loop_stats["dispatch_iterations"] += 1
-            for spec in self._backlog:
-                if self.ledger.try_acquire(spec.resources):
+            for key in list(self._backlog):
+                bucket = self._backlog.get(key)
+                if bucket is None:
+                    continue
+                while bucket and self.ledger.try_acquire(
+                        bucket[0].resources):
+                    spec = bucket.popleft()
+                    self._backlog_n -= 1
                     t0 = time.perf_counter()
                     if spec.enqueued_at:
                         lag_ms = (t0 - spec.enqueued_at) * 1000
@@ -390,10 +409,9 @@ class Node:
                     self.loop_stats["launch_ms_total"] += (
                         time.perf_counter() - t0) * 1000
                     progressed = True
-                else:
-                    remaining.append(spec)
-            self._backlog = remaining
-            if self._backlog and not progressed:
+                if not bucket:
+                    self._backlog.pop(key, None)
+            if self._backlog_n and not progressed:
                 self.ledger.wait_for_change(0.05)
 
     def _launch(self, spec: TaskSpec) -> None:
@@ -425,7 +443,9 @@ class Node:
     def _fail_backlog(self) -> None:
         from ray_tpu._private import worker
         rt = worker.global_runtime()
-        backlog, self._backlog = self._backlog, []
+        buckets, self._backlog = self._backlog, OrderedDict()
+        self._backlog_n = 0
+        backlog = [spec for bucket in buckets.values() for spec in bucket]
         for spec in backlog:
             self._drop_pending(spec)
         if rt is not None:

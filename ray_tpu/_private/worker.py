@@ -1588,7 +1588,7 @@ class Runtime:
                 running = len(node._running)
             lines.append(
                 f"node {node.node_id.hex()[:8]}: alive={node.alive} "
-                f"running={running} backlog={len(node._backlog)} "
+                f"running={running} backlog={node._backlog_n} "
                 f"actors={len(node.actors)} "
                 f"store_used={node.store.used_bytes()} "
                 f"loop={node.loop_stats}")

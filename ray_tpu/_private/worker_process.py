@@ -391,6 +391,20 @@ class _WorkerState:
                     sem.release()
             elif op == "cancel":
                 self._async_raise(msg["target"])
+            elif op == "join_fast_lane":
+                # dedicate this worker to the native daemon core's task
+                # lane (fast_lane.py); the mp channel stays open for
+                # host ops (fetch_function, nested core ops, metrics)
+                try:
+                    from ray_tpu._private.fast_lane import (
+                        worker_fast_lane_start)
+                    worker_fast_lane_start(tuple(msg["addr"]), self)
+                    self.send({"id": msg["id"], "op": "result",
+                               "ok": True,
+                               "blob": cloudpickle.dumps(None)})
+                except BaseException as e:  # noqa: BLE001 — shipped
+                    self.send({"id": msg["id"], "op": "result",
+                               "ok": False, "blob": _dump_exc(e)})
 
     def _async_raise(self, rid: str) -> None:
         """Best-effort KeyboardInterrupt into the thread running ``rid``

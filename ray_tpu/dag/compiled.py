@@ -108,6 +108,11 @@ class CompiledDAG:
         self._teardown = False
         self._buffer_size = buffer_size_bytes
         self._proc = None
+        # One submit at a time: a round's input-channel writes and its
+        # rounds.put must be atomic or concurrent execute() calls can
+        # interleave writes across channels and mispair round outputs
+        # with ObjectRefs.
+        self._submit_lock = __import__("threading").Lock()
         self._executors = self._bind_executors()
         if self._executors is None:
             # cross-process mode: pre-allocated shm channels + a
@@ -419,6 +424,7 @@ class CompiledDAG:
         from ray_tpu._private.object_ref import ObjectRef
 
         rt = worker.global_worker()
+        value = None
         if self._proc["inputs"]:
             if not args:
                 raise ValueError("DAG has an InputNode but execute() "
@@ -426,14 +432,15 @@ class CompiledDAG:
             value = args[0]
             if isinstance(value, ObjectRef):
                 value = rt.get([value])[0]
-            for ch in self._proc["inputs"]:
-                ch.write("ok", value)
 
         oid = ObjectID.from_random()
         ref = ObjectRef(oid, owner_hex=rt.worker_id.hex(),
                         task_name="compiled_dag")
-        self._proc["rounds"].put(
-            (oid, isinstance(self.root, MultiOutputNode)))
+        with self._submit_lock:
+            for ch in self._proc["inputs"]:
+                ch.write("ok", value)
+            self._proc["rounds"].put(
+                (oid, isinstance(self.root, MultiOutputNode)))
         return ref
 
     def _execute_channels(self, args):

@@ -36,6 +36,24 @@ _HDR = struct.Struct("<QQQ")          # seq, ack, len
 HEADER_SIZE = _HDR.size
 DEFAULT_CAPACITY = 1 << 20            # 1 MiB per edge
 
+_TSO_ARCHS = ("x86_64", "AMD64", "i686", "x86")
+_arch_warned = False
+
+
+def _check_arch() -> None:
+    """The lock-free publish order is only guaranteed under x86-TSO
+    (all TPU hosts). Warn once elsewhere instead of silently racing."""
+    global _arch_warned
+    import platform
+    if _arch_warned or platform.machine() in _TSO_ARCHS:
+        return
+    _arch_warned = True
+    import warnings
+    warnings.warn(
+        f"shm channels assume x86-TSO store ordering; on "
+        f"{platform.machine()} a reader may observe the seq bump "
+        f"before the payload bytes", RuntimeWarning, stacklevel=3)
+
 
 class ChannelClosed(Exception):
     pass
@@ -51,6 +69,7 @@ class ShmChannel:
 
     def __init__(self, name: Optional[str] = None, *,
                  capacity: int = DEFAULT_CAPACITY, create: bool = False):
+        _check_arch()
         if create:
             self._shm = shared_memory.SharedMemory(
                 create=True, size=HEADER_SIZE + capacity)
@@ -65,6 +84,13 @@ class ShmChannel:
         return self._shm.name
 
     # -- header accessors -------------------------------------------------
+    # Memory-model note: the seq/ack protocol publishes payload+len
+    # BEFORE bumping seq (slot 0) and relies on CPython's byte-store
+    # ordering plus x86-TSO for the reader to observe them in that
+    # order. On weakly-ordered hosts (ARM) a reader could in principle
+    # see the new seq before the payload bytes; TPU hosts are x86, so
+    # this is asserted at import in _check_arch() rather than paying a
+    # lock per message on the hot path.
     def _get(self, idx: int) -> int:
         return struct.unpack_from("<Q", self._shm.buf, idx * 8)[0]
 

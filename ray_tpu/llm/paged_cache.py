@@ -66,12 +66,23 @@ class BlockPool:
                      extra_key: Optional[Tuple] = None) -> List[int]:
         """Hash chain over the FULL blocks of ``tokens``. ``extra_key``
         (e.g. a model/adapter id) salts the chain so different models
-        never share blocks."""
+        never share blocks.
+
+        Content addressing uses sha256, not Python ``hash()``: a 64-bit
+        hash collision (or a crafted token sequence in a multi-tenant
+        server) would silently map different block contents onto the
+        same physical block and serve wrong KV. The digest cost is
+        negligible next to prefill FLOPs (vLLM made the same move)."""
+        import hashlib
+
         hashes: List[int] = []
-        prev: object = extra_key
+        prev = hashlib.sha256(repr(extra_key).encode()).digest()
         for start in range(0, len(tokens) - block_size + 1, block_size):
-            prev = hash((prev, tuple(tokens[start:start + block_size])))
-            hashes.append(prev)
+            h = hashlib.sha256(prev)
+            h.update(repr(tuple(tokens[start:start + block_size]))
+                     .encode())
+            prev = h.digest()
+            hashes.append(int.from_bytes(prev[:16], "little"))
         return hashes
 
     # -- allocation -------------------------------------------------------

@@ -272,7 +272,26 @@ def cmd_debug(args) -> int:
     from ray_tpu.util import rpdb
     if args.session:
         host, _, port = args.session.rpartition(":")
-        rpdb.connect(host or "127.0.0.1", int(port))
+        token = getattr(args, "token", None)
+        if not token:
+            # externally-bound sessions require their KV-advertised
+            # token; resolve it from the running cluster when possible
+            cluster = getattr(args, "cluster", "") or _try_cluster_address()
+            if cluster:
+                from ray_tpu._private.head import HeadClient
+                chost, cport = cluster.rsplit(":", 1)
+                head = HeadClient((chost, int(cport)))
+                try:
+                    for s in rpdb.sessions_from_kv(head):
+                        if (str(s.get("port")) == port
+                                and s.get("host") == (host
+                                                      or "127.0.0.1")
+                                and s.get("token")):
+                            token = s["token"]
+                            break
+                finally:
+                    head.close()
+        rpdb.connect(host or "127.0.0.1", int(port), token=token)
         return 0
     sessions = []
     cluster = getattr(args, "cluster", "") or _try_cluster_address()
@@ -347,6 +366,9 @@ def main(argv=None) -> int:
                    help="host:port of a session to attach; empty = list")
     p.add_argument("--cluster", default="",
                    help="head host:port (default: the cluster file)")
+    p.add_argument("--token", default="",
+                   help="session token for externally-bound sessions "
+                        "(default: resolved from the cluster KV)")
 
     args = parser.parse_args(argv)
     handler = {

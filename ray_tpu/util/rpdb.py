@@ -165,6 +165,17 @@ def _open_session(banner: str) -> Optional[_RemotePdb]:
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     bind_host, host = _bind_and_advertise()
+    # SECURITY: an externally-reachable pdb port is arbitrary code
+    # execution, so the opt-in bind requires a shared token before the
+    # session starts. The token rides the cluster KV (cluster-internal)
+    # so `ray-tpu debug` sends it automatically; a bare network peer
+    # that can reach the port cannot produce it.
+    token = None
+    if bind_host != "127.0.0.1":
+        token = os.environ.get("RAY_TPU_DEBUGGER_TOKEN")
+        if not token:
+            import secrets
+            token = secrets.token_hex(16)
     srv.bind((bind_host, 0))
     srv.listen(1)
     _, port = srv.getsockname()
@@ -175,33 +186,74 @@ def _open_session(banner: str) -> Optional[_RemotePdb]:
         task_id = task_id.hex() if task_id is not None else None
     except Exception:
         task_id = None
-    reg = _SessionRegistry({
+    meta = {
         "host": host, "port": port, "pid": os.getpid(),
         "task_id": task_id, "banner": banner,
         "started_at": time.time(),
-    })
+    }
+    if token is not None:
+        meta["token"] = token
+    reg = _SessionRegistry(meta)
     reg.register()
     # pool workers have no runtime handle for the KV: the stderr line
     # still reaches the operator via worker-log forwarding
     print(f"[rpdb] {banner}; attach with: ray-tpu debug {host}:{port}",
           file=sys.stderr, flush=True)
     timeout = float(os.environ.get("RAY_TPU_DEBUGGER_TIMEOUT_S", "600"))
-    srv.settimeout(timeout)
+    deadline = time.monotonic() + timeout
+    conn = None
     try:
-        conn, _ = srv.accept()
-    except socket.timeout:
-        reg.retract()
-        srv.close()
-        return None
+        # keep accepting until a client authenticates: one bad/probing
+        # connection (port scanner, stale token) must NOT tear the
+        # session down — that would be a trivial remote DoS of the
+        # breakpoint and silently skip it
+        while time.monotonic() < deadline:
+            srv.settimeout(max(0.1, deadline - time.monotonic()))
+            try:
+                cand, _ = srv.accept()
+            except socket.timeout:
+                break
+            if token is None or _check_token(cand, token, timeout):
+                conn = cand
+                break
+            try:
+                cand.close()
+            except Exception:
+                pass
     finally:
         try:
             srv.close()
         except Exception:
             pass
+    if conn is None:
+        reg.retract()
+        return None
     dbg = _RemotePdb(conn)
     dbg._registry = reg
     dbg._io.write(banner + "\n")
     return dbg
+
+
+def _check_token(conn: socket.socket, token: str,
+                 timeout: float) -> bool:
+    """First client line must equal the session token (constant-time
+    compare). Wrong or missing token: drop the connection without
+    starting pdb."""
+    import hmac
+    try:
+        conn.settimeout(min(timeout, 30.0))
+        buf = b""
+        while b"\n" not in buf and len(buf) < 256:
+            chunk = conn.recv(64)
+            if not chunk:
+                return False
+            buf += chunk
+        line = buf.split(b"\n", 1)[0].strip().decode(errors="replace")
+        ok = hmac.compare_digest(line, token)
+        conn.settimeout(None)
+        return ok
+    except Exception:
+        return False
 
 
 def set_trace(frame=None) -> None:
@@ -261,12 +313,16 @@ def post_mortem_on_error():
 # ---------------------------------------------------------------------------
 
 def connect(host: str, port: int, *, commands: Optional[List[str]] = None,
-            timeout: float = 30.0) -> str:
+            timeout: float = 30.0, token: Optional[str] = None) -> str:
     """Attach to a session. With ``commands`` (tests/automation): send
     each line, return the full transcript. Without: bridge this
     process's stdin/stdout to the session until it closes (the
-    ``ray-tpu debug`` interactive path)."""
+    ``ray-tpu debug`` interactive path). ``token`` authenticates to an
+    externally-bound session (falls back to RAY_TPU_DEBUGGER_TOKEN)."""
     sock = socket.create_connection((host, port), timeout=timeout)
+    token = token or os.environ.get("RAY_TPU_DEBUGGER_TOKEN")
+    if token:
+        sock.sendall(token.encode() + b"\n")
     if commands is None:
         # interactive: the timeout applies to CONNECTING only — an
         # operator reading code at the prompt must not be disconnected
