@@ -18,6 +18,9 @@ leaves a diagnosable tail instead of silence.
 Modes:
   BENCH_SERVE=1          — serving benchmark (p50 TTFT + output tok/s)
                            instead of the training benchmark.
+  BENCH_SERVE_HTTP=1     — proxy-level serving benchmark: the same
+                           metrics measured at an HTTP client through
+                           the asyncio ingress (full serving path).
 Knobs:
   BENCH_TOTAL_DEADLINE   — total wall-clock budget, seconds (default 540)
   BENCH_TIMEOUT          — accelerator-attempt cap, seconds (default 300)
@@ -207,7 +210,10 @@ def _child() -> int:
         force_cpu_platform()
     serve_mode = os.environ.get("BENCH_SERVE") == "1"
     error = os.environ.get("BENCH_ERROR") or None
-    if serve_mode:
+    if os.environ.get("BENCH_SERVE_HTTP") == "1":
+        from ray_tpu.llm.bench import run_http_proxy_bench
+        result = run_http_proxy_bench(error=error)
+    elif serve_mode:
         from ray_tpu.llm.bench import run_serving_bench
         result = run_serving_bench(error=error)
     else:

@@ -598,8 +598,11 @@ class RemoteStore:
 
 
 class _OwnerHolder:
-    """Pins refs created on behalf of daemon workers (cleared per daemon
-    on disconnect; reference: owner-side borrower bookkeeping)."""
+    """Pins refs created on behalf of daemon workers, keyed by borrower
+    ("t:<task>" / "a:<actor>" — reference: per-task borrow tracking,
+    ``reference_count.h:73``). Holds release when the borrowing task
+    finishes or the actor dies, NOT only on daemon disconnect — a
+    long-lived daemon must not pin dead tasks' objects."""
 
     def __init__(self):
         self._held: Dict[Any, List[Any]] = {}
@@ -609,9 +612,21 @@ class _OwnerHolder:
         with self._lock:
             self._held.setdefault(task_rid or "_", []).append(obj)
 
+    def release(self, key: str) -> None:
+        """Drop one borrower's holds (the dropped ObjectRefs' __del__
+        cascades into refcounting — outside the lock)."""
+        with self._lock:
+            dropped = self._held.pop(key, None)
+        del dropped
+
     def clear(self) -> None:
         with self._lock:
-            self._held.clear()
+            held, self._held = self._held, {}
+        del held
+
+    def num_keys(self) -> int:
+        with self._lock:
+            return len(self._held)
 
 
 class OwnerService:
@@ -676,7 +691,8 @@ class ClusterBackend:
         self._supervisor = threading.Thread(
             target=self._supervise_head, daemon=True, name="head-supervisor")
         self._supervisor.start()
-        self.owner_server = Server(OwnerService(runtime)).start()
+        self.owner_service = OwnerService(runtime)
+        self.owner_server = Server(self.owner_service).start()
         self.daemons: Dict[NodeID, DaemonHandle] = {}
         self._lock = threading.Lock()
         import json
@@ -699,6 +715,7 @@ class ClusterBackend:
                 self.daemons[node_id] = handle
         self.head.subscribe("node", self._on_node_event)
         self.start_resource_reporter()
+        self.start_task_event_flusher()
 
     @classmethod
     def attach(cls, runtime, address: str) -> "ClusterBackend":
@@ -722,7 +739,8 @@ class ClusterBackend:
         self.head = HeadClient((host, self._head_port),
                                reconnect_window=cls.HEAD_RECONNECT_S)
         self._shutting_down = False
-        self.owner_server = Server(OwnerService(runtime)).start()
+        self.owner_service = OwnerService(runtime)
+        self.owner_server = Server(self.owner_service).start()
         self.daemons: Dict[NodeID, DaemonHandle] = {}
         self._lock = threading.Lock()
         for info in self.head.list_nodes():
@@ -747,6 +765,7 @@ class ClusterBackend:
                 f"cluster at {address} has no alive nodes to join")
         self.head.subscribe("node", self._on_node_event)
         self.start_resource_reporter()
+        self.start_task_event_flusher()
         return self
 
     def start_resource_reporter(self, interval_s: float = 0.5) -> None:
@@ -781,6 +800,44 @@ class ClusterBackend:
 
         threading.Thread(target=loop, daemon=True,
                          name="resource-reporter").start()
+
+    def start_task_event_flusher(self, interval_s: float = 1.0) -> None:
+        """Periodically ship NEW driver task events to the head's
+        task-event store so state/timeline queries survive driver exit
+        (reference: task_event_buffer.cc -> gcs_task_manager.h:94)."""
+        self._task_event_cursor = 0
+        flush_lock = threading.Lock()
+
+        def flush_once() -> None:
+            buf = getattr(self.runtime, "task_events", None)
+            if buf is None:
+                return
+            # one flusher at a time: the periodic thread, shutdown's
+            # final flush, and direct test calls share the cursor — a
+            # concurrent read-push-advance would double-store the batch
+            # (the head has no dedupe)
+            with flush_lock:
+                batch = buf.events_after(self._task_event_cursor)
+                if not batch:
+                    return
+                job_hex = self.runtime.job_id.hex()
+                for ev in batch:
+                    ev.setdefault("job_id", job_hex)
+                try:
+                    self.head.task_events_push(batch)
+                except rpc.RpcError:
+                    return   # lost flush: retry with same cursor
+                self._task_event_cursor = batch[-1]["seq"]
+
+        self._flush_task_events = flush_once
+
+        def loop():
+            while not self._shutting_down:
+                time.sleep(interval_s)
+                flush_once()
+
+        threading.Thread(target=loop, daemon=True,
+                         name="task-event-flusher").start()
 
     def _supervise_head(self) -> None:
         """Respawn a crashed head on the same port with the same state."""
@@ -844,6 +901,14 @@ class ClusterBackend:
             pass
 
     def shutdown(self) -> None:
+        # final task-event flush: post-mortem queries against a shared
+        # (persistent) head see the driver's full history
+        flush = getattr(self, "_flush_task_events", None)
+        if flush is not None:
+            try:
+                flush()
+            except Exception:
+                pass
         self._shutting_down = True
         with self._lock:
             daemons = list(self.daemons.values())

@@ -77,6 +77,8 @@ declare("xlang_create_actor", "cls", "name", "args")
 declare("xlang_call_actor", "name", "method", "args")
 declare("daemon_stop")
 declare("daemon_stats")
+declare("syncer_exchange", "view")
+declare("syncer_view")
 declare("core_op", "call", "payload", "task")
 declare("core_release", "task")
 
@@ -364,9 +366,12 @@ class DaemonRuntime:
         owner = self.service.owner
         if owner is None:
             raise RuntimeError("daemon has no owner connection")
+        # prefer the globally-unique borrower key; the bare worker rid
+        # collides across workers/daemons at the shared owner holder
         out = owner.call("core_op", call=msg["call"],
                          payload=msg["payload"],
-                         task=msg.get("task"), timeout=None)
+                         task=msg.get("task_key") or msg.get("task"),
+                         timeout=None)
         return out["ok"], out["value"]
 
     def on_actor_worker_died(self, actor_id: ActorID, cause: str) -> None:
@@ -405,6 +410,15 @@ class DaemonService:
         self._xlang_actors: Dict[str, list] = {}
         self.head_addr = None            # set by main() in daemon mode
         self._xlang_head_client = None
+        # peer resource gossip (reference: ray_syncer.h:83): versioned
+        # per-node load entries, merged peer-to-peer; loop starts in
+        # main() once the head address is known
+        self._syncer_view: Dict[str, Dict[str, Any]] = {}
+        self._syncer_lock = threading.Lock()
+        self._syncer_peers_cache: Dict[str, Any] = {}
+        self._syncer_peers_ts = 0.0
+        self._syncer_interval_s = float(
+            os.environ.get("RAY_TPU_SYNCER_INTERVAL_S", "0.5"))
         # Task bodies block on worker IPC, so the pool is sized well past
         # core count; reusing threads beats per-task spawn under GIL
         # contention (reference: raylet dispatches from its event loop).
@@ -1162,6 +1176,109 @@ class DaemonService:
         self._task_pool.submit(run)
         return rpc.HOLD
 
+    # -- peer resource gossip (reference: ray_syncer.h:83) ---------------
+    def _syncer_self_entry(self) -> Dict[str, Any]:
+        with self._lock:
+            running = len(self._task_rids)
+        fast = (self.fast_core.stats()
+                if self.fast_core is not None else {})
+        return {
+            "running": running + fast.get("inflight", 0)
+            + fast.get("queued", 0),
+            "store_used": self.objects.used_bytes(),
+            "fast_queued": fast.get("queued", 0),
+        }
+
+    def _syncer_tick(self) -> None:
+        """One anti-entropy round: refresh the self entry, exchange full
+        views with <=2 random peers (merge by version), occasionally
+        push the merged view to the head. Peer-to-peer propagation means
+        the head needs O(1) incoming reports per interval regardless of
+        node count — the RaySyncer scaling property — instead of every
+        node pushing every interval."""
+        import random as _random
+
+        me = self.node_id.hex()
+        with self._syncer_lock:
+            mine = self._syncer_view.get(me)
+            version = (mine["v"] + 1) if mine else 1
+            self._syncer_view[me] = {"v": version,
+                                     "load": self._syncer_self_entry(),
+                                     "ts": time.time()}
+            view = {k: dict(v) for k, v in self._syncer_view.items()}
+        peers = [(hex_id, tuple(addr))
+                 for hex_id, addr in self._syncer_peers().items()
+                 if hex_id != me]
+        for hex_id, addr in _random.sample(peers, min(2, len(peers))):
+            try:
+                out = self._peer(addr).call("syncer_exchange",
+                                            view=view, timeout=5.0)
+                self._syncer_merge(out.get("view", {}))
+            except (rpc.RpcError, OSError):
+                continue
+        # head push: probabilistic so ~one node per interval reports
+        # (every node pushes when the cluster is tiny)
+        if _random.random() < 1.0 / max(1, len(peers)):
+            self._syncer_push_head()
+
+    def _syncer_peers(self) -> Dict[str, Any]:
+        """node hex -> daemon addr, from the head membership (cached)."""
+        now = time.monotonic()
+        if now - self._syncer_peers_ts < 5.0:
+            return self._syncer_peers_cache
+        try:
+            head = HeadClient(self.head_addr)
+            try:
+                nodes = head.list_nodes()
+            finally:
+                head.close()
+            self._syncer_peers_cache = {
+                n["node_id"]: tuple(n["addr"]) for n in nodes
+                if n.get("alive") and n.get("addr")}
+            self._syncer_peers_ts = now
+        except (OSError, rpc.RpcError):
+            pass
+        return self._syncer_peers_cache
+
+    def _syncer_merge(self, view: Dict[str, Any]) -> None:
+        with self._syncer_lock:
+            for hex_id, entry in view.items():
+                cur = self._syncer_view.get(hex_id)
+                if cur is None or entry["v"] > cur["v"]:
+                    self._syncer_view[hex_id] = dict(entry)
+
+    def _syncer_push_head(self) -> None:
+        try:
+            head = HeadClient(self.head_addr)
+            try:
+                with self._syncer_lock:
+                    view = {k: dict(v)
+                            for k, v in self._syncer_view.items()}
+                head._call("report_loads_gossip", view=view)
+            finally:
+                head.close()
+        except (OSError, rpc.RpcError):
+            pass
+
+    def _syncer_loop(self) -> None:
+        while True:
+            try:
+                self._syncer_tick()
+            except Exception:
+                pass
+            time.sleep(self._syncer_interval_s)
+
+    def handle_syncer_exchange(self, conn, rid, msg):
+        self._syncer_merge(msg["view"])
+        with self._syncer_lock:
+            return {"view": {k: dict(v)
+                             for k, v in self._syncer_view.items()}}
+
+    def handle_syncer_view(self, conn, rid, msg):
+        with self._syncer_lock:
+            return {"view": {k: dict(v)
+                             for k, v in self._syncer_view.items()}}
+
     # -- misc -------------------------------------------------------------
     def handle_core_release(self, conn, rid, msg):
         return {"ok": True}  # owner-side holds are driver-local
@@ -1224,6 +1341,8 @@ def main() -> None:
     head_host, head_port = args.head.rsplit(":", 1)
     head_addr = (head_host, int(head_port))
     service.head_addr = head_addr       # cross-language KV lookups
+    threading.Thread(target=service._syncer_loop, daemon=True,
+                     name="syncer-gossip").start()
     labels = json.loads(args.labels)
     head = HeadClient(head_addr)
     head.register_node(args.node_id, resources, labels, server.addr)

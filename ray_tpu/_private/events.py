@@ -21,12 +21,15 @@ class TaskEventBuffer:
         self._events: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
+        self._seq = 0
 
     def record(self, *, task_id: str, name: str, event: str,
                node_id: str = "", actor_id: str = "",
                extra: Optional[Dict] = None) -> None:
         with self._lock:
+            self._seq += 1
             self._events.append({
+                "seq": self._seq,
                 "task_id": task_id, "name": name, "event": event,
                 "node_id": node_id, "actor_id": actor_id,
                 "ts_us": (time.perf_counter() - self._t0) * 1e6,
@@ -36,6 +39,12 @@ class TaskEventBuffer:
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:
             return list(self._events)
+
+    def events_after(self, cursor: int) -> List[Dict[str, Any]]:
+        """Events with seq > cursor (the head-store flusher's incremental
+        read; reference: task_event_buffer.cc periodic flush)."""
+        with self._lock:
+            return [ev for ev in self._events if ev["seq"] > cursor]
 
     def clear(self) -> None:
         with self._lock:

@@ -69,6 +69,9 @@ declare("kv_keys", "prefix", "ns")
 declare("subscribe", "channel", "cursor")
 declare("publish", "channel", "event")
 declare("report_resources", "loads")
+declare("report_loads_gossip", "view")
+declare("task_events_push", "events")
+declare("task_events_get", "job_id", "name", "limit")
 declare("head_stop")
 
 # High-frequency gossip channels: never persisted, log trimmed to a
@@ -117,7 +120,52 @@ class _HeadStore:
         self._db.execute(
             "CREATE TABLE IF NOT EXISTS events (channel TEXT, idx INTEGER, "
             "event BLOB, PRIMARY KEY(channel, idx))")
+        # Head-side task-event store (reference: gcs_task_manager.h:94):
+        # task state transitions buffered by drivers land here so the
+        # state API / timeline survive driver exit. Bounded by row count.
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS task_events ("
+            "seq INTEGER PRIMARY KEY AUTOINCREMENT, "
+            "task_id TEXT, name TEXT, event TEXT, job_id TEXT, "
+            "wall_ts REAL, payload BLOB)")
         self._db.commit()
+
+    def append_task_events(self, events: List[Dict[str, Any]],
+                           max_rows: int) -> None:
+        self._db.executemany(
+            "INSERT INTO task_events "
+            "(task_id, name, event, job_id, wall_ts, payload) "
+            "VALUES (?, ?, ?, ?, ?, ?)",
+            [(ev.get("task_id", ""), ev.get("name", ""),
+              ev.get("event", ""), ev.get("job_id", ""),
+              ev.get("wall_ts", 0.0),
+              msgpack.packb(ev, use_bin_type=True))
+             for ev in events])
+        # bounded: drop the oldest rows past the cap (one statement,
+        # amortized — gcs_task_manager evicts the same way)
+        self._db.execute(
+            "DELETE FROM task_events WHERE seq <= ("
+            "SELECT MAX(seq) FROM task_events) - ?", (max_rows,))
+        self._db.commit()
+
+    def get_task_events(self, job_id: str = "", name: str = "",
+                        limit: int = 10_000) -> List[Dict[str, Any]]:
+        q = "SELECT payload FROM task_events"
+        cond, args = [], []
+        if job_id:
+            cond.append("job_id = ?")
+            args.append(job_id)
+        if name:
+            cond.append("name = ?")
+            args.append(name)
+        if cond:
+            q += " WHERE " + " AND ".join(cond)
+        q += " ORDER BY seq DESC LIMIT ?"
+        args.append(int(limit))
+        rows = self._db.execute(q, args).fetchall()
+        out = [msgpack.unpackb(r[0], raw=False) for r in rows]
+        out.reverse()
+        return out
 
     def load(self) -> Tuple[Dict[bytes, bytes], Dict[str, List[Any]]]:
         kv = {bytes(k): bytes(v) for k, v in
@@ -156,6 +204,14 @@ class HeadService:
         self._bases: Dict[str, int] = {}   # trimmed-channel log offsets
         self._parked: Dict[str, List[Tuple[Connection, int, int]]] = {}
         self._store: Optional[_HeadStore] = None
+        # task-event store: sqlite when persistent, bounded ring in
+        # memory otherwise (reference: gcs_task_manager.h:94)
+        self._task_events_cap = 100_000
+        # per-node load entries converged via daemon peer gossip
+        # (report_loads_gossip); versioned like the daemons' own views
+        self._gossip_loads: Dict[str, Dict[str, Any]] = {}
+        from collections import deque as _deque
+        self._task_events: Any = _deque(maxlen=self._task_events_cap)
         if state_path:
             self._store = _HeadStore(state_path)
             self._kv, self._events = self._store.load()
@@ -196,7 +252,13 @@ class HeadService:
 
     def handle_list_nodes(self, conn, rid, msg):
         with self._lock:
-            return {"nodes": [e.view() for e in self._nodes.values()]}
+            nodes = [e.view() for e in self._nodes.values()]
+            for n in nodes:
+                g = self._gossip_loads.get(n["node_id"])
+                if g is not None:
+                    n["gossip_load"] = g["load"]
+                    n["gossip_version"] = g["v"]
+            return {"nodes": nodes}
 
     def handle_drain_node(self, conn, rid, msg):
         self._mark_dead(msg["node_id"], "drained")
@@ -310,6 +372,31 @@ class HeadService:
         self._publish(msg["channel"], msg["event"])
         return {"ok": True}
 
+    # -- task events (reference: gcs_task_manager.h:94) ------------------
+    def handle_task_events_push(self, conn, rid, msg):
+        events = msg["events"]
+        with self._lock:
+            if self._store is not None:
+                self._store.append_task_events(events,
+                                               self._task_events_cap)
+            else:
+                self._task_events.extend(events)
+        return {"ok": True, "count": len(events)}
+
+    def handle_task_events_get(self, conn, rid, msg):
+        job_id = msg.get("job_id") or ""
+        name = msg.get("name") or ""
+        limit = int(msg.get("limit") or 10_000)
+        with self._lock:
+            if self._store is not None:
+                out = self._store.get_task_events(job_id, name, limit)
+            else:
+                out = [ev for ev in self._task_events
+                       if (not job_id or ev.get("job_id") == job_id)
+                       and (not name or ev.get("name") == name)]
+                out = out[-limit:]
+        return {"events": out}
+
     def handle_report_resources(self, conn, rid, msg):
         """Resource-view gossip (the RaySyncer role,
         ``common/ray_syncer/ray_syncer.h:83``): the scheduling authority
@@ -327,6 +414,18 @@ class HeadService:
                     updated[node_hex] = dict(avail)
         if updated:
             self._publish("resources", {"available": updated})
+        return {"ok": True}
+
+    def handle_report_loads_gossip(self, conn, rid, msg):
+        """Peer-gossip ingestion (reference: ray_syncer.h:83): ONE node
+        per gossip interval pushes the cluster-wide merged view it
+        converged on — the head never needs per-node load reports, so
+        its inbound load-report rate is O(1) in cluster size."""
+        with self._lock:
+            for node_hex, entry in msg["view"].items():
+                cur = self._gossip_loads.get(node_hex)
+                if cur is None or entry["v"] > cur["v"]:
+                    self._gossip_loads[node_hex] = dict(entry)
         return {"ok": True}
 
     def handle_head_stop(self, conn, rid, msg):
@@ -391,6 +490,15 @@ class HeadClient:
 
     def list_nodes(self) -> List[Dict[str, Any]]:
         return self._call("list_nodes")["nodes"]
+
+    def task_events_push(self, events: List[Dict[str, Any]]) -> int:
+        return self._call("task_events_push",
+                          events=events)["count"]
+
+    def task_events_get(self, job_id: str = "", name: str = "",
+                        limit: int = 10_000) -> List[Dict[str, Any]]:
+        return self._call("task_events_get", job_id=job_id, name=name,
+                          limit=limit)["events"]
 
     def mark_node_dead(self, node_id: str, reason: str) -> None:
         self._call("mark_node_dead", node_id=node_id, reason=reason)

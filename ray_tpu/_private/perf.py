@@ -147,7 +147,77 @@ def main() -> int:
     sys.stdout.flush()
     # scaling TREND: does the drain rate hold at 3x the backlog?
     print(json.dumps(queued_task_drain(3 * drain_n)))
+    sys.stdout.flush()
+    if os.environ.get("PERF_ENVELOPE") == "1":
+        for row in envelope_rows():
+            print(json.dumps(row))
+            sys.stdout.flush()
     return 0
+
+
+def envelope_rows() -> List[Dict]:
+    """Scale-envelope slices (reference: release/benchmarks/README.md
+    1M+ queued / 40k actors / 2,000 nodes): 100k-task drain, 5k live
+    actors, 64-virtual-node spread — committed as PERF.md evidence."""
+    import ray_tpu
+
+    rows: List[Dict] = []
+    own = not ray_tpu.is_initialized()
+    if own:
+        ray_tpu.init(num_nodes=1, resources={"CPU": 8})
+
+    @ray_tpu.remote(_in_process=True)
+    def val(i):
+        return i
+
+    t0 = time.perf_counter()
+    refs = [val.remote(i) for i in range(100_000)]
+    submit_s = time.perf_counter() - t0
+    out = ray_tpu.get(refs)
+    total_s = time.perf_counter() - t0
+    assert out[-1] == 99_999
+    rows.append({"name": "queued_100000_task_drain", "n": 100_000,
+                 "submit_seconds": round(submit_s, 3),
+                 "total_seconds": round(total_s, 3),
+                 "submit_per_s": round(100_000 / submit_s, 1),
+                 "drain_per_s": round(100_000 / total_s, 1)})
+
+    @ray_tpu.remote(_in_process=True)
+    class Cell:
+        def __init__(self, i):
+            self.i = i
+
+        def get(self):
+            return self.i
+
+    t0 = time.perf_counter()
+    cells = [Cell.remote(i) for i in range(5000)]
+    out = ray_tpu.get([c.get.remote() for c in cells])
+    total_s = time.perf_counter() - t0
+    assert out[-1] == 4999
+    rows.append({"name": "actors_5000_create_and_call",
+                 "throughput_per_s": round(5000 / total_s, 1),
+                 "count": 5000, "seconds": round(total_s, 3)})
+    for c in cells:
+        ray_tpu.kill(c)
+
+    # 64-node spread: its own runtime (node count is an init
+    # parameter) — the current one must go either way
+    ray_tpu.shutdown()
+    rt = ray_tpu.init(num_nodes=64, resources={"CPU": 2})
+
+    @ray_tpu.remote(_in_process=True, scheduling_strategy="SPREAD")
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    t0 = time.perf_counter()
+    nodes = set(ray_tpu.get([where.remote() for _ in range(256)]))
+    total_s = time.perf_counter() - t0
+    rows.append({"name": "spread_256_tasks_64_nodes",
+                 "throughput_per_s": round(256 / total_s, 1),
+                 "count": len(nodes), "seconds": round(total_s, 3)})
+    ray_tpu.shutdown()
+    return rows
 
 
 if __name__ == "__main__":

@@ -997,6 +997,15 @@ class Runtime:
 
     def _on_task_done(self, spec: TaskSpec, state: str) -> None:
         self.stats["tasks_finished"] += 1
+        # Per-task borrow release (reference: reference_count.h:73): refs
+        # the owner created on this task's behalf (nested put/submit from
+        # its worker) un-pin NOW — results are already stored, so
+        # containment keeps anything the task returned alive. Without
+        # this a long-lived daemon pins dead tasks' objects forever.
+        backend = getattr(self, "cluster_backend", None)
+        svc = getattr(backend, "owner_service", None)
+        if svc is not None:
+            svc.holder.release("t:" + spec.task_id.hex())
         from ray_tpu._private.export_events import emit_export
         emit_export("TASK", task_id=spec.task_id.hex(), name=spec.name,
                     state=state, kind=str(spec.kind),
@@ -1488,6 +1497,13 @@ class Runtime:
                             pending_tasks: List[TaskSpec],
                             may_restart: bool) -> None:
         self.process_router.discard_actor(actor_id)
+        # Actor-lifetime borrows die with the incarnation (a restart
+        # rebuilds state from creation args; the old in-worker refs are
+        # gone either way).
+        svc = getattr(getattr(self, "cluster_backend", None),
+                      "owner_service", None)
+        if svc is not None:
+            svc.holder.release("a:" + actor_id.hex())
         remote = self._remote_actors.pop(actor_id, None)
         if remote is not None and not remote.dead:
             remote.kill_actor(actor_id, expected=True)

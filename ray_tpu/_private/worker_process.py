@@ -153,6 +153,25 @@ def fetch_function_blob(fid: str) -> bytes:
 _current_rid = threading.local()
 
 
+def _borrower_key() -> Optional[str]:
+    """Owner-side borrow key for refs created on this caller's behalf:
+    actor context → held for the actor's lifetime; task context → held
+    until the owner finishes that task (reference: per-task borrows,
+    ``reference_count.h:73``)."""
+    try:
+        from ray_tpu._private import runtime_context
+        ctx = runtime_context._ctx.get()
+    except Exception:
+        return None
+    if ctx is None:
+        return None
+    if ctx.actor_id is not None:
+        return "a:" + ctx.actor_id.hex()
+    if ctx.task_id is not None:
+        return "t:" + ctx.task_id.hex()
+    return None
+
+
 def _dump_exc(e: BaseException) -> bytes:
     tb = traceback.format_exc()
     try:
@@ -355,6 +374,11 @@ class _WorkerState:
         from ray_tpu._private.device_objects import wire_dumps
         self.send({"op": "core", "id": rid, "call": call,
                    "task": getattr(_current_rid, "rid", None),
+                   # globally-unique borrower key (reference: per-task
+                   # borrow tracking, reference_count.h:73) — the worker
+                   # rid above is only unique per process, so the
+                   # owner's cross-daemon holder cannot key on it
+                   "task_key": _borrower_key(),
                    "payload": wire_dumps(kw)})   # device args preserved
         ev.wait()
         if slot[1]:

@@ -11,7 +11,8 @@ from ray_tpu.data.aggregate import Count, Max, Mean, Min, Std, Sum
 from ray_tpu.data.dataset import Dataset, GroupedData
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
-                                   from_pandas, range, read_binary_files,
+                                   from_pandas, range, read_avro,
+                                   read_binary_files,
                                    read_csv, read_images, read_json,
                                    read_numpy, read_parquet, read_sql,
                                    read_text, read_tfrecords,
@@ -25,6 +26,7 @@ __all__ = [
     "read_images",
     "read_numpy",
     "read_sql",
+    "read_avro",
     "read_tfrecords",
     "read_webdataset",
     "Count", "Sum", "Min", "Max", "Mean", "Std",

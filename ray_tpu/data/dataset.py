@@ -398,6 +398,21 @@ class Dataset:
                 with fs.open_output(f"{local}/part-{i:05d}.npy") as f:
                     f.write(buf.getvalue())
 
+    def write_avro(self, path: str) -> None:
+        """One Avro Object Container File per block (reference:
+        Dataset.write_avro via fastavro; here data/avro.py's native
+        codec, deflate blocks, schema inferred per dataset)."""
+        from ray_tpu.data.avro import infer_schema, write_container
+        from ray_tpu.data.filesystem import resolve_filesystem
+        fs, local = resolve_filesystem(path)
+        fs.makedirs(local)
+        for i, block in enumerate(self.iter_blocks()):
+            if block.num_rows:
+                rows = block.to_pylist()
+                blob = write_container(infer_schema(rows), rows)
+                with fs.open_output(f"{local}/part-{i:05d}.avro") as f:
+                    f.write(blob)
+
     def write_tfrecords(self, path: str) -> None:
         """One TFRecord shard per block, rows as tf.train.Example
         (crc32c-framed; no TensorFlow — data/tfrecords.py)."""

@@ -295,3 +295,25 @@ def read_webdataset(paths) -> Dataset:
         return block_from_rows(rows)
 
     return _file_read_dataset(paths, ".tar", reader, "read_webdataset")
+
+
+def read_avro(paths) -> Dataset:
+    """Avro Object Container Files (reference: `data/read_api.py`
+    read_avro via fastavro; this image has no avro wheel —
+    `data/avro.py` speaks the container framing + binary encoding
+    directly, null/deflate codecs)."""
+    from ray_tpu.data.avro import read_container
+
+    def reader(f):
+        with _seam_open(f) as fh:
+            blob = fh.read()
+        _, records = read_container(blob)
+        rows = [r if isinstance(r, dict) else {"value": r}
+                for r in records]
+        all_cols = {c for r in rows for c in r}
+        for r in rows:
+            for c in all_cols:
+                r.setdefault(c, None)
+        return block_from_rows(rows)
+
+    return _file_read_dataset(paths, ".avro", reader, "read_avro")

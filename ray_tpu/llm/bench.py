@@ -131,3 +131,134 @@ def run_serving_bench(error: Optional[str] = None) -> dict:
     if error:
         out["error"] = error
     return out
+
+
+def run_http_proxy_bench(error: Optional[str] = None) -> dict:
+    """Proxy-level serving bench: p50 TTFT + output tok/s measured AT
+    THE HTTP CLIENT through the asyncio ingress + Serve data plane +
+    engine — the full serving path the reference drives
+    (``release/llm_tests/serve/benchmark/load_test.py:802-809``), not
+    the engine-direct numbers of :func:`run_serving_bench`."""
+    import http.client
+    import json
+    import threading
+
+    import jax
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm.serving import LLMConfig, build_llm_app
+    from ray_tpu.models.llama import LlamaConfig
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    if on_tpu:
+        model_cfg = LlamaConfig.bench_400m(max_seq_len=1024)
+        n_requests, concurrency, max_tokens = 64, 16, 64
+        prompt_len = 64
+    else:
+        model_cfg = None   # LLMServer debug config
+        n_requests, concurrency, max_tokens = 8, 4, 8
+        prompt_len = 12
+
+    own = not ray_tpu.is_initialized()
+    if own:
+        ray_tpu.init(num_nodes=1, resources={"CPU": 8})
+    cfg = LLMConfig(model_config=model_cfg, max_slots=16,
+                    max_seq=(1024 if on_tpu else 128))
+    serve.run(build_llm_app(cfg))
+    port = serve.start_http_proxy(port=0, max_ongoing_requests=256)
+
+    rng = np.random.default_rng(0)
+    vocab = model_cfg.vocab_size if model_cfg else 512
+
+    def one_request(out, idx):
+        prompt = [int(x) for x in
+                  rng.integers(1, vocab, prompt_len)]
+        body = json.dumps({"prompt": prompt, "stream": True,
+                           "max_tokens": max_tokens})
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=300)
+        t0 = time.perf_counter()
+        ttft = None
+        tokens = 0
+        try:
+            conn.request("POST", "/", body=body,
+                         headers={"Content-Type": "application/json",
+                                  "Accept": "text/event-stream"})
+            resp = conn.getresponse()
+            buf = b""
+            while True:
+                chunk = resp.read(4096)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n\n" in buf:
+                    event, buf = buf.split(b"\n\n", 1)
+                    if not event.startswith(b"data: "):
+                        continue
+                    data = event[6:]
+                    if data == b"[DONE]":
+                        break
+                    if ttft is None:
+                        ttft = time.perf_counter() - t0
+                    try:
+                        if "token_id" in json.loads(data):
+                            tokens += 1
+                    except json.JSONDecodeError:
+                        pass
+        finally:
+            conn.close()
+        out[idx] = (ttft, tokens)
+
+    # warmup burst (compiles prefill/decode shapes outside the timing)
+    warm: dict = {}
+    warm_threads = [threading.Thread(target=one_request,
+                                     args=(warm, i))
+                    for i in range(min(concurrency, 4))]
+    for t in warm_threads:
+        t.start()
+    for t in warm_threads:
+        t.join()
+
+    results: dict = {}
+    t0 = time.perf_counter()
+    sem = threading.Semaphore(concurrency)
+
+    def gated(idx):
+        with sem:
+            one_request(results, idx)
+
+    threads = [threading.Thread(target=gated, args=(i,))
+               for i in range(n_requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    ttfts = sorted(t for t, _ in results.values() if t is not None)
+    total_tokens = sum(n for _, n in results.values())
+    out = {
+        "metric": "llm_serve_http_output_tokens_per_sec",
+        "value": round(total_tokens / wall, 1) if wall else 0.0,
+        "unit": "tokens/s",
+        "vs_baseline": round(_percentile(ttfts, 50), 4),
+        "detail": {
+            "ttft_p50_ms": round(_percentile(ttfts, 50) * 1e3, 2),
+            "ttft_p90_ms": round(_percentile(ttfts, 90) * 1e3, 2),
+            "requests": n_requests,
+            "concurrency": concurrency,
+            "output_tokens": total_tokens,
+            "wall_s": round(wall, 3),
+            "plane": "asyncio-http-proxy",
+            "device": getattr(dev, "device_kind", dev.platform),
+        },
+    }
+    serve.shutdown()
+    if own:
+        ray_tpu.shutdown()
+    if error:
+        out["error"] = error
+    return out

@@ -264,6 +264,25 @@ def cmd_job_submit(args) -> int:
     return 0 if status == "SUCCEEDED" else 1
 
 
+def cmd_up(args) -> int:
+    """Create the cluster described by a YAML config (reference:
+    `ray up`, scripts.py:1419 over autoscaler commands.py)."""
+    from ray_tpu.cluster_launcher import up
+    state = up(args.config_file)
+    workers = sum(1 for n in state["nodes"] if n["kind"] == "worker")
+    print(f"cluster {state['cluster_name']!r} up at {state['address']} "
+          f"({workers} workers)")
+    print(f'connect with: ray_tpu.init(address="{state["address"]}")')
+    return 0
+
+
+def cmd_down(args) -> int:
+    from ray_tpu.cluster_launcher import down
+    n = down(args.config_file)
+    print(f"terminated {n} nodes")
+    return 0
+
+
 def cmd_debug(args) -> int:
     """List active remote-debugger sessions or attach to one
     (reference: the `ray debug` CLI over ray.util.rpdb). Listing reads
@@ -361,6 +380,11 @@ def main(argv=None) -> int:
     p = sub.add_parser("job-submit")
     p.add_argument("entrypoint")
     p.add_argument("--timeout", type=float, default=300.0)
+    p = sub.add_parser("up")
+    p.add_argument("config_file", help="cluster YAML (see "
+                                       "ray_tpu/cluster_launcher.py)")
+    p = sub.add_parser("down")
+    p.add_argument("config_file")
     p = sub.add_parser("debug")
     p.add_argument("session", nargs="?", default="",
                    help="host:port of a session to attach; empty = list")
@@ -378,6 +402,7 @@ def main(argv=None) -> int:
         "memory": cmd_memory, "timeline": cmd_timeline,
         "microbenchmark": cmd_microbenchmark, "dashboard": cmd_dashboard,
         "serve-deploy": cmd_serve_deploy, "job-submit": cmd_job_submit,
+        "up": cmd_up, "down": cmd_down,
         "debug": cmd_debug,
     }[args.command]
     return handler(args)

@@ -80,7 +80,10 @@ def delete(app_name: str) -> None:
 def shutdown() -> None:
     global _http_server, _http_thread
     if _http_server is not None:
-        _http_server.shutdown()
+        if hasattr(_http_server, "shutdown"):
+            _http_server.shutdown()     # legacy ThreadingHTTPServer
+        else:
+            _http_server.stop()         # asyncio proxy
         _http_server = None
         _http_thread = None
     try:
@@ -172,18 +175,36 @@ class _ProxyHandler(BaseHTTPRequestHandler):
         self.do_POST()
 
 
-def start_http_proxy(port: int = 8000, host: str = "127.0.0.1") -> int:
-    """Start the HTTP proxy serving all running applications. Returns the
-    bound port (0 picks a free one)."""
+def start_http_proxy(port: int = 8000, host: str = "127.0.0.1", *,
+                     max_ongoing_requests: int = 200,
+                     request_timeout_s: float = 60.0,
+                     legacy_threaded: bool = False) -> int:
+    """Start the HTTP ingress serving all running applications; returns
+    the bound port (0 picks a free one).
+
+    Default plane: the asyncio proxy (``serve/http_proxy.py`` — one
+    event loop, keep-alive, SSE streaming, and ingress backpressure
+    shedding 503s past ``max_ongoing_requests``; reference:
+    ``serve/_private/proxy.py:697``). ``legacy_threaded=True`` keeps the
+    old thread-per-connection stdlib server."""
     global _http_server, _http_thread
     if _http_server is not None:
-        return _http_server.server_address[1]
+        return (_http_server.server_address[1]
+                if hasattr(_http_server, "server_address")
+                else _http_server.port)
     controller = _get_controller(create=False)
-    _ProxyHandler.handles = {
-        app: DeploymentHandle(ingress, controller)
-        for app, ingress in _apps.items()}
-    _http_server = ThreadingHTTPServer((host, port), _ProxyHandler)
-    _http_thread = threading.Thread(
-        target=_http_server.serve_forever, daemon=True)
-    _http_thread.start()
-    return _http_server.server_address[1]
+    handles = {app: DeploymentHandle(ingress, controller)
+               for app, ingress in _apps.items()}
+    if legacy_threaded:
+        _ProxyHandler.handles = handles
+        _http_server = ThreadingHTTPServer((host, port), _ProxyHandler)
+        _http_thread = threading.Thread(
+            target=_http_server.serve_forever, daemon=True)
+        _http_thread.start()
+        return _http_server.server_address[1]
+    from ray_tpu.serve.http_proxy import AsyncHTTPProxy
+    _http_server = AsyncHTTPProxy(
+        handles, host=host, port=port,
+        max_ongoing_requests=max_ongoing_requests,
+        request_timeout_s=request_timeout_s)
+    return _http_server.start()

@@ -7,6 +7,8 @@ reporting and checkpoint plumbing shared with Train.
 
 from ray_tpu.tune.schedulers import (AsyncHyperBandScheduler, FIFOScheduler,
                                      PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.search import (BasicVariantGenerator, BOHBSearcher,
+                                 HaltonSearcher, Searcher, TPESearcher)
 from ray_tpu.tune.search_space import (choice, grid_search, loguniform,
                                        randint, sample_from, uniform)
 from ray_tpu.tune.tuner import (ResultGrid, Trial, TuneConfig, Tuner, report,
@@ -19,4 +21,6 @@ __all__ = [
     "sample_from",
     "FIFOScheduler", "AsyncHyperBandScheduler", "PopulationBasedTraining",
     "TrialScheduler",
+    "Searcher", "BasicVariantGenerator", "HaltonSearcher", "TPESearcher",
+    "BOHBSearcher",
 ]

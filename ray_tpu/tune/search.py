@@ -127,6 +127,11 @@ class TPESearcher(Searcher):
                 and math.isfinite(metric_value):
             self._observed.append((config, float(metric_value)))
 
+    def _model_observations(self) -> List[Tuple[Dict[str, Any], float]]:
+        """The observation pool the Parzen model fits; BOHB narrows this
+        to the most-informative fidelity."""
+        return self._observed
+
     # -- numeric helpers ---------------------------------------------------
     @staticmethod
     def _to_unit(dom, value) -> Optional[float]:
@@ -164,17 +169,21 @@ class TPESearcher(Searcher):
         return math.log(max(acc / (len(points) * bw), 1e-12))
 
     # -- suggestion --------------------------------------------------------
+    def _random_config(self) -> Dict[str, Any]:
+        return {key: (dom.sample(self._rng)
+                      if isinstance(dom, Domain)
+                      else (self._rng.choice(dom.values)
+                            if isinstance(dom, GridSearch) else dom))
+                for key, dom in self.space.items()}
+
     def suggest(self, trial_id: str) -> Dict[str, Any]:
-        if len(self._observed) < self.n_startup:
-            config = {key: (dom.sample(self._rng)
-                            if isinstance(dom, Domain)
-                            else (self._rng.choice(dom.values)
-                                  if isinstance(dom, GridSearch) else dom))
-                      for key, dom in self.space.items()}
+        observed = self._model_observations()
+        if len(observed) < self.n_startup:
+            config = self._random_config()
             self._pending[trial_id] = config
             return config
 
-        ranked = sorted(self._observed, key=lambda cv: cv[1])
+        ranked = sorted(observed, key=lambda cv: cv[1])
         n_good = max(1, int(self.gamma * len(ranked)))
         good, bad = ranked[:n_good], ranked[n_good:]
 
@@ -221,3 +230,62 @@ class TPESearcher(Searcher):
                 config[key] = dom
         self._pending[trial_id] = config
         return config
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model-based component (reference:
+    ``python/ray/tune/search/bohb/bohb_search.py`` wrapping HpBandSter):
+    a TPE whose observation pool is MULTI-FIDELITY — intermediate
+    results at each rung budget feed per-budget pools, and the Parzen
+    model fits the largest budget that has enough observations. Pair it
+    with the ASHA scheduler (the async-hyperband role) for full BOHB
+    behavior: the scheduler culls, this searcher proposes.
+
+    The trial controller calls :meth:`on_trial_result` for every
+    ``tune.report`` (budget = the scheduler's time_attr value).
+    """
+
+    def __init__(self, n_startup: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: int = 0):
+        super().__init__(n_startup=n_startup, gamma=gamma,
+                         n_candidates=n_candidates, seed=seed)
+        # budget -> {trial_id: (config, latest value at that budget)}
+        self._by_budget: Dict[int, Dict[str, Tuple[Dict[str, Any],
+                                                   float]]] = {}
+
+    def on_trial_result(self, trial_id: str, budget: Any,
+                        metric_value: Optional[float]) -> None:
+        config = self._pending.get(trial_id)
+        if (config is None or metric_value is None
+                or not math.isfinite(metric_value)):
+            return
+        try:
+            b = int(budget)
+        except (TypeError, ValueError):
+            return
+        self._by_budget.setdefault(b, {})[trial_id] = (
+            config, float(metric_value))
+
+    def on_trial_complete(self, trial_id: str,
+                          metric_value: Optional[float]) -> None:
+        config = self._pending.pop(trial_id, None)
+        if config is not None and metric_value is not None \
+                and math.isfinite(metric_value):
+            self._observed.append((config, float(metric_value)))
+
+    def _model_observations(self) -> List[Tuple[Dict[str, Any], float]]:
+        # BOHB rule: fit on the LARGEST budget with enough points —
+        # high-fidelity signal dominates when available, low-fidelity
+        # rungs bootstrap the model early
+        for b in sorted(self._by_budget, reverse=True):
+            pool = self._by_budget[b]
+            if len(pool) >= self.n_startup:
+                return list(pool.values())
+        if self._observed:
+            return self._observed
+        # fall back to the richest partial pool to leave startup ASAP
+        best: List[Tuple[Dict[str, Any], float]] = []
+        for pool in self._by_budget.values():
+            if len(pool) > len(best):
+                best = list(pool.values())
+        return best

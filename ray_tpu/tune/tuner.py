@@ -321,6 +321,21 @@ class Tuner:
                     entries = []
                 for entry in entries:
                     self._consume_entry(trial, entry, cbs)
+                    # multi-fidelity searchers (BOHB) ingest every
+                    # intermediate report at its budget (= the
+                    # scheduler's time_attr value)
+                    on_res = getattr(searcher, "on_trial_result", None)
+                    if on_res is not None:
+                        metrics = entry["metrics"]
+                        value = metrics.get(self.cfg.metric)
+                        if value is not None:
+                            if self.cfg.mode == "max":
+                                value = -float(value)
+                            on_res(getattr(trial, "search_id", ""),
+                                   metrics.get(
+                                       getattr(scheduler, "time_attr",
+                                               "training_iteration")),
+                                   value)
                     if scheduler.on_result(trial, entry["metrics"]) == STOP:
                         trial.actor.stop.remote()
                         trial.status = "STOPPED"

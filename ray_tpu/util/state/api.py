@@ -111,6 +111,59 @@ def summarize_tasks() -> Dict[str, int]:
     return dict(counts)
 
 
+def list_tasks_from_head(address: str, *, job_id: str = "",
+                         limit: int = 10_000) -> List[Dict[str, Any]]:
+    """Post-mortem task listing straight from the HEAD's task-event
+    store (reference: gcs_task_manager.h:94) — works with no runtime in
+    this process and after the submitting driver exited. ``address`` is
+    the head's host:port."""
+    from ray_tpu._private.head import HeadClient
+    host, port = address.rsplit(":", 1)
+    head = HeadClient((host, int(port)))
+    try:
+        events = head.task_events_get(job_id=job_id, limit=limit)
+    finally:
+        head.close()
+    rows: Dict[str, Dict[str, Any]] = {}
+    for ev in events:
+        row = rows.setdefault(ev["task_id"], {
+            "task_id": ev["task_id"], "name": ev["name"],
+            "state": ev["event"], "node_id": ev.get("node_id") or None,
+            "job_id": ev.get("job_id", ""),
+            "required_resources": {}})
+        row["state"] = ev["event"]
+        # placement is only known from RUNNING onward: keep the latest
+        # non-empty node rather than the submission event's blank
+        if ev.get("node_id"):
+            row["node_id"] = ev["node_id"]
+    return list(rows.values())
+
+
+def timeline_from_head(address: str, path: Optional[str] = None,
+                       *, job_id: str = "") -> Any:
+    """Chrome-trace timeline rebuilt from the head's task-event store —
+    post-mortem counterpart of :func:`timeline`."""
+    import json as _json
+
+    from ray_tpu._private.events import TaskEventBuffer
+    from ray_tpu._private.head import HeadClient
+    host, port = address.rsplit(":", 1)
+    head = HeadClient((host, int(port)))
+    try:
+        events = head.task_events_get(job_id=job_id)
+    finally:
+        head.close()
+    buf = TaskEventBuffer()
+    with buf._lock:
+        buf._events.extend(events)
+    trace = buf.chrome_trace()
+    if path:
+        with open(path, "w") as f:
+            _json.dump(trace, f)
+        return path
+    return trace
+
+
 def timeline(path: Optional[str] = None) -> Any:
     """Chrome-trace dump of task events (reference: `ray timeline`)."""
     rt = _rt()

@@ -100,3 +100,60 @@ def test_cli_start_join_two_drivers_stop(tmp_path):
         time.sleep(0.3)
     assert st.returncode != 0 or not [
         n for n in json.loads(st.stdout)["nodes"] if n["alive"]]
+
+
+def test_ray_tpu_up_down_subprocess_provider(tmp_path):
+    """`ray-tpu up` with the subprocess provider creates a REAL head +
+    worker-daemon cluster a driver can join; `down` terminates it
+    (reference: `ray up` over autoscaler commands + NodeUpdater)."""
+    import ray_tpu
+    from ray_tpu import cluster_launcher as cl
+
+    config = tmp_path / "cluster.yaml"
+    config.write_text(
+        "cluster_name: up-test\n"
+        "provider:\n  type: subprocess\n"
+        "head:\n  resources: {CPU: 2}\n"
+        "worker:\n  resources: {CPU: 2}\n  count: 2\n")
+    state = cl.up(str(config))
+    try:
+        assert cl.wait_for_nodes(state["address"], 2, timeout=60)
+        rt = ray_tpu.init(address=state["address"])
+
+        @ray_tpu.remote
+        def pid():
+            import os
+            return os.getpid()
+
+        pids = set(ray_tpu.get([pid.remote() for _ in range(4)],
+                               timeout=120))
+        assert pids and all(p != __import__("os").getpid()
+                            for p in pids)
+        ray_tpu.shutdown()
+        # idempotent: a second `up` adds nothing
+        state2 = cl.up(str(config))
+        assert sum(1 for n in state2["nodes"]
+                   if n["kind"] == "worker") == 2
+    finally:
+        n = cl.down(str(config))
+    assert n == 3    # head + 2 workers
+
+
+def test_ssh_provider_command_shape(tmp_path):
+    """SshProvider builds correct bootstrap command lines (the
+    NodeUpdater contract); run=False returns without executing."""
+    from ray_tpu.cluster_launcher import SshProvider
+
+    p = SshProvider(user="tpu", hosts=["h1", "h2"], key="/k",
+                    repo="/srv/ray_tpu", run=False)
+    head = p.create_head({"resources": {"CPU": 4}})
+    assert head["address"] == "h1:6379"
+    assert head["command"][:3] == ["ssh", "-o", "StrictHostKeyChecking=no"]
+    assert "tpu@h1" in head["command"]
+    w1 = p.create_worker("h1:6379", {"resources": {"CPU": 4, "TPU": 4}})
+    w2 = p.create_worker("h1:6379", {"resources": {"CPU": 4}})
+    assert w1["host"] == "h1" and w2["host"] == "h2"  # round robin
+    remote = w1["command"][-1]
+    assert "--head h1:6379" in remote
+    assert "--host 0.0.0.0" in remote
+    assert '"TPU": 4' in remote

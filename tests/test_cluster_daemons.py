@@ -444,3 +444,146 @@ def test_resource_view_gossip(daemon_cluster):
         time.sleep(0.05)
     assert all(v == 4 for v in per_node.values()), per_node
     assert events and "available" in events[-1]
+
+
+def test_per_task_borrow_release(daemon_cluster):
+    """Refs the owner pins on a worker task's behalf (nested put) release
+    when THAT task finishes — a long-lived daemon must not pin dead
+    tasks' objects (reference: per-task borrows, reference_count.h:73)."""
+    rt = daemon_cluster
+    from ray_tpu._private.ids import ObjectID
+
+    @ray_tpu.remote
+    def put_and_drop():
+        ref = ray_tpu.put(np.arange(1000))
+        return ref.id.hex()      # the hex only: no live ref escapes
+
+    @ray_tpu.remote
+    def put_and_return():
+        return ray_tpu.put(np.arange(1000))
+
+    # dropped borrow: freed once the task is done (the dropped handle
+    # can sit in a reply-closure cycle, so nudge the cyclic collector)
+    import gc
+    oid_hex = ray_tpu.get(put_and_drop.remote())
+    deadline = time.monotonic() + 5.0
+    oid = ObjectID.from_hex(oid_hex)
+    while time.monotonic() < deadline and rt.refcounter.ref_count(oid):
+        gc.collect()
+        time.sleep(0.05)
+    assert rt.refcounter.ref_count(oid) == 0
+    svc = rt.cluster_backend.owner_service
+    assert svc.holder.num_keys() == 0, "holder leaked task keys"
+
+    # returned borrow: containment in the result keeps it alive
+    inner = ray_tpu.get(put_and_return.remote())
+    assert list(ray_tpu.get(inner)[:3]) == [0, 1, 2]
+
+
+def test_actor_borrow_released_on_death(daemon_cluster):
+    """Actor-lifetime borrows persist across its tasks, then release on
+    actor death."""
+    rt = daemon_cluster
+    from ray_tpu._private.ids import ObjectID
+
+    @ray_tpu.remote
+    class Holder:
+        def make(self):
+            self.ref = ray_tpu.put(np.arange(500))
+            return self.ref.id.hex()
+
+        def read(self):
+            return int(ray_tpu.get(self.ref)[1])
+
+    h = Holder.remote()
+    oid = ObjectID.from_hex(ray_tpu.get(h.make.remote()))
+    assert ray_tpu.get(h.read.remote()) == 1   # alive across actor tasks
+    assert rt.refcounter.ref_count(oid) > 0
+    ray_tpu.kill(h)
+    import gc
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and rt.refcounter.ref_count(oid):
+        gc.collect()
+        time.sleep(0.05)
+    assert rt.refcounter.ref_count(oid) == 0
+
+
+def test_head_task_event_store(daemon_cluster):
+    """Task state transitions buffer at the HEAD (reference:
+    gcs_task_manager.h:94) so list/timeline queries outlive the driver's
+    in-process buffer."""
+    rt = daemon_cluster
+    backend = rt.cluster_backend
+
+    @ray_tpu.remote
+    def marked():
+        return 1
+
+    ray_tpu.get([marked.remote() for _ in range(5)])
+    backend._flush_task_events()
+    # wipe the driver-side buffer: reads below must come from the head
+    rt.task_events.clear()
+    events = backend.head.task_events_get()
+    finished = [e for e in events
+                if e["event"] == "FINISHED" and "marked" in e["name"]]
+    assert len(finished) == 5, events
+    assert all(e["job_id"] == rt.job_id.hex() for e in finished)
+    # exact-name server-side filter round trip
+    exact = backend.head.task_events_get(name=finished[0]["name"])
+    assert len([e for e in exact if e["event"] == "FINISHED"]) == 5
+    # job filter excludes other jobs
+    assert backend.head.task_events_get(job_id="deadbeef") == []
+
+
+def test_post_mortem_state_from_head(daemon_cluster):
+    """list_tasks_from_head / timeline_from_head answer from the head
+    store alone — the post-driver-exit introspection path."""
+    rt = daemon_cluster
+    backend = rt.cluster_backend
+
+    @ray_tpu.remote
+    def traced():
+        return 1
+
+    ray_tpu.get([traced.remote() for _ in range(3)])
+    backend._flush_task_events()
+    addr = f"{backend.head.addr[0]}:{backend.head.addr[1]}"
+    from ray_tpu.util.state.api import (list_tasks_from_head,
+                                        timeline_from_head)
+    rows = list_tasks_from_head(addr)
+    done = [r for r in rows
+            if "traced" in r["name"] and r["state"] == "FINISHED"]
+    assert len(done) == 3
+    trace = timeline_from_head(addr)
+    assert isinstance(trace, list)
+
+
+def test_peer_resource_gossip(daemon_cluster):
+    """Daemon-to-daemon anti-entropy (reference: ray_syncer.h:83 bidi
+    gossip): each daemon's view converges to contain EVERY node's load
+    entry via peer exchange, and the head's membership view gains
+    gossip_load entries pushed by ~one node per interval."""
+    rt = daemon_cluster
+    handles = _daemon_handles(rt)
+    all_ids = {h.node_id.hex() for h in handles}
+    deadline = time.monotonic() + 15
+    converged = False
+    while time.monotonic() < deadline and not converged:
+        views = [set(h.client.call("syncer_view")["view"])
+                 for h in handles]
+        converged = all(all_ids <= v for v in views)
+        if not converged:
+            time.sleep(0.2)
+    assert converged, f"gossip never converged: {views}"
+    # entries carry real load fields
+    view = handles[0].client.call("syncer_view")["view"]
+    entry = view[handles[1].node_id.hex()]
+    assert {"running", "store_used", "fast_queued"} <= set(entry["load"])
+    # the head picked up gossip entries without per-node reports
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        nodes = rt.cluster_backend.head.list_nodes()
+        if any("gossip_load" in n for n in nodes):
+            break
+        time.sleep(0.2)
+    assert any("gossip_load" in n for n in nodes), nodes

@@ -428,3 +428,68 @@ def test_run_config_validation_errors(serve_cluster):
     # a typo'd path is a file error, not a schema error
     with _pytest.raises(FileNotFoundError):
         serve.run_config("/nonexistent/app.yaml")
+
+
+def test_http_proxy_ingress_backpressure(serve_cluster):
+    """The asyncio ingress sheds load with 503 + Retry-After once
+    max_ongoing_requests is hit (reference: proxy backpressure), instead
+    of queueing unboundedly."""
+    import http.client
+    import threading as _threading
+
+    @serve.deployment(max_ongoing_requests=16)
+    def slow(payload):
+        time.sleep(1.0)
+        return {"ok": True}
+
+    serve.run(slow.bind())
+    port = serve.start_http_proxy(port=0, max_ongoing_requests=2)
+    codes = []
+    lock = _threading.Lock()
+
+    def hit():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            conn.request("POST", "/", body=json.dumps({}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            with lock:
+                codes.append((resp.status,
+                              resp.getheader("Retry-After")))
+            resp.read()
+        finally:
+            conn.close()
+
+    threads = [_threading.Thread(target=hit) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    status_codes = [c for c, _ in codes]
+    assert status_codes.count(200) >= 2
+    assert 503 in status_codes, codes
+    assert any(ra == "1" for c, ra in codes if c == 503)
+
+
+def test_http_proxy_keep_alive(serve_cluster):
+    """Two requests ride ONE connection (HTTP/1.1 keep-alive)."""
+    import http.client
+
+    @serve.deployment
+    def echo2(payload):
+        return {"got": payload}
+
+    serve.run(echo2.bind())
+    port = serve.start_http_proxy(port=0)
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        for i in range(2):
+            conn.request("POST", "/", body=json.dumps({"i": i}),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert json.loads(resp.read()) == {"got": {"i": i}}
+    finally:
+        conn.close()
+    proxy = serve.api._http_server
+    assert proxy.stats["requests"] >= 2

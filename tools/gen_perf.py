@@ -17,19 +17,22 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def run_mode(mode: str) -> list:
+def run_mode(mode: str, envelope: bool = False) -> list:
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     env["JAX_PLATFORMS"] = "cpu"
     env["RAY_TPU_LOG_TO_DRIVER"] = "0"
+    if envelope:
+        env["PERF_ENVELOPE"] = "1"
     if mode == "daemons":
         env["RAY_TPU_CLUSTER"] = "daemons"
     else:
         env.pop("RAY_TPU_CLUSTER", None)
     out = subprocess.run(
         [sys.executable, "-m", "ray_tpu._private.perf"],
-        capture_output=True, text=True, env=env, timeout=900)
+        capture_output=True, text=True, env=env,
+        timeout=(3600 if envelope else 900))
     if out.returncode != 0:
         raise RuntimeError(f"{mode} perf run failed:\n{out.stderr[-2000:]}")
     return [json.loads(line) for line in out.stdout.splitlines()
@@ -37,11 +40,17 @@ def run_mode(mode: str) -> list:
 
 
 def main() -> int:
+    envelope = os.environ.get("PERF_ENVELOPE", "1") == "1"
     rows = {}
     for mode in ("in-process", "daemons"):
-        rows[mode] = {r["name"]: r for r in run_mode(mode)}
+        rows[mode] = {r["name"]: r
+                      for r in run_mode(
+                          mode, envelope=(envelope
+                                          and mode == "in-process"))}
 
-    names = list(rows["in-process"])
+    env_names = ["queued_100000_task_drain", "actors_5000_create_and_call",
+                 "spread_256_tasks_64_nodes"]
+    names = [n for n in rows["in-process"] if n not in env_names]
     print("# PERF — core-op envelope (committed record)")
     print()
     print(f"Recorded {time.strftime('%Y-%m-%d')} on "
@@ -67,6 +76,26 @@ def main() -> int:
                         f"({r['total_seconds']}s total)")
             return "—"
         print(f"| {name} | {fmt(a)} | {fmt(b)} |")
+    env_rows = [rows["in-process"][n] for n in env_names
+                if n in rows["in-process"]]
+    if env_rows:
+        print()
+        print("## Scale envelope (single-host slices of "
+              "`release/benchmarks/README.md:5-31`)")
+        print()
+        print("| envelope probe | result |")
+        print("|---|---|")
+        for r in env_rows:
+            if "drain_per_s" in r:
+                print(f"| {r['name']} | submit {r['submit_per_s']:,.0f}/s, "
+                      f"drain {r['drain_per_s']:,.0f}/s "
+                      f"({r['total_seconds']}s total) |")
+            elif r["name"] == "spread_256_tasks_64_nodes":
+                print(f"| {r['name']} | {r['count']} distinct nodes hit, "
+                      f"{r['throughput_per_s']:,.0f} tasks/s |")
+            else:
+                print(f"| {r['name']} | {r['throughput_per_s']:,.0f}/s "
+                      f"({r['seconds']}s for {r['count']}) |")
     print()
     print("Notes: daemons mode pays the full serialization + RPC + "
           "process boundary on every op — the honest cost of the "

@@ -1,20 +1,22 @@
 #!/usr/bin/env bash
-# CI entry: the full suite in the default (in-process) topology, then the
-# protocol-sensitive suites again over REAL head+daemon OS processes
-# (reference: the default topology there IS processes — VERDICT r2 weak
-# #3 asks both paths to stay covered).
+# CI entry, three stages (reference: the default topology there IS real
+# processes — python/ray/tests/conftest.py:588 — so the WHOLE suite must
+# hold over the wire, not just protocol-picked files):
+#   1. full suite, in-process topology (the fast path)
+#   2. full suite again over REAL head+daemon OS processes
+#      (RAY_TPU_CLUSTER=daemons) — suites that manage their own
+#      clusters/processes (multihost, cluster_daemons, fast_lane) simply
+#      ignore the env and run identically
+#   3. the scale-envelope tier (100k drain / 5k actors / 64 nodes),
+#      excluded from stages 1-2 by pytest.ini's `-m "not envelope"`
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== full suite (in-process topology) ==="
+echo "=== stage 1: full suite (in-process topology) ==="
 python -m pytest tests/ -x -q
 
-echo "=== wire-protocol topology (RAY_TPU_CLUSTER=daemons) ==="
-RAY_TPU_CLUSTER=daemons python -m pytest \
-    tests/test_core_tasks.py tests/test_actors.py \
-    tests/test_placement_group.py tests/test_serve.py \
-    tests/test_train.py tests/test_data.py \
-    tests/test_hash_shuffle.py tests/test_train_elastic.py -q
-# daemon-dependent suites manage their own clusters (xlang C++ tier,
-# sharded device objects across real processes)
-python -m pytest tests/test_cpp_client.py tests/test_device_objects.py -q
+echo "=== stage 2: full suite (RAY_TPU_CLUSTER=daemons wire topology) ==="
+RAY_TPU_CLUSTER=daemons python -m pytest tests/ -q
+
+echo "=== stage 3: scale-envelope tier ==="
+python -m pytest tests/ -m envelope -q
