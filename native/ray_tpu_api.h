@@ -1,0 +1,322 @@
+// ray_tpu embedded C++ API (header-only facade).
+//
+// Reference capability: the C++ front end (`cpp/include/ray/api.h` —
+// ray::Init / ray::Put / ray::Get / ray::Task(...).Remote() over the
+// embedded CoreWorker). Here the embedded runtime is the native wire
+// client (libray_tpu_cpp_client.so, C ABI `rtc_*`); this header gives
+// a C++ program the same ergonomics with RAII handles and typed
+// argument/result marshalling — no Python anywhere in the process.
+//
+// Usage:
+//   ray_tpu_api::Runtime rt;
+//   rt.Init(head_host, head_port, daemon_host, daemon_port);
+//   rt.KvPut("k", "v");
+//   auto r = rt.SubmitTask("add", ray_tpu_api::Args().I(2).I(3));
+//   int64_t five = r.AsInt();
+//   rt.CreateActor("Counter", "c1", ray_tpu_api::Args());
+//   rt.CallActor("c1", "inc", ray_tpu_api::Args()).AsInt();
+//
+// Values cross the boundary as msgpack (the cross-language contract:
+// plain ints/floats/strings/bools/bytes — never language pickles).
+
+#pragma once
+
+#include <dlfcn.h>
+#include <stdint.h>
+
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ray_tpu_api {
+
+// -- minimal msgpack (what the xlang value contract allows) -----------------
+
+class Args {
+ public:
+  Args &I(int64_t v) {
+    count_++;
+    if (v >= 0 && v < 128) {
+      buf_.push_back(char(v));
+    } else {
+      buf_.push_back(char(0xd3));
+      for (int i = 7; i >= 0; i--) buf_.push_back(char((v >> (8 * i)) & 0xff));
+    }
+    return *this;
+  }
+  Args &D(double v) {
+    count_++;
+    buf_.push_back(char(0xcb));
+    uint64_t bits;
+    memcpy(&bits, &v, 8);
+    for (int i = 7; i >= 0; i--)
+      buf_.push_back(char((bits >> (8 * i)) & 0xff));
+    return *this;
+  }
+  Args &B(bool v) {
+    count_++;
+    buf_.push_back(char(v ? 0xc3 : 0xc2));
+    return *this;
+  }
+  Args &S(const std::string &s) {
+    count_++;
+    append_str(s);
+    return *this;
+  }
+  Args &Bin(const std::string &b) {
+    count_++;
+    buf_.push_back(char(0xc6));
+    uint32_t n = uint32_t(b.size());
+    for (int i = 3; i >= 0; i--) buf_.push_back(char((n >> (8 * i)) & 0xff));
+    buf_.append(b);
+    return *this;
+  }
+
+  // packed msgpack ARRAY of the accumulated values
+  std::string Packed() const {
+    std::string out;
+    if (count_ < 16) {
+      out.push_back(char(0x90 | count_));
+    } else {
+      out.push_back(char(0xdc));
+      out.push_back(char((count_ >> 8) & 0xff));
+      out.push_back(char(count_ & 0xff));
+    }
+    out.append(buf_);
+    return out;
+  }
+
+ private:
+  void append_str(const std::string &s) {
+    size_t n = s.size();
+    if (n < 32) {
+      buf_.push_back(char(0xa0 | n));
+    } else {
+      buf_.push_back(char(0xdb));
+      for (int i = 3; i >= 0; i--) buf_.push_back(char((n >> (8 * i)) & 0xff));
+    }
+    buf_.append(s);
+  }
+  std::string buf_;
+  size_t count_ = 0;
+};
+
+// -- result decoding --------------------------------------------------------
+
+struct Result {
+  bool ok = false;
+  std::string raw;     // msgpack on ok; UTF-8 error text otherwise
+  std::string Error() const { return ok ? "" : raw; }
+
+  int64_t AsInt() const {
+    const uint8_t *p = Bytes();
+    uint8_t t = p[0];
+    if (t < 0x80) return t;
+    if (t >= 0xe0) return int8_t(t);
+    // signed (0xd0-0xd3) sign-extend; unsigned (0xcc-0xcf) must NOT —
+    // msgpack-python packs 128..255 as 0xcc, 40000 as 0xcd, etc.
+    if (t == 0xd3 || t == 0xcf) return ReadBE(p + 1, 8);
+    if (t == 0xd2) return int32_t(ReadBE(p + 1, 4));
+    if (t == 0xce) return ReadBE(p + 1, 4);
+    if (t == 0xd1) return int16_t(ReadBE(p + 1, 2));
+    if (t == 0xcd) return ReadBE(p + 1, 2);
+    if (t == 0xd0) return int8_t(ReadBE(p + 1, 1));
+    if (t == 0xcc) return ReadBE(p + 1, 1);
+    throw std::runtime_error("result is not an int");
+  }
+  double AsDouble() const {
+    const uint8_t *p = Bytes();
+    if (p[0] == 0xcb) {
+      uint64_t bits = uint64_t(ReadBE(p + 1, 8));
+      double v;
+      memcpy(&v, &bits, 8);
+      return v;
+    }
+    return double(AsInt());
+  }
+  std::string AsString() const {
+    const uint8_t *p = Bytes();
+    uint8_t t = p[0];
+    size_t n, off;
+    if ((t & 0xe0) == 0xa0) {
+      n = t & 0x1f;
+      off = 1;
+    } else if (t == 0xd9) {
+      n = p[1];
+      off = 2;
+    } else if (t == 0xda) {
+      n = size_t(ReadBE(p + 1, 2));
+      off = 3;
+    } else if (t == 0xdb) {
+      n = size_t(ReadBE(p + 1, 4));
+      off = 5;
+    } else {
+      throw std::runtime_error("result is not a string");
+    }
+    return std::string(reinterpret_cast<const char *>(p + off), n);
+  }
+  bool AsBool() const { return Bytes()[0] == 0xc3; }
+  bool IsNil() const { return !raw.empty() && Bytes()[0] == 0xc0; }
+
+ private:
+  const uint8_t *Bytes() const {
+    if (raw.empty()) throw std::runtime_error("empty result");
+    return reinterpret_cast<const uint8_t *>(raw.data());
+  }
+  static int64_t ReadBE(const uint8_t *p, int n) {
+    int64_t v = 0;
+    for (int i = 0; i < n; i++) v = (v << 8) | p[i];
+    return v;
+  }
+};
+
+// -- the embedded runtime ---------------------------------------------------
+
+class Runtime {
+ public:
+  Runtime() = default;
+  ~Runtime() { Shutdown(); }
+  Runtime(const Runtime &) = delete;
+  Runtime &operator=(const Runtime &) = delete;
+
+  // Connect to a running cluster: the head (KV plane) and one node
+  // daemon (task/actor/object planes). lib_path defaults to the .so
+  // next to this header's repo layout or LD_LIBRARY_PATH.
+  void Init(const std::string &head_host, int head_port,
+            const std::string &daemon_host, int daemon_port,
+            const std::string &lib_path = "libray_tpu_cpp_client.so") {
+    lib_ = dlopen(lib_path.c_str(), RTLD_NOW);
+    if (!lib_) throw std::runtime_error(std::string("dlopen: ") + dlerror());
+    Load();
+    head_ = connect_(head_host.c_str(), head_port);
+    if (!head_) throw std::runtime_error("cannot reach head");
+    daemon_ = connect_(daemon_host.c_str(), daemon_port);
+    if (!daemon_) throw std::runtime_error("cannot reach daemon");
+  }
+
+  void Shutdown() {
+    if (head_) close_(head_), head_ = nullptr;
+    if (daemon_) close_(daemon_), daemon_ = nullptr;
+    if (lib_) dlclose(lib_), lib_ = nullptr;
+  }
+
+  // -- KV plane (head InternalKV) --------------------------------------
+  bool KvPut(const std::string &key, const std::string &value) {
+    return kv_put_(head_, U(key), int(key.size()), U(value),
+                   int(value.size())) == 0;
+  }
+  bool KvGet(const std::string &key, std::string *out) {
+    uint8_t *buf = nullptr;
+    int64_t n = 0;
+    int rc = kv_get_(head_, U(key), int(key.size()), &buf, &n);
+    if (rc != 0) return false;
+    out->assign(reinterpret_cast<char *>(buf), size_t(n));
+    free_(buf);
+    return true;
+  }
+
+  // -- object plane (daemon object table / shm arena) ------------------
+  bool PutObject(const std::string &oid, const std::string &blob) {
+    return put_object_(daemon_, U(oid), int(oid.size()), U(blob),
+                       int64_t(blob.size())) == 0;
+  }
+  bool GetObject(const std::string &oid, std::string *out) {
+    uint8_t *buf = nullptr;
+    int64_t n = 0;
+    int rc = get_object_(daemon_, U(oid), int(oid.size()), &buf, &n);
+    if (rc != 0) return false;
+    out->assign(reinterpret_cast<char *>(buf), size_t(n));
+    free_(buf);
+    return true;
+  }
+
+  long Ping() { return ping_(daemon_); }
+
+  // -- tasks / actors (cross-language by NAME) -------------------------
+  Result SubmitTask(const std::string &name, const Args &args) {
+    uint8_t *buf = nullptr;
+    int64_t n = 0;
+    std::string packed = args.Packed();
+    int rc = submit_(daemon_, name.c_str(), U(packed),
+                     int(packed.size()), &buf, &n);
+    return Finish(rc, buf, n);
+  }
+  Result CreateActor(const std::string &cls, const std::string &name,
+                     const Args &args) {
+    std::string packed = args.Packed();
+    int rc = create_actor_(daemon_, cls.c_str(), name.c_str(), U(packed),
+                           int(packed.size()));
+    Result r;
+    r.ok = rc == 0;
+    if (!r.ok) r.raw = last_error_(daemon_);
+    return r;
+  }
+  Result CallActor(const std::string &name, const std::string &method,
+                   const Args &args) {
+    uint8_t *buf = nullptr;
+    int64_t n = 0;
+    std::string packed = args.Packed();
+    int rc = call_actor_(daemon_, name.c_str(), method.c_str(), U(packed),
+                         int(packed.size()), &buf, &n);
+    return Finish(rc, buf, n);
+  }
+
+ private:
+  Result Finish(int rc, uint8_t *buf, int64_t n) {
+    Result r;
+    r.ok = rc == 0;
+    if (buf) {
+      r.raw.assign(reinterpret_cast<char *>(buf), size_t(n));
+      free_(buf);
+    } else if (rc < 0) {
+      r.raw = "transport error";
+    }
+    return r;
+  }
+  static const uint8_t *U(const std::string &s) {
+    return reinterpret_cast<const uint8_t *>(s.data());
+  }
+  template <typename T>
+  void Sym(T &fn, const char *name) {
+    fn = reinterpret_cast<T>(dlsym(lib_, name));
+    if (!fn) throw std::runtime_error(std::string("missing symbol ") + name);
+  }
+  void Load() {
+    Sym(connect_, "rtc_connect");
+    Sym(close_, "rtc_close");
+    Sym(free_, "rtc_free");
+    Sym(kv_put_, "rtc_kv_put");
+    Sym(kv_get_, "rtc_kv_get");
+    Sym(put_object_, "rtc_put_object");
+    Sym(get_object_, "rtc_get_object");
+    Sym(ping_, "rtc_ping");
+    Sym(submit_, "rtc_submit_task");
+    Sym(create_actor_, "rtc_create_actor");
+    Sym(call_actor_, "rtc_call_actor");
+    Sym(last_error_, "rtc_last_error");
+  }
+
+  void *lib_ = nullptr;
+  void *head_ = nullptr;
+  void *daemon_ = nullptr;
+  void *(*connect_)(const char *, int) = nullptr;
+  void (*close_)(void *) = nullptr;
+  void (*free_)(void *) = nullptr;
+  int (*kv_put_)(void *, const uint8_t *, int, const uint8_t *, int) = nullptr;
+  int (*kv_get_)(void *, const uint8_t *, int, uint8_t **, int64_t *) = nullptr;
+  int (*put_object_)(void *, const uint8_t *, int, const uint8_t *,
+                     int64_t) = nullptr;
+  int (*get_object_)(void *, const uint8_t *, int, uint8_t **,
+                     int64_t *) = nullptr;
+  long (*ping_)(void *) = nullptr;
+  int (*submit_)(void *, const char *, const uint8_t *, int, uint8_t **,
+                 int64_t *) = nullptr;
+  int (*create_actor_)(void *, const char *, const char *, const uint8_t *,
+                       int) = nullptr;
+  int (*call_actor_)(void *, const char *, const char *, const uint8_t *, int,
+                     uint8_t **, int64_t *) = nullptr;
+  const char *(*last_error_)(void *) = nullptr;
+};
+
+}  // namespace ray_tpu_api

@@ -1279,6 +1279,84 @@ class DaemonService:
             return {"view": {k: dict(v)
                              for k, v in self._syncer_view.items()}}
 
+    # -- per-node agent (reference: dashboard/agent.py) -------------------
+    def start_agent(self, host: str = "127.0.0.1") -> Optional[int]:
+        """Per-node observability HTTP endpoint, served from THIS daemon
+        process (the dashboard agent role: the head's dashboard answers
+        cluster questions; node-local stats/profiles come from the node
+        itself):
+          GET /api/stats        daemon_stats as JSON
+          GET /api/profile/cpu  in-process stack-sample flamegraph data
+          GET /metrics          Prometheus exposition (this process)
+        Returns the bound port (advertised via daemon_stats)."""
+        import json as _json
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, body: bytes, ctype: str,
+                      code: int = 200) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    path = self.path.split("?")[0].rstrip("/")
+                    if path == "/api/stats":
+                        with service._lock:
+                            stats = {
+                                "node_id": service.node_id.hex(),
+                                "pid": os.getpid(),
+                                "leases": len(service._leases),
+                                "running": len(service._task_rids),
+                            }
+                        stats["store_used"] = (
+                            service.objects.used_bytes())
+                        if service.fast_core is not None:
+                            stats["fast_lane"] = (
+                                service.fast_core.stats())
+                        self._send(_json.dumps(stats).encode(),
+                                   "application/json")
+                    elif path == "/api/profile/cpu":
+                        from urllib.parse import parse_qsl
+
+                        from ray_tpu.util.profiling import (
+                            sample_cpu_profile)
+                        q = dict(parse_qsl(
+                            self.path.partition("?")[2]))
+                        dur = min(float(q.get("duration", 2)), 30.0)
+                        self._send(_json.dumps(
+                            sample_cpu_profile(duration_s=dur)).encode(),
+                            "application/json")
+                    elif path == "/metrics":
+                        from ray_tpu.util.metrics import prometheus_text
+                        self._send(prometheus_text().encode(),
+                                   "text/plain; version=0.0.4")
+                    else:
+                        self._send(b'{"error": "unknown path"}',
+                                   "application/json", 404)
+                except Exception as e:  # noqa: BLE001 — to the client
+                    self._send(_json.dumps(
+                        {"error": repr(e)}).encode(),
+                        "application/json", 500)
+
+        try:
+            server = ThreadingHTTPServer((host, 0), Handler)
+        except OSError:
+            return None
+        threading.Thread(target=server.serve_forever, daemon=True,
+                         name="node-agent").start()
+        self.agent_port = server.server_address[1]
+        return self.agent_port
+
     # -- misc -------------------------------------------------------------
     def handle_core_release(self, conn, rid, msg):
         return {"ok": True}  # owner-side holds are driver-local
@@ -1299,6 +1377,7 @@ class DaemonService:
                 "store_used": self.objects.used_bytes(),
                 "pull_stats": dict(self.pulls.stats),
                 "fast_lane": fast,
+                "agent_port": getattr(self, "agent_port", None),
                 "actors": len(
                     self.runtime.process_router._actor_workers)}
 
@@ -1343,6 +1422,7 @@ def main() -> None:
     service.head_addr = head_addr       # cross-language KV lookups
     threading.Thread(target=service._syncer_loop, daemon=True,
                      name="syncer-gossip").start()
+    service.start_agent(host=args.host)
     labels = json.loads(args.labels)
     head = HeadClient(head_addr)
     head.register_node(args.node_id, resources, labels, server.addr)

