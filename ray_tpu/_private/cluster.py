@@ -161,7 +161,14 @@ class DaemonHandle:
         elif method == "actor_worker_died":
             cb = self.on_actor_worker_died
             if cb is not None:
-                cb(msg["actor_id"], msg["cause"])
+                # OFF the reader thread: the death flow issues sync RPCs
+                # on THIS client (kill_actor during _handle_actor_death);
+                # running it inline would block the reader that must
+                # deliver those replies — a deadlock
+                threading.Thread(target=cb,
+                                 args=(msg["actor_id"], msg["cause"]),
+                                 daemon=True,
+                                 name="actor-death-cb").start()
         elif method == "worker_log":
             # cross-process worker line surfaced on the driver
             # (reference: print_worker_logs)
@@ -192,9 +199,17 @@ class DaemonHandle:
 
     # -- wiring -----------------------------------------------------------
     def hello(self, owner_addr: Tuple[str, int], job_id, namespace: str):
+        # ship the driver's import roots (the code-search-path role):
+        # module-level functions pickle BY REFERENCE, so daemon workers
+        # must be able to import the driver's modules (reference:
+        # workers see the job's code paths via working_dir/py_modules)
+        import sys as _sys
+        sys_path = [p for p in _sys.path
+                    if isinstance(p, str) and p
+                    and os.path.isdir(p)]
         out = self._call("hello_driver", owner_addr=list(owner_addr),
                          job_id=cloudpickle.dumps(job_id),
-                         namespace=namespace)
+                         namespace=namespace, sys_path=sys_path)
         self.fast_port = out.get("fast_port")
         self._job_id = job_id
         return out
@@ -746,20 +761,7 @@ class ClusterBackend:
         for info in self.head.list_nodes():
             if not info["alive"]:
                 continue
-            node_id = NodeID.from_hex(info["node_id"])
-            try:
-                handle = DaemonHandle(node_id, tuple(info["addr"]), None,
-                                      self.arenas)
-                handle.hello(self.owner_server.addr, runtime.job_id,
-                             runtime.namespace)
-            except (OSError, rpc.RpcError, DaemonCrashed):
-                # listed alive but actually unreachable (died inside the
-                # heartbeat window): skip it, don't fail the whole join
-                continue
-            handle.on_actor_worker_died = self._make_actor_death_cb()
-            with self._lock:
-                self.daemons[node_id] = handle
-            self.node_resources[node_id] = dict(info["resources"])
+            self._join_node(info, add_runtime_node=False)
         if not self.daemons:
             raise RuntimeError(
                 f"cluster at {address} has no alive nodes to join")
@@ -871,7 +873,52 @@ class ClusterBackend:
 
         return cb
 
+    def _join_node(self, info: Dict[str, Any],
+                   add_runtime_node: bool) -> Optional[DaemonHandle]:
+        """ONE node-join sequence, shared by attach() (initial sweep)
+        and the mid-session 'added' event (autoscaler provisioning,
+        `ray-tpu up` extension): connect, hello, wire callbacks, and
+        replay driver-wide settings the daemon missed (memory limit)."""
+        try:
+            node_id = NodeID.from_hex(info["node_id"])
+        except (KeyError, ValueError):
+            return None
+        with self._lock:
+            if node_id in self.daemons or self._shutting_down:
+                return None
+        try:
+            handle = DaemonHandle(node_id, tuple(info["addr"]), None,
+                                  self.arenas)
+            handle.hello(self.owner_server.addr, self.runtime.job_id,
+                         self.runtime.namespace)
+        except (OSError, rpc.RpcError, DaemonCrashed, KeyError):
+            return None    # raced its death; the death event follows
+        handle.on_actor_worker_died = self._make_actor_death_cb()
+        with self._lock:
+            if node_id in self.daemons:         # concurrent add race
+                handle.detach()
+                return None
+            self.daemons[node_id] = handle
+        self.node_resources[node_id] = dict(info["resources"])
+        # a limit set BEFORE this node joined must police it too
+        mon = getattr(self.runtime, "memory_monitor", None)
+        if mon is not None and getattr(mon, "_explicit_limit", None):
+            try:
+                handle.client.call("set_memory_limit",
+                                   limit=mon._explicit_limit,
+                                   timeout=5.0)
+            except Exception:
+                pass
+        if add_runtime_node:
+            self.runtime.add_remote_node(handle,
+                                         dict(info["resources"]))
+        return handle
+
     def _on_node_event(self, event: Dict[str, Any]) -> None:
+        if event.get("kind") == "added":
+            self._join_node(event.get("node") or {},
+                            add_runtime_node=True)
+            return
         if event.get("kind") != "death":
             return
         node_id = NodeID.from_hex(event["node_id"])

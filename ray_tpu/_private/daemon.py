@@ -48,7 +48,7 @@ from ray_tpu._private.rpc import Client, Connection, Server, declare
 
 INLINE_RESULT = 100 * 1024  # reference: max_direct_call_object_size
 
-declare("hello_driver", "owner_addr", "job_id", "namespace")
+declare("hello_driver", "owner_addr", "job_id", "namespace", "sys_path")
 declare("request_worker_lease", "task_meta")
 declare("return_worker", "lease_id")
 declare("push_task", "spec", "fid", "args", "lease_id", "backpressure")
@@ -79,6 +79,8 @@ declare("daemon_stop")
 declare("daemon_stats")
 declare("syncer_exchange", "view")
 declare("syncer_view")
+declare("oom_check", "task_id")
+declare("set_memory_limit", "limit")
 declare("core_op", "call", "payload", "task")
 declare("core_release", "task")
 
@@ -487,6 +489,9 @@ class DaemonService:
             except Exception:
                 pass
             raise RuntimeError(f"fast-lane join failed: {out!r}")
+        # close the hello/spawn race: a set_extra_sys_path that landed
+        # between this worker's boot snapshot and now re-sends here
+        wp.ensure_sys_path(w)
         return w
 
     def _fast_pool_loop(self) -> None:
@@ -496,8 +501,11 @@ class DaemonService:
         prestart + autoscaling-by-demand)."""
         while True:
             try:
+                from ray_tpu._private import worker_process as wp
                 alive = [w for w in self._fast_workers if w.alive()]
                 self._fast_workers = alive
+                for w in alive:
+                    wp.ensure_sys_path(w)   # no-op when current
                 stats = (self.fast_core.stats()
                          if self.fast_core is not None else {})
                 grow = (not alive
@@ -543,6 +551,23 @@ class DaemonService:
         self.owner = Client(tuple(msg["owner_addr"]), timeout=None)
         self.runtime.job_id = cloudpickle.loads(msg["job_id"])
         self.runtime.namespace = msg["namespace"]
+        # driver import roots: future workers get them in the boot
+        # frame; already-running ones (prestarted pool, fast lane) get
+        # an extend op so by-reference pickles resolve immediately
+        from ray_tpu._private import worker_process as _wp
+        paths = list(msg.get("sys_path") or [])
+        if paths:
+            _wp.set_extra_sys_path(paths)
+            for w in _wp.live_workers():
+                try:
+                    w.notify_extend_sys_path(paths)
+                except Exception:
+                    pass
+            for w in list(self._fast_workers):
+                try:
+                    w.notify_extend_sys_path(paths)
+                except Exception:
+                    pass
         # Don't report ready until the worker pool is warm: the first
         # lease otherwise pays a cold fork while racing driver work for
         # the CPU (reference: worker prestart hides process start cost).
@@ -1279,6 +1304,80 @@ class DaemonService:
             return {"view": {k: dict(v)
                              for k, v in self._syncer_view.items()}}
 
+    # -- node-side OOM defense (reference: the raylet memory monitor,
+    # common/memory_monitor.h + worker_killing_policy) -------------------
+    def _memory_candidates(self):
+        """This node's killable worker processes: push-lane running
+        tasks (``self._task_rids`` — the daemon's own tracking; the
+        router's ``_running`` is only the xlang path here), actor
+        workers, and dedicated fast-lane workers. Task ids recorded as
+        hex — that is what the driver's oom_check sends."""
+        from ray_tpu._private.memory_monitor import _Candidate
+        out = []
+        with self._lock:
+            running = dict(self._task_rids)
+        router = self.runtime.process_router
+        with router._lock:
+            actors = dict(router._actor_workers)
+        actor_pids = {c.proc.pid for c in actors.values()}
+        for task_hex, (client, _rid) in running.items():
+            if client.alive() and client.proc.pid not in actor_pids:
+                out.append(_Candidate(
+                    client.proc.pid, "task", task_id=task_hex,
+                    retriable=True, started_at=0.0, owner_key=""))
+        for actor_id, client in actors.items():
+            if client.alive():
+                out.append(_Candidate(
+                    client.proc.pid, "actor", actor_id=actor_id,
+                    retriable=True, started_at=0.0, owner_key=""))
+        for w in list(self._fast_workers):
+            if w.alive():
+                out.append(_Candidate(
+                    w.proc.pid, "task", retriable=True,
+                    started_at=0.0, owner_key="fast-lane"))
+        return out
+
+    def start_memory_monitor(self) -> None:
+        from ray_tpu._private.config import cfg
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+        if not cfg().memory_monitor:
+            return
+        self.memory_monitor = MemoryMonitor(
+            None, candidates_fn=self._memory_candidates)
+        self.memory_monitor.start()
+
+    def handle_set_memory_limit(self, conn, rid, msg):
+        """Driver-pushed cluster-wide limit; starts this node's monitor
+        if the flag left it off."""
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+        mon = getattr(self, "memory_monitor", None)
+        if mon is None:
+            mon = self.memory_monitor = MemoryMonitor(
+                None, candidates_fn=self._memory_candidates,
+                interval_s=0.25)
+            mon.start()
+        mon.limit = int(msg["limit"])
+        return {"ok": True}
+
+    def handle_oom_check(self, conn, rid, msg):
+        """Did this node's monitor OOM-kill the worker running
+        ``task_id`` (or ANY worker very recently — fast-lane tasks are
+        attributed by time, their ids live in the C++ core)?"""
+        mon = getattr(self, "memory_monitor", None)
+        if mon is None:
+            return {"oom": False, "kills": 0}
+        if msg.get("task_id") and any(
+                (t.hex() if hasattr(t, "hex") else t) == msg["task_id"]
+                for t in mon.oom_killed_tasks):
+            return {"oom": True, "kills": mon.kills}
+        # time-window fallback covers ONLY un-attributed kills (fast-
+        # lane workers, whose task ids live in the C++ core). A kill
+        # already attributed to another task must not paint an
+        # unrelated crash (e.g. a segfault) as OOM.
+        recent = any(time.time() - ts < 60.0 and not attributed
+                     for _pid, ts, attributed in mon.kill_log[-20:])
+        return {"oom": recent, "kills": mon.kills}
+
     # -- per-node agent (reference: dashboard/agent.py) -------------------
     def start_agent(self, host: str = "127.0.0.1") -> Optional[int]:
         """Per-node observability HTTP endpoint, served from THIS daemon
@@ -1423,6 +1522,7 @@ def main() -> None:
     threading.Thread(target=service._syncer_loop, daemon=True,
                      name="syncer-gossip").start()
     service.start_agent(host=args.host)
+    service.start_memory_monitor()
     labels = json.loads(args.labels)
     head = HeadClient(head_addr)
     head.register_node(args.node_id, resources, labels, server.addr)

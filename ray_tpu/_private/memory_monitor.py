@@ -163,8 +163,13 @@ class MemoryMonitor:
     def __init__(self, runtime, limit_bytes: Optional[int] = None,
                  threshold: float = USAGE_THRESHOLD,
                  policy: Optional[Any] = None,
-                 interval_s: Optional[float] = None):
+                 interval_s: Optional[float] = None,
+                 candidates_fn: Optional[Any] = None):
         self.runtime = runtime
+        # custom candidate source (the daemon-side monitor: its worker
+        # pool is not a driver Runtime; reference: the raylet's monitor
+        # watches ITS node's workers, node_manager-side)
+        self.candidates_fn = candidates_fn
         self.limit = limit_bytes or _flag("memory_limit_bytes") or \
             system_memory_limit()
         self.threshold = threshold if threshold is not None \
@@ -178,6 +183,7 @@ class MemoryMonitor:
         self.kills = 0
         self.oom_killed_tasks: set = set()
         self.oom_killed_actors: set = set()
+        self.kill_log: List[Any] = []   # (pid, wall ts) per OOM kill
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="memory-monitor")
@@ -190,10 +196,24 @@ class MemoryMonitor:
 
     def set_limit(self, limit_bytes: int) -> None:
         self.limit = limit_bytes
+        self._explicit_limit = limit_bytes   # replayed to late joiners
+        # cluster-wide: node daemons enforce on THEIR workers (the
+        # raylet-side monitor); forward the new limit to each
+        backend = getattr(self.runtime, "cluster_backend", None) \
+            if self.runtime is not None else None
+        if backend is not None:
+            for handle in list(backend.daemons.values()):
+                try:
+                    handle.client.call("set_memory_limit",
+                                       limit=limit_bytes, timeout=5.0)
+                except Exception:
+                    pass
 
     # -- sampling ---------------------------------------------------------
     def _worker_pids(self):
         """(pid, candidate) for every live worker process."""
+        if self.candidates_fn is not None:
+            return list(self.candidates_fn())
         router = self.runtime.process_router
         out: List[_Candidate] = []
         with router._lock:
@@ -254,6 +274,11 @@ class MemoryMonitor:
         if victim is None:
             return
         self.kills += 1
+        import time as _time
+        attributed = (victim.task_id is not None
+                      or victim.actor_id is not None)
+        self.kill_log.append((victim.pid, _time.time(), attributed))
+        del self.kill_log[:-100]          # bounded
         if victim.task_id is not None:
             self.oom_killed_tasks.add(victim.task_id)
         if victim.actor_id is not None:

@@ -341,6 +341,11 @@ class Runtime:
                 if inflight.cancelled:
                     return
                 inflight.state = TaskState.RUNNING
+        # same RUNNING transition the in-process path records: the
+        # timeline/chrome-trace pairs RUNNING with FINISHED/FAILED
+        self.task_events.record(
+            task_id=spec.task_id.hex(), name=spec.name, event="RUNNING",
+            node_id=node.node_id.hex())
         try:
             args, kwargs = self._resolve_args(spec)
         except exc.TaskError as te:
@@ -362,8 +367,12 @@ class Runtime:
             self._execute_inline(spec, node, args, kwargs)
             return
         fid, args_blob = payload
+        from ray_tpu.util import tracing
         try:
-            kind, value = node.daemon.execute_task(spec, fid, args_blob)
+            with tracing.span(f"task::{spec.name}",
+                              task_id=spec.task_id.hex()[:16]):
+                kind, value = node.daemon.execute_task(spec, fid,
+                                                       args_blob)
         except RemoteWorkerCrashed as crash:
             # one worker died; the daemon (node) is fine — plain retry
             self._on_process_task_crash(spec, node, crash)
@@ -908,6 +917,18 @@ class Runtime:
                 exc.TaskCancelledError(spec.task_id), spec.name))
             return
         oom = self.memory_monitor.was_oom_killed(spec.task_id)
+        if not oom and node is not None:
+            # remote workers are policed by THEIR node's monitor (the
+            # raylet role): ask the daemon whether this crash was its
+            # OOM kill
+            daemon = getattr(node, "daemon", None)
+            if daemon is not None and not daemon.dead:
+                try:
+                    oom = daemon.client.call(
+                        "oom_check", task_id=spec.task_id.hex(),
+                        timeout=5.0)["oom"]
+                except Exception:
+                    pass
         if _retries_left(spec):
             self.task_events.record(task_id=spec.task_id.hex(),
                                     name=spec.name,

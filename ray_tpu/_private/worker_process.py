@@ -42,6 +42,7 @@ import inspect
 import itertools
 import os
 import queue
+import sys
 import threading
 import time
 import traceback
@@ -415,6 +416,11 @@ class _WorkerState:
                     sem.release()
             elif op == "cancel":
                 self._async_raise(msg["target"])
+            elif op == "extend_sys_path":
+                import sys as _sys
+                for p in msg.get("paths", []):
+                    if p not in _sys.path:
+                        _sys.path.append(p)
             elif op == "join_fast_lane":
                 # dedicate this worker to the native daemon core's task
                 # lane (fast_lane.py); the mp channel stays open for
@@ -702,6 +708,9 @@ def _child_main(conn) -> None:
     signal.signal(signal.SIGINT, signal.SIG_IGN)
     boot = cloudpickle.loads(conn.recv_bytes())
     os.environ.update(boot.get("env", {}))
+    for _p in boot.get("extra_sys_path", []):
+        if _p not in sys.path:
+            sys.path.append(_p)
     if boot.get("log_dir"):
         # Per-worker log files + tail-to-driver (reference:
         # _private/log_monitor.py; VERDICT r2 #9).
@@ -1135,6 +1144,11 @@ class WorkerClient:
     def alive(self) -> bool:
         return not self.dead and self.proc.poll() is None
 
+    def notify_extend_sys_path(self, paths: List[str]) -> None:
+        """Fire-and-forget: live workers learn new driver import roots
+        (a late hello must also reach the prestarted pool)."""
+        self._send({"op": "extend_sys_path", "paths": list(paths)})
+
     def kill(self, expected: bool = True) -> None:
         import subprocess
         _checkout_done(self)
@@ -1368,6 +1382,25 @@ class WorkerClient:
 
 _POOL_LOCK = threading.Lock()
 _IDLE: List[WorkerClient] = []
+# driver import roots shipped at hello (code-search-path role): new
+# workers get them in the boot frame, live ones via an extend op
+_EXTRA_SYS_PATH: List[str] = []
+_SYS_PATH_VERSION = [0]
+_ALL_WORKERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def set_extra_sys_path(paths: List[str]) -> None:
+    changed = False
+    for p in paths:
+        if p not in _EXTRA_SYS_PATH:
+            _EXTRA_SYS_PATH.append(p)
+            changed = True
+    if changed:
+        _SYS_PATH_VERSION[0] += 1
+
+
+def live_workers() -> List["WorkerClient"]:
+    return [w for w in list(_ALL_WORKERS) if w.alive()]
 _PRESTARTING = [0]
 _POOL_CLOSED = threading.Event()   # interpreter exiting: no new spawns
 # Demand tracking: the idle cap follows the high-water mark of concurrent
@@ -1403,6 +1436,8 @@ def _async_kill(w: WorkerClient) -> None:
 
 def _make_boot() -> Dict[str, Any]:
     boot: Dict[str, Any] = {"env": {}}
+    if _EXTRA_SYS_PATH:
+        boot["extra_sys_path"] = list(_EXTRA_SYS_PATH)
     # Workers never own the accelerator: pin them to the CPU platform with
     # the same virtual device count the host uses (so jax-in-worker works
     # under the test mesh and cannot fight over the chip).
@@ -1425,7 +1460,25 @@ def _make_boot() -> Dict[str, Any]:
 
 
 def _spawn_worker() -> WorkerClient:
-    return WorkerClient(_make_boot())
+    # version BEFORE building the boot: a set_extra_sys_path racing
+    # this spawn makes the worker look stale, and ensure_sys_path
+    # re-sends (idempotent) instead of silently missing the paths
+    version = _SYS_PATH_VERSION[0]
+    w = WorkerClient(_make_boot())
+    w._sys_path_version = version
+    _ALL_WORKERS.add(w)
+    return w
+
+
+def ensure_sys_path(w: "WorkerClient") -> None:
+    """Re-send driver import roots if this worker predates the latest
+    set_extra_sys_path (spawn/hello races leave stale workers)."""
+    if getattr(w, "_sys_path_version", -1) != _SYS_PATH_VERSION[0]:
+        try:
+            w.notify_extend_sys_path(_EXTRA_SYS_PATH)
+            w._sys_path_version = _SYS_PATH_VERSION[0]
+        except Exception:
+            pass
 
 
 def _checkout_done(w: WorkerClient) -> None:
@@ -1462,6 +1515,7 @@ def acquire_worker() -> WorkerClient:
                 _ACTIVE[0] = max(0, _ACTIVE[0] - 1)
             raise
     got._checked_out = True
+    ensure_sys_path(got)
     _maybe_prestart_async()
     return got
 

@@ -18,13 +18,28 @@ def _labels_key(labels: Optional[Dict[str, str]]) -> Tuple:
 class Metric:
     kind = "untyped"
 
+    def __new__(cls, name: str, *args, **kwargs):
+        # get-or-create by name: re-declaring a metric (the natural
+        # pattern inside tasks — Counter("x").inc() per call) must
+        # return the LIVE instance, not a fresh zeroed one. A replace
+        # here silently reset values, so a worker reusing a process
+        # reported only its first flush's deltas.
+        with _REG_LOCK:
+            existing = _REGISTRY.get(name)
+            if existing is not None and type(existing) is cls:
+                return existing
+        return super().__new__(cls)
+
     def __init__(self, name: str, description: str = "",
                  tag_keys: Sequence[str] = ()):
+        if getattr(self, "_initialized", False):
+            return                      # live instance from __new__
         self.name = name
         self.description = description
         self.tag_keys = tuple(tag_keys)
         self._values: Dict[Tuple, float] = {}
         self._lock = threading.Lock()
+        self._initialized = True
         with _REG_LOCK:
             _REGISTRY[name] = self
 
@@ -63,6 +78,8 @@ class Histogram(Metric):
     def __init__(self, name: str, description: str = "",
                  boundaries: Sequence[float] = (0.01, 0.1, 1, 10, 100),
                  tag_keys: Sequence[str] = ()):
+        if getattr(self, "_initialized", False):
+            return                      # live instance from __new__
         super().__init__(name, description, tag_keys)
         self.boundaries = tuple(boundaries)
         self._counts: Dict[Tuple, List[int]] = {}

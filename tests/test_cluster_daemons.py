@@ -613,3 +613,52 @@ def test_per_node_agent_endpoints(daemon_cluster):
         assert "collapsed" in prof and prof["samples"] > 0
         with urllib.request.urlopen(f"{base}/metrics", timeout=30) as r:
             assert r.status == 200
+
+
+def test_autoscaler_provisions_real_daemon_process(daemon_cluster):
+    """ProcessHostProvider end to end: unmet demand drives the
+    reconciler to SPAWN a real node-daemon OS process which registers
+    at the head and becomes schedulable; idle drain terminates it
+    (reference: autoscaler node providers actually creating hosts)."""
+    import time as _t
+
+    from ray_tpu.autoscaler_v2 import (InstanceStatus,
+                                       ProcessHostProvider, Reconciler)
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    rt = daemon_cluster
+    before = {n.node_id for n in rt.alive_nodes()}
+    provider = ProcessHostProvider(rt)
+    rec = Reconciler(rt, provider, idle_timeout_s=0.5)
+
+    # 2 daemons x CPU:4 fully... demand a CPU:16 host (cpu-host type)
+    pg = placement_group([{"CPU": 16}], strategy="PACK")
+    assert not pg.wait(0.5)
+    deadline = _t.monotonic() + 60
+    while _t.monotonic() < deadline:
+        rec.reconcile()
+        if pg.wait(0.5):
+            break
+    assert pg.wait(5), "real daemon never provisioned"
+    rec.reconcile()   # promote ALLOCATED -> RAY_RUNNING post-join
+    new_nodes = {n.node_id for n in rt.alive_nodes()} - before
+    assert len(new_nodes) == 1
+    running = rec.instance_manager.list(InstanceStatus.RAY_RUNNING)
+    assert running and running[0].node_type == "cpu-host"
+
+    # a task actually lands on the provisioned daemon process
+    @ray_tpu.remote(num_cpus=9)   # only fits the new CPU:16 host
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    assert where.remote() is not None
+
+    remove_placement_group(pg)
+    deadline = _t.monotonic() + 30
+    while _t.monotonic() < deadline:
+        rec.reconcile()
+        if rec.instance_manager.list(InstanceStatus.TERMINATED):
+            break
+        _t.sleep(0.2)
+    assert rec.instance_manager.list(InstanceStatus.TERMINATED)

@@ -81,7 +81,22 @@ class Stage:
         return self.calls
 
 
-def test_cross_process_pipeline(ray_start_regular):
+@pytest.fixture
+def local_pool_runtime():
+    """The shm-channel fast path binds DRIVER-POOL actor workers (the
+    channels are wired through the driver's WorkerClient dag ops). In
+    the daemons topology actors live in daemon-hosted workers and
+    compile falls back to the dynamic path — correct behavior, covered
+    by test_daemons_mode_falls_back_to_dynamic below — so the channel
+    tests pin the local topology explicitly."""
+    import ray_tpu
+    rt = ray_tpu.init(num_nodes=1, resources={"CPU": 8},
+                      cluster="local")
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_cross_process_pipeline(local_pool_runtime):
     """Two process-worker actors pipeline through shm channels; per
     execute() NO RPC reaches either worker (call counters frozen)."""
     a, b = Stage.remote(1), Stage.remote(10)
@@ -107,7 +122,7 @@ def test_cross_process_pipeline(ray_start_regular):
     assert ray_tpu.get(a.f.remote(100)) == 101
 
 
-def test_fan_out_and_constants(ray_start_regular):
+def test_fan_out_and_constants(local_pool_runtime):
     """One upstream feeding two consumers plus a mixed-arg stage."""
     from ray_tpu.dag import MultiOutputNode
     a, b, c2 = Stage.remote(1), Stage.remote(2), Stage.remote(0)
@@ -123,7 +138,7 @@ def test_fan_out_and_constants(ray_start_regular):
     c.teardown()
 
 
-def test_pipelined_rounds_in_order(ray_start_regular):
+def test_pipelined_rounds_in_order(local_pool_runtime):
     """Back-to-back execute() calls resolve in round order through the
     single ordered finisher (no racing readers on the channels)."""
     a, b = Stage.remote(1), Stage.remote(10)
@@ -136,7 +151,7 @@ def test_pipelined_rounds_in_order(ray_start_regular):
     c.teardown()
 
 
-def test_superseding_compile_and_gc(ray_start_regular):
+def test_superseding_compile_and_gc(local_pool_runtime):
     """Recompiling over the same actors supersedes the old loop; GC of
     the STALE CompiledDAG must not kill the new binding."""
     import gc
@@ -155,7 +170,7 @@ def test_superseding_compile_and_gc(ray_start_regular):
     c2.teardown()
 
 
-def test_dead_stage_worker_fails_round_promptly(ray_start_regular):
+def test_dead_stage_worker_fails_round_promptly(local_pool_runtime):
     """SIGKILL a stage's worker process mid-DAG: the pending round must
     fail with ActorDiedError within seconds, not a 300s channel
     timeout."""
@@ -183,7 +198,7 @@ def test_dead_stage_worker_fails_round_promptly(ray_start_regular):
     c.teardown()
 
 
-def test_stage_error_propagates(ray_start_regular):
+def test_stage_error_propagates(local_pool_runtime):
     a, b = Stage.remote(1), Stage.remote(2)
     with InputNode() as inp:
         dag = b.f.bind(a.boom.bind(inp))
@@ -192,3 +207,21 @@ def test_stage_error_propagates(ray_start_regular):
     with pytest.raises(Exception, match="stage exploded"):
         ray_tpu.get(c.execute(1), timeout=60)
     c.teardown()
+
+
+def test_daemons_mode_falls_back_to_dynamic():
+    """Under the wire topology the compiled DAG cannot pre-wire driver
+    shm channels to daemon-hosted workers; compile must DEGRADE to the
+    dynamic execution path and still produce correct results."""
+    import ray_tpu
+    rt = ray_tpu.init(num_nodes=1, resources={"CPU": 4},
+                      cluster="daemons")
+    try:
+        a, b = Stage.remote(1), Stage.remote(10)
+        with InputNode() as inp:
+            dag = b.f.bind(a.f.bind(inp))
+        c = dag.experimental_compile()
+        assert ray_tpu.get(c.execute(5)) == (5 + 1) + 10
+        assert ray_tpu.get(c.execute(7)) == 18
+    finally:
+        ray_tpu.shutdown()

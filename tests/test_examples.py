@@ -39,8 +39,17 @@ def test_serve_llama_example(ray_start_regular):
     assert out["usage"]["completion_tokens"] == 8
 
 
-def test_compiled_dag_pipeline_example(ray_start_regular):
-    import compiled_dag_pipeline
-    outs = compiled_dag_pipeline.main(rounds=20)
-    assert len(outs) == 20
-    assert all(isinstance(o, float) for o in outs)
+def test_compiled_dag_pipeline_example():
+    # pinned local: the example demonstrates (and asserts) the
+    # driver-pool shm-channel mode, which correctly degrades to the
+    # dynamic path under the daemons topology
+    import ray_tpu
+    rt = ray_tpu.init(num_nodes=1, resources={"CPU": 8},
+                      cluster="local")
+    try:
+        import compiled_dag_pipeline
+        outs = compiled_dag_pipeline.main(rounds=20)
+        assert len(outs) == 20
+        assert all(isinstance(o, float) for o in outs)
+    finally:
+        ray_tpu.shutdown()

@@ -74,21 +74,28 @@ def test_worker_sigkill_triggers_task_retry(ray_start_regular, tmp_path):
         import os as _os
         import time as _time
         n = len(_os.listdir(_os.path.dirname(marker)))
-        open(f"{marker}.{n}", "w").close()
+        # the attempt file carries this worker's pid so the test can
+        # SIGKILL it in EITHER topology (daemons-mode workers are not
+        # in the driver's router)
+        with open(f"{marker}.{n}", "w") as f:
+            f.write(str(_os.getpid()))
         if n == 0:
             _time.sleep(30)  # first attempt: get killed mid-flight
         return _os.getpid()
 
     rt = ray_tpu._private.worker.global_runtime()
     ref = slow.remote()
-    # find the worker pid and SIGKILL it
+    # find the worker pid (from the attempt-0 marker) and SIGKILL it
     deadline = time.monotonic() + 10
     pid = None
     while pid is None and time.monotonic() < deadline:
-        with rt.process_router._lock:
-            running = dict(rt.process_router._running)
-        for task_id, (client, _rid) in running.items():
-            pid = client.proc.pid
+        try:
+            with open(f"{marker}.0") as f:
+                content = f.read().strip()
+            if content:
+                pid = int(content)
+        except (FileNotFoundError, ValueError):
+            pass
         time.sleep(0.05)
     assert pid is not None, "task never landed on a worker process"
     os.kill(pid, signal.SIGKILL)
