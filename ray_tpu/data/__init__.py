@@ -11,22 +11,24 @@ from ray_tpu.data.aggregate import Count, Max, Mean, Min, Std, Sum
 from ray_tpu.data.dataset import Dataset, GroupedData
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data.read_api import (from_arrow, from_items, from_numpy,
-                                   from_pandas, range, read_avro,
+                                   from_pandas, from_torch, range, read_avro, read_delta,
                                    read_binary_files,
                                    read_csv, read_images, read_json,
-                                   read_numpy, read_parquet, read_sql,
+                                   read_numpy, read_orc, read_parquet, read_sql,
                                    read_text, read_tfrecords,
                                    read_webdataset)
 
 __all__ = [
     "Dataset", "GroupedData", "DataIterator",
-    "range", "from_items", "from_numpy", "from_pandas", "from_arrow",
+    "range", "from_items", "from_numpy", "from_pandas", "from_arrow", "from_torch",
     "read_parquet", "read_csv", "read_json", "read_text",
     "read_binary_files",
     "read_images",
     "read_numpy",
     "read_sql",
     "read_avro",
+    "read_delta",
+    "read_orc",
     "read_tfrecords",
     "read_webdataset",
     "Count", "Sum", "Min", "Max", "Mean", "Std",

@@ -413,6 +413,18 @@ class Dataset:
                 with fs.open_output(f"{local}/part-{i:05d}.avro") as f:
                     f.write(blob)
 
+    def write_orc(self, path: str) -> None:
+        """One ORC file per block (reference: Dataset.write_orc)."""
+        from pyarrow import orc as _orc
+
+        from ray_tpu.data.filesystem import resolve_filesystem
+        fs, local = resolve_filesystem(path)
+        fs.makedirs(local)
+        for i, block in enumerate(self.iter_blocks()):
+            if block.num_rows:
+                with fs.open_output(f"{local}/part-{i:05d}.orc") as f:
+                    _orc.write_table(block, f)
+
     def write_tfrecords(self, path: str) -> None:
         """One TFRecord shard per block, rows as tf.train.Example
         (crc32c-framed; no TensorFlow — data/tfrecords.py)."""

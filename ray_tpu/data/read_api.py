@@ -317,3 +317,127 @@ def read_avro(paths) -> Dataset:
         return block_from_rows(rows)
 
     return _file_read_dataset(paths, ".avro", reader, "read_avro")
+
+
+def read_delta(path: str, *, version: Optional[int] = None) -> Dataset:
+    """Delta Lake table (reference: `data/read_api.py` read_delta via
+    deltalake; that wheel is absent, so this speaks the open Delta
+    transaction-log protocol directly): replay `_delta_log/*.json`
+    commits (add/remove actions) up to ``version``, then read the
+    surviving parquet data files in parallel. Checkpoint parquet files
+    are also honored as the replay base when present."""
+    import json as _json
+
+    from ray_tpu.data.filesystem import resolve_filesystem
+    fs, local = resolve_filesystem(path)
+    log_dir = f"{local.rstrip('/')}/_delta_log"
+
+    entries = sorted(
+        p for p in fs.listdir(log_dir)
+        if p.endswith(".json")
+        and p.rsplit("/", 1)[-1].split(".")[0].isdigit())
+    live: Dict[str, bool] = {}
+    base_version = -1
+    # checkpoint base: highest N with both N.checkpoint.parquet and a
+    # _last_checkpoint marker is the compacted state up to N
+    ckpts = sorted(p for p in fs.listdir(log_dir)
+                   if p.endswith(".checkpoint.parquet"))
+    if ckpts:
+        import pyarrow.parquet as pq
+        ck = ckpts[-1]
+        ck_version = int(ck.rsplit("/", 1)[-1].split(".")[0])
+        if version is None or ck_version <= version:
+            with fs.open_input(ck) as f:
+                table = pq.read_table(f)
+            for row in table.to_pylist():
+                add = row.get("add")
+                if add and add.get("path"):
+                    live[add["path"]] = True
+                rm = row.get("remove")
+                if rm and rm.get("path"):
+                    live.pop(rm["path"], None)
+            base_version = ck_version
+    for entry in entries:
+        v = int(entry.rsplit("/", 1)[-1].split(".")[0])
+        if v <= base_version or (version is not None and v > version):
+            continue
+        with fs.open_input(entry) as f:
+            for line in f.read().decode().splitlines():
+                if not line.strip():
+                    continue
+                action = _json.loads(line)
+                if "add" in action:
+                    live[action["add"]["path"]] = True
+                elif "remove" in action:
+                    live.pop(action["remove"]["path"], None)
+
+    files = [f"{local.rstrip('/')}/{rel}" for rel in sorted(live)]
+
+    def reader(f):
+        import pyarrow.parquet as pq
+        with _seam_open(f) as fh:
+            return pq.read_table(fh)
+
+    registry = dict(__import__(
+        "ray_tpu.data.filesystem", fromlist=["_REGISTRY"])._REGISTRY)
+
+    def run(f):
+        from ray_tpu.data import filesystem as fsmod
+        for scheme, fsys in registry.items():
+            fsmod._REGISTRY[scheme] = fsys
+        return reader(f)
+
+    tasks = [lambda f=f: run(f) for f in files]
+    if not tasks:
+        tasks = [lambda: pa.table({})]
+    return Dataset(L.Read("read_delta", [], read_tasks=tasks))
+
+
+def read_orc(paths) -> Dataset:
+    """Apache ORC files (reference: `data/read_api.py` read_orc via
+    pyarrow.orc — available in this image's pyarrow)."""
+    def reader(f):
+        import io as _io
+
+        from pyarrow import orc as _orc
+        with _seam_open(f) as fh:
+            data = fh.read()
+        return _orc.ORCFile(_io.BytesIO(data)).read()
+
+    return _file_read_dataset(paths, ".orc", reader, "read_orc")
+
+
+def from_torch(torch_dataset, *, parallelism: int = 4) -> Dataset:
+    """Materialize a torch.utils.data.Dataset (map-style or iterable)
+    into blocks (reference: `data/read_api.py` from_torch; torch is CPU
+    -only in this image, which is exactly the ingest role)."""
+    try:
+        n = len(torch_dataset)
+        items = [torch_dataset[i] for i in builtins_range(n)]
+    except TypeError:
+        items = list(torch_dataset)     # iterable-style
+
+    def to_row(item):
+        import numpy as _np
+        try:
+            import torch as _torch
+            is_tensor = isinstance(item, _torch.Tensor)
+        except ImportError:
+            is_tensor = False
+        if is_tensor:
+            return {"item": _np.asarray(item)}
+        if isinstance(item, dict):
+            return item
+        if isinstance(item, (tuple, list)):
+            return {f"field_{i}": (_np.asarray(v)
+                                   if hasattr(v, "numpy") else v)
+                    for i, v in enumerate(item)}
+        return {"item": item}
+
+    return from_items([to_row(it) for it in items],
+                      parallelism=parallelism)
+
+
+import builtins as _builtins
+
+builtins_range = _builtins.range
