@@ -473,7 +473,9 @@ class DaemonService:
         from ray_tpu._private import worker_process as wp
 
         w = wp._spawn_worker()
-        w._checked_out = True
+        # NOT _checked_out: lane workers never enter the idle pool and
+        # must not skew the pool's active-checkout accounting on death
+        w.fast_lane = True
         w.raw_outcomes = True
         w.runtime = self.runtime
         w.node = self.node_stub
@@ -1370,13 +1372,12 @@ class DaemonService:
                 (t.hex() if hasattr(t, "hex") else t) == msg["task_id"]
                 for t in mon.oom_killed_tasks):
             return {"oom": True, "kills": mon.kills}
-        # time-window fallback covers ONLY un-attributed kills (fast-
-        # lane workers, whose task ids live in the C++ core). A kill
-        # already attributed to another task must not paint an
-        # unrelated crash (e.g. a segfault) as OOM.
-        recent = any(time.time() - ts < 60.0 and not attributed
-                     for _pid, ts, attributed in mon.kill_log[-20:])
-        return {"oom": recent, "kills": mon.kills}
+        # fallback covers ONLY un-attributed kills (fast-lane workers,
+        # whose task ids live in the C++ core), and CONSUMES the entry:
+        # one kill explains one crash — it must not keep painting
+        # later, unrelated crashes (e.g. a segfault) as OOM
+        return {"oom": mon.consume_unattributed_kill(),
+                "kills": mon.kills}
 
     # -- per-node agent (reference: dashboard/agent.py) -------------------
     def start_agent(self, host: str = "127.0.0.1") -> Optional[int]:

@@ -242,6 +242,14 @@ class MemoryMonitor:
                 retriable=restartable,
                 started_at=getattr(client, "actor_since", 0.0),
                 owner_key=getattr(info, "class_name", "") or ""))
+        # driver-local fast-lane workers: their task ids live in the
+        # native core, so kills are un-attributed (time-window
+        # attribution in the crash handler, like the daemon's lane)
+        for w in list(getattr(router, "_fast_workers", [])):
+            if w.alive():
+                out.append(_Candidate(
+                    w.proc.pid, "task", retriable=True,
+                    started_at=0.0, owner_key="fast-lane"))
         return out
 
     def usage_bytes(self, candidates=None) -> int:
@@ -290,3 +298,17 @@ class MemoryMonitor:
 
     def was_oom_killed(self, task_id) -> bool:
         return task_id in self.oom_killed_tasks
+
+    def consume_unattributed_kill(self, window_s: float = 60.0) -> bool:
+        """Claim ONE un-attributed OOM kill (fast-lane workers — their
+        task ids live in the native core) within the window. Consuming
+        the entry means one kill explains one crash; it cannot keep
+        painting later, unrelated crashes as OOM."""
+        import time as _time
+        now = _time.time()
+        for i in range(len(self.kill_log) - 1, -1, -1):
+            pid, ts, attributed = self.kill_log[i]
+            if not attributed and now - ts < window_s:
+                self.kill_log[i] = (pid, ts, True)   # claimed
+                return True
+        return False

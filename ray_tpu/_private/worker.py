@@ -917,6 +917,12 @@ class Runtime:
                 exc.TaskCancelledError(spec.task_id), spec.name))
             return
         oom = self.memory_monitor.was_oom_killed(spec.task_id)
+        if not oom and getattr(crash, "fast_lane", False):
+            # lane workers' task ids live in the native core: attribute
+            # by claiming ONE recent un-attributed monitor kill, scoped
+            # to lane crashes only so a classic worker's segfault near
+            # a lane OOM kill is never mislabeled
+            oom = self.memory_monitor.consume_unattributed_kill()
         if not oom and node is not None:
             # remote workers are policed by THEIR node's monitor (the
             # raylet role): ask the daemon whether this crash was its
@@ -1027,6 +1033,8 @@ class Runtime:
         svc = getattr(backend, "owner_service", None)
         if svc is not None:
             svc.holder.release("t:" + spec.task_id.hex())
+        # same release for the driver-local fast lane's workers
+        self.process_router.release_borrows("t:" + spec.task_id.hex())
         from ray_tpu._private.export_events import emit_export
         emit_export("TASK", task_id=spec.task_id.hex(), name=spec.name,
                     state=state, kind=str(spec.kind),

@@ -1212,6 +1212,15 @@ class WorkerClient:
             key = "__actor__"  # actor-held refs live as long as the actor
         self._holds.setdefault(key, []).append(obj)
 
+    def _hold_key_for(self, msg: Dict[str, Any]) -> Optional[str]:
+        """Classic workers key holds by worker rid (released in
+        _finish); dedicated fast-lane workers have no per-call _finish,
+        so their holds key by the global borrower id ('t:<task>') and
+        release via ProcessRouter.release_borrows when the task ends."""
+        if getattr(self, "fast_lane", False) and msg.get("task_key"):
+            return msg["task_key"]
+        return msg.get("task")
+
     def _core_dispatch(self, msg: Dict[str, Any]) -> Any:
         kw = cloudpickle.loads(msg["payload"])
         if msg["call"] == "metrics_push":
@@ -1224,7 +1233,8 @@ class WorkerClient:
         rt = self.runtime
         if rt is None:
             raise RuntimeError("worker not bound to a runtime")
-        return dispatch_core_op(rt, self, msg["call"], kw, msg.get("task"))
+        return dispatch_core_op(rt, self, msg["call"], kw,
+                                self._hold_key_for(msg))
 
     def _request(self, msg: Dict[str, Any]) -> Tuple[str, _Pending]:
         rid = f"h{next(self._ids)}"
@@ -1630,6 +1640,18 @@ class ProcessRouter:
         self._lock = threading.Lock()
         # task_id -> (client, rid) while a normal task runs in a process
         self._running: Dict[TaskID, Tuple[WorkerClient, str]] = {}
+        # driver-local fast lane (the SAME native core the daemons run,
+        # native/daemon_core.cc, hosted in THIS process): plain tasks
+        # skip the per-task mp.Connection round trip and per-task
+        # checkout entirely. Lazily started on first eligible task.
+        self._fast = None                 # FastLaneClient
+        self._fast_core = None            # CoreHandle
+        self._fast_lock = threading.Lock()
+        self._fast_workers: List[WorkerClient] = []
+        self._fast_rids: Dict[str, int] = {}     # task hex -> lane rid
+        self._fast_disabled = os.environ.get(
+            "RAY_TPU_FAST_LANE", "1") == "0"
+        self._fast_max = max(2, min(8, (os.cpu_count() or 4)))
         if self.enabled:
             # Launch the forkserver template synchronously during init()
             # (bounds the brief PALLAS_AXON_POOL_IPS env window to the
@@ -1698,6 +1720,12 @@ class ProcessRouter:
 
     def execute_task(self, spec: TaskSpec, node, payload):
         fid, args_blob = payload
+        if self._fast_eligible(spec):
+            out = self._execute_fast(spec, node, fid, args_blob)
+            if out is not None:
+                return out
+            # lane declined (down, or the function returned a live
+            # generator): classic checkout below
         client = acquire_worker()
         client.runtime = self.runtime
         client.node = node
@@ -1714,6 +1742,144 @@ class ProcessRouter:
         release_worker(client)
         return outcome
 
+    # -- driver-local fast lane ------------------------------------------
+    def _fast_eligible(self, spec: TaskSpec) -> bool:
+        return (not self._fast_disabled
+                and spec.num_returns == 1
+                and not spec.runtime_env
+                and not (spec.func is not None
+                         and inspect.isgeneratorfunction(spec.func)))
+
+    def _fast_client(self):
+        if self._fast is not None and not self._fast.dead:
+            return self._fast
+        with self._fast_lock:
+            if self._fast is not None and not self._fast.dead:
+                return self._fast
+            try:
+                from ray_tpu._private.fast_lane import (CoreHandle,
+                                                        FastLaneClient)
+                if self._fast_core is None:
+                    core = CoreHandle()
+                    if core.start("127.0.0.1", 0) is None:
+                        self._fast_disabled = True   # no native build
+                        return None
+                    self._fast_core = core
+                    threading.Thread(target=self._fast_pool_loop,
+                                     daemon=True,
+                                     name="router-fastlane").start()
+                self._fast = FastLaneClient(
+                    ("127.0.0.1", self._fast_core.port))
+                return self._fast
+            except Exception:
+                self._fast_disabled = True
+                return None
+
+    def _fast_dedicate(self) -> WorkerClient:
+        core = self._fast_core
+        if core is None:
+            raise RuntimeError("fast lane stopped")
+        w = _spawn_worker()
+        # NOT _checked_out: lane workers never enter the idle pool, and
+        # marking them checked out would make their eventual kill()
+        # decrement an _ACTIVE count they never incremented (skewing
+        # pool sizing)
+        w.fast_lane = True
+        w.runtime = self.runtime
+        w.node = None
+        try:
+            rid, pend = w._request({
+                "op": "join_fast_lane",
+                "addr": ["127.0.0.1", core.port]})
+            out = w._wait_outcome(rid, pend)
+            if out[0] not in ("ok", "ok_raw"):
+                raise RuntimeError(f"fast-lane join failed: {out!r}")
+        except BaseException:
+            try:
+                w.kill(expected=True)
+            except Exception:
+                pass
+            raise
+        ensure_sys_path(w)
+        return w
+
+    def _fast_pool_loop(self) -> None:
+        """Queue-depth-driven sizing, like the daemon's lane pool. The
+        whole maintenance step holds _fast_lock so shutdown()'s swap
+        can never interleave with a dedicate (which would leak the
+        just-spawned worker process)."""
+        while not getattr(self.runtime, "_shutdown", False):
+            try:
+                with self._fast_lock:
+                    if self._fast_core is None:
+                        return        # shut down
+                    alive = [w for w in self._fast_workers
+                             if w.alive()]
+                    self._fast_workers = alive
+                    for w in alive:
+                        ensure_sys_path(w)
+                    stats = self._fast_core.stats()
+                    if (not alive
+                            or (stats.get("queued", 0) > 0
+                                and len(alive) < self._fast_max)):
+                        self._fast_workers.append(
+                            self._fast_dedicate())
+                        continue
+            except Exception:
+                time.sleep(1.0)
+            time.sleep(0.25)
+
+    def _execute_fast(self, spec: TaskSpec, node, fid: str,
+                      args_blob: bytes):
+        from ray_tpu._private import fast_lane as _fle
+        fl = self._fast_client()
+        if fl is None:
+            return None
+        payload = _fle.build_payload(
+            spec, fid, args_blob, self.runtime.job_id,
+            node.node_id if node is not None else None)
+        try:
+            rid, slot = fl.submit(payload)
+        except _fle.FastLaneError:
+            return None                  # nothing submitted: classic
+        task_hex = spec.task_id.hex()
+        with self._fast_lock:
+            self._fast_rids[task_hex] = rid
+        try:
+            kind, blob = fl.wait(slot)
+        except _fle.FastLaneError as e:
+            # submitted but the lane died: surface as a worker crash so
+            # retry accounting applies (never a silent re-run)
+            crash = WorkerCrashed(f"fast lane died mid-task: {e}")
+            crash.fast_lane = True
+            raise crash
+        finally:
+            with self._fast_lock:
+                self._fast_rids.pop(task_hex, None)
+        if kind == _fle.KIND_OK:
+            return ("ok", cloudpickle.loads(blob))
+        if kind == _fle.KIND_ERR:
+            e, tb = cloudpickle.loads(blob)
+            setattr(e, "_remote_traceback", tb)
+            return ("err", e)
+        if kind == _fle.KIND_GEN_FALLBACK:
+            return None                  # stream via the classic path
+        if kind == _fle.KIND_CANCELLED:
+            return ("err", KeyboardInterrupt())
+        if kind == _fle.KIND_CRASHED:
+            crash = WorkerCrashed(blob.decode(errors="replace"))
+            crash.fast_lane = True
+            raise crash
+        raise RuntimeError(f"unknown fast-lane outcome kind {kind}")
+
+    def release_borrows(self, key: str) -> None:
+        """Drop lane workers' owner-side holds for a finished borrower
+        ('t:<task>' — per-task borrow release for the driver-local
+        lane, mirroring the cluster OwnerHolder)."""
+        for w in list(self._fast_workers):
+            dropped = w._holds.pop(key, None)
+            del dropped
+
     @staticmethod
     def _release_after(client: WorkerClient, it):
         try:
@@ -1722,6 +1888,13 @@ class ProcessRouter:
             release_worker(client)
 
     def cancel_task(self, task_id: TaskID, force: bool) -> bool:
+        task_hex = task_id.hex()
+        with self._fast_lock:
+            rid = self._fast_rids.get(task_hex)
+            fl = self._fast
+        if rid is not None and fl is not None and not fl.dead:
+            fl.cancel(rid, force=force)
+            return True
         with self._lock:
             entry = self._running.get(task_id)
         if entry is None:
@@ -1836,6 +2009,24 @@ class ProcessRouter:
 
     # -- lifecycle -------------------------------------------------------
     def shutdown(self) -> None:
+        # fast lane first: the core is process-global (one rtdc per
+        # process), so the next runtime in this process needs it freed
+        with self._fast_lock:
+            fl, self._fast = self._fast, None
+            core, self._fast_core = self._fast_core, None
+            lane_workers, self._fast_workers = self._fast_workers, []
+        if fl is not None:
+            fl.close()
+        for w in lane_workers:
+            try:
+                w.kill(expected=True)
+            except Exception:
+                pass
+        if core is not None:
+            try:
+                core.stop()
+            except Exception:
+                pass
         with self._lock:
             actors = dict(self._actor_workers)
             self._actor_workers.clear()

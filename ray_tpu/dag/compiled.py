@@ -158,10 +158,16 @@ class CompiledDAG:
             if executor is None or executor.is_async:
                 return None
             instance = executor.instance
+            from ray_tpu._private.cluster import RemoteActorInstance
             from ray_tpu._private.worker_process import \
                 _ProcessActorInstance
-            if isinstance(instance, _ProcessActorInstance):
-                return None         # worker-process actor: fallback
+            if isinstance(instance,
+                          (_ProcessActorInstance, RemoteActorInstance)):
+                # worker-process / daemon-hosted actor: fallback. The
+                # instance check matters even with the _remote_actors
+                # gate above — actor creation is async, so a compile
+                # racing registration can resolve the executor first.
+                return None
             bound[node.id] = executor
         return bound or None
 

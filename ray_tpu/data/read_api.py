@@ -338,16 +338,24 @@ def read_delta(path: str, *, version: Optional[int] = None) -> Dataset:
         and p.rsplit("/", 1)[-1].split(".")[0].isdigit())
     live: Dict[str, bool] = {}
     base_version = -1
-    # checkpoint base: highest N with both N.checkpoint.parquet and a
-    # _last_checkpoint marker is the compacted state up to N
-    ckpts = sorted(p for p in fs.listdir(log_dir)
-                   if p.endswith(".checkpoint.parquet"))
-    if ckpts:
+    # checkpoint base: the newest usable checkpoint VERSION — reading
+    # EVERY part of it (the spec allows multi-part checkpoints,
+    # N.checkpoint.<part>.<parts>.parquet; one part alone silently
+    # drops files)
+    by_version: Dict[int, List[str]] = {}
+    for p in fs.listdir(log_dir):
+        name = p.rsplit("/", 1)[-1]
+        if ".checkpoint." in name and name.endswith(".parquet"):
+            head = name.split(".")[0]
+            if head.isdigit():
+                by_version.setdefault(int(head), []).append(p)
+    usable = [v for v in by_version
+              if version is None or v <= version]
+    if usable:
         import pyarrow.parquet as pq
-        ck = ckpts[-1]
-        ck_version = int(ck.rsplit("/", 1)[-1].split(".")[0])
-        if version is None or ck_version <= version:
-            with fs.open_input(ck) as f:
+        ck_version = max(usable)
+        for part in sorted(by_version[ck_version]):
+            with fs.open_input(part) as f:
                 table = pq.read_table(f)
             for row in table.to_pylist():
                 add = row.get("add")
@@ -356,7 +364,7 @@ def read_delta(path: str, *, version: Optional[int] = None) -> Dataset:
                 rm = row.get("remove")
                 if rm and rm.get("path"):
                     live.pop(rm["path"], None)
-            base_version = ck_version
+        base_version = ck_version
     for entry in entries:
         v = int(entry.rsplit("/", 1)[-1].split(".")[0])
         if v <= base_version or (version is not None and v > version):
@@ -371,26 +379,18 @@ def read_delta(path: str, *, version: Optional[int] = None) -> Dataset:
                 elif "remove" in action:
                     live.pop(action["remove"]["path"], None)
 
-    files = [f"{local.rstrip('/')}/{rel}" for rel in sorted(live)]
+    scheme = path.split("://", 1)[0] + "://" if "://" in path else ""
+    files = [f"{scheme}{local.rstrip('/')}/{rel}"
+             for rel in sorted(live)]
+    if not files:
+        return _from_blocks([pa.table({})])
 
     def reader(f):
         import pyarrow.parquet as pq
         with _seam_open(f) as fh:
             return pq.read_table(fh)
 
-    registry = dict(__import__(
-        "ray_tpu.data.filesystem", fromlist=["_REGISTRY"])._REGISTRY)
-
-    def run(f):
-        from ray_tpu.data import filesystem as fsmod
-        for scheme, fsys in registry.items():
-            fsmod._REGISTRY[scheme] = fsys
-        return reader(f)
-
-    tasks = [lambda f=f: run(f) for f in files]
-    if not tasks:
-        tasks = [lambda: pa.table({})]
-    return Dataset(L.Read("read_delta", [], read_tasks=tasks))
+    return _file_read_dataset(files, ".parquet", reader, "read_delta")
 
 
 def read_orc(paths) -> Dataset:
