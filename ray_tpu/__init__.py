@@ -59,6 +59,11 @@ def init(num_nodes: int = 1,
                            "(use ignore_reinit_error=True to allow)")
     from ray_tpu._private.config import apply_system_config
     apply_system_config(_system_config)
+    # `ray-tpu attach` exports RAY_TPU_ADDRESS so a bare init() joins
+    # the attached cluster (reference: RAY_ADDRESS)
+    import os as _os
+    if not kwargs.get("address") and _os.environ.get("RAY_TPU_ADDRESS"):
+        kwargs["address"] = _os.environ["RAY_TPU_ADDRESS"]
     return _worker.init_runtime(
         num_nodes=num_nodes, resources_per_node=resources,
         object_store_memory=object_store_memory, namespace=namespace,

@@ -264,6 +264,24 @@ def cmd_job_submit(args) -> int:
     return 0 if status == "SUCCEEDED" else 1
 
 
+def cmd_attach(args) -> int:
+    """Open a shell (or run a command) wired to the running cluster
+    (reference: `ray attach` opens a shell on the head; the local
+    equivalent exports RAY_TPU_ADDRESS so `ray_tpu.init()` with no
+    arguments joins the cluster)."""
+    import subprocess
+
+    address = getattr(args, "cluster", "") or _try_cluster_address()
+    if not address:
+        raise SystemExit("no running cluster (start one with "
+                         "`ray-tpu start --head` or `ray-tpu up`)")
+    env = dict(os.environ)
+    env["RAY_TPU_ADDRESS"] = address
+    cmd = args.cmd or [os.environ.get("SHELL", "/bin/bash")]
+    print(f"attached to {address} (RAY_TPU_ADDRESS set)")
+    return subprocess.call(cmd, env=env)
+
+
 def cmd_up(args) -> int:
     """Create the cluster described by a YAML config (reference:
     `ray up`, scripts.py:1419 over autoscaler commands.py)."""
@@ -385,6 +403,11 @@ def main(argv=None) -> int:
                                        "ray_tpu/cluster_launcher.py)")
     p = sub.add_parser("down")
     p.add_argument("config_file")
+    p = sub.add_parser("attach")
+    p.add_argument("cmd", nargs="*",
+                   help="command to run attached (default: $SHELL)")
+    p.add_argument("--cluster", default="",
+                   help="head host:port (default: the cluster file)")
     p = sub.add_parser("debug")
     p.add_argument("session", nargs="?", default="",
                    help="host:port of a session to attach; empty = list")
@@ -402,7 +425,7 @@ def main(argv=None) -> int:
         "memory": cmd_memory, "timeline": cmd_timeline,
         "microbenchmark": cmd_microbenchmark, "dashboard": cmd_dashboard,
         "serve-deploy": cmd_serve_deploy, "job-submit": cmd_job_submit,
-        "up": cmd_up, "down": cmd_down,
+        "up": cmd_up, "down": cmd_down, "attach": cmd_attach,
         "debug": cmd_debug,
     }[args.command]
     return handler(args)

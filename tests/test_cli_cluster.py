@@ -157,3 +157,41 @@ def test_ssh_provider_command_shape(tmp_path):
     assert "--head h1:6379" in remote
     assert "--host 0.0.0.0" in remote
     assert '"TPU": 4' in remote
+
+
+def test_ray_tpu_attach_runs_command_against_cluster(tmp_path):
+    """`ray-tpu attach <cmd>` exports RAY_TPU_ADDRESS so a bare
+    ray_tpu.init() inside the command joins the running cluster
+    (reference: `ray attach` + RAY_ADDRESS)."""
+    import subprocess
+    import sys as _sys
+
+    from ray_tpu import cluster_launcher as cl
+
+    config = tmp_path / "cluster.yaml"
+    config.write_text(
+        "cluster_name: attach-test\n"
+        "provider:\n  type: subprocess\n"
+        "head:\n  resources: {CPU: 2}\n"
+        "worker:\n  resources: {CPU: 2}\n  count: 1\n")
+    state = cl.up(str(config))
+    try:
+        assert cl.wait_for_nodes(state["address"], 1, timeout=60)
+        repo = __import__("os").path.dirname(__import__("os").path.dirname(
+            __import__("os").path.abspath(__file__)))
+        script = ("import ray_tpu; rt = ray_tpu.init(); "
+                  "print('NODES', len(rt.alive_nodes())); "
+                  "ray_tpu.shutdown()")
+        env = dict(__import__("os").environ)
+        env["JAX_PLATFORMS"] = "cpu"     # the attached child must not
+        env.pop("PALLAS_AXON_POOL_IPS", None)   # grab the accelerator
+        out = subprocess.run(
+            [_sys.executable, "-m", "ray_tpu.scripts.cli", "attach",
+             "--cluster", state["address"], "--",
+             _sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=120,
+            cwd=repo, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "NODES 1" in out.stdout, out.stdout
+    finally:
+        cl.down(str(config))
