@@ -56,12 +56,29 @@ constexpr uint8_t OP_PING = 0x05;
 constexpr uint8_t OP_EXEC = 0x06;
 constexpr uint8_t OP_REPLY = 0x07;
 constexpr uint8_t OP_CANCEL_EXEC = 0x08;
+// targeted lane (actors): a TAGGED worker serves exactly the submits
+// addressed to its tag, strictly FIFO — the per-actor ordering the
+// reference enforces via actor_scheduling_queue.h
+constexpr uint8_t OP_HELLO_TAGGED = 0x09;
+constexpr uint8_t OP_SUBMIT_TARGETED = 0x0a;
+// core -> tagged worker: registration is LIVE. The worker reports its
+// join complete only after this ack, so the daemon's create-actor
+// reply (and thus the driver's first targeted submit) cannot race the
+// hello bytes through the event loop.
+constexpr uint8_t OP_HELLO_ACK = 0x0b;
 
 constexpr uint8_t KIND_CRASHED = 0x63;
 constexpr uint8_t KIND_CANCELLED = 0x64;
 constexpr uint8_t KIND_PONG = 0x65;
 
 constexpr size_t MAX_FRAME = size_t(1) << 31;
+
+struct Pending {
+  uint64_t rid;
+  int driver_fd;
+  uint64_t driver_gen;
+  std::vector<uint8_t> payload;
+};
 
 struct Conn {
   int fd = -1;
@@ -72,17 +89,13 @@ struct Conn {
   std::deque<std::vector<uint8_t>> wq;
   size_t wq_off = 0;         // bytes of wq.front() already written
   uint64_t inflight_tid = 0; // worker: task currently executing (0 = idle)
+  uint64_t tag = 0;          // targeted worker: its address (0 = pool)
+  // targeted worker: submits waiting for it, strictly FIFO
+  std::deque<Pending> own_queue;
   // driver: rid -> tid for its in-flight tasks. Per-connection, because
   // every driver numbers its rids independently from 1 — a global map
   // would collide across drivers.
   std::unordered_map<uint64_t, uint64_t> rid_tid;
-};
-
-struct Pending {
-  uint64_t rid;
-  int driver_fd;
-  uint64_t driver_gen;
-  std::vector<uint8_t> payload;
 };
 
 struct Inflight {
@@ -100,6 +113,7 @@ struct Core {
   uint64_t next_tid = 1;
   std::unordered_map<int, Conn> conns;
   std::deque<int> free_workers;
+  std::unordered_map<uint64_t, int> tagged;   // tag -> worker fd
   std::deque<Pending> queue;
   std::unordered_map<uint64_t, Inflight> inflight;
 
@@ -202,6 +216,20 @@ void complete(Core &c, uint64_t tid, uint8_t kind, const uint8_t *blob,
   reply_driver(c, inf.driver_fd, inf.driver_gen, inf.rid, kind, blob, blen);
 }
 
+void exec_on(Core &c, Conn &worker, Pending &&p) {
+  uint64_t tid = c.next_tid++;
+  c.inflight[tid] = Inflight{p.rid, p.driver_fd, p.driver_gen,
+                             worker.fd};
+  auto dit = c.conns.find(p.driver_fd);
+  if (dit != c.conns.end() && dit->second.gen == p.driver_gen)
+    dit->second.rid_tid[p.rid] = tid;
+  worker.inflight_tid = tid;
+  uint8_t h[8];
+  memcpy(h, &tid, 8);
+  send_frame(c, worker, OP_EXEC, h, 8, p.payload.data(),
+             p.payload.size());
+}
+
 void dispatch(Core &c) {
   while (!c.queue.empty() && !c.free_workers.empty()) {
     int wfd = c.free_workers.front();
@@ -210,16 +238,7 @@ void dispatch(Core &c) {
     if (wit == c.conns.end()) continue;  // stale free-list entry
     Pending p = std::move(c.queue.front());
     c.queue.pop_front();
-    uint64_t tid = c.next_tid++;
-    c.inflight[tid] = Inflight{p.rid, p.driver_fd, p.driver_gen, wfd};
-    auto dit = c.conns.find(p.driver_fd);
-    if (dit != c.conns.end() && dit->second.gen == p.driver_gen)
-      dit->second.rid_tid[p.rid] = tid;
-    wit->second.inflight_tid = tid;
-    uint8_t h[8];
-    memcpy(h, &tid, 8);
-    send_frame(c, wit->second, OP_EXEC, h, 8, p.payload.data(),
-               p.payload.size());
+    exec_on(c, wit->second, std::move(p));
   }
 }
 
@@ -228,12 +247,18 @@ void close_conn(Core &c, int fd) {
   if (it == c.conns.end()) return;
   Conn &conn = it->second;
   if (conn.is_worker) {
-    // crash any task it was executing
+    // crash any task it was executing AND everything queued on it
+    static const char err[] = "worker process died (fast lane)";
     if (conn.inflight_tid) {
-      static const char err[] = "worker process died (fast lane)";
       complete(c, conn.inflight_tid, KIND_CRASHED,
                reinterpret_cast<const uint8_t *>(err), sizeof(err) - 1);
     }
+    for (auto &p : conn.own_queue)
+      reply_driver(c, p.driver_fd, p.driver_gen, p.rid, KIND_CRASHED,
+                   reinterpret_cast<const uint8_t *>(err),
+                   sizeof(err) - 1);
+    conn.own_queue.clear();
+    if (conn.tag) c.tagged.erase(conn.tag);
     for (auto fit = c.free_workers.begin(); fit != c.free_workers.end();)
       fit = (*fit == fd) ? c.free_workers.erase(fit) : fit + 1;
   } else {
@@ -242,6 +267,14 @@ void close_conn(Core &c, int fd) {
       qit = (qit->driver_fd == fd && qit->driver_gen == conn.gen)
                 ? c.queue.erase(qit)
                 : qit + 1;
+    for (auto &kv : c.conns) {
+      if (!kv.second.is_worker) continue;
+      auto &oq = kv.second.own_queue;
+      for (auto qit = oq.begin(); qit != oq.end();)
+        qit = (qit->driver_fd == fd && qit->driver_gen == conn.gen)
+                  ? oq.erase(qit)
+                  : qit + 1;
+    }
     for (auto &kv : c.inflight)
       if (kv.second.driver_fd == fd && kv.second.driver_gen == conn.gen)
         kv.second.driver_fd = -1;  // result will be discarded
@@ -268,6 +301,38 @@ void on_frame(Core &c, int fd, const uint8_t *body, size_t len) {
       dispatch(c);
       break;
     }
+    case OP_HELLO_TAGGED: {
+      if (n < 8) return;
+      conn.is_worker = true;
+      conn.inflight_tid = 0;
+      conn.tag = get_u64(p);
+      c.tagged[conn.tag] = fd;      // NOT in free_workers
+      send_frame(c, conn, OP_HELLO_ACK, nullptr, 0, nullptr, 0);
+      break;
+    }
+    case OP_SUBMIT_TARGETED: {
+      if (n < 16) return;
+      uint64_t rid = get_u64(p);
+      uint64_t tag = get_u64(p + 8);
+      c.stat_submitted.fetch_add(1, std::memory_order_relaxed);
+      auto tit = c.tagged.find(tag);
+      if (tit == c.tagged.end()) {
+        static const char err[] = "no such targeted worker";
+        reply_driver(c, fd, conn.gen, rid, KIND_CRASHED,
+                     reinterpret_cast<const uint8_t *>(err),
+                     sizeof(err) - 1);
+        return;
+      }
+      auto wit = c.conns.find(tit->second);
+      if (wit == c.conns.end()) return;
+      Pending pend{rid, fd, conn.gen,
+                   std::vector<uint8_t>(p + 16, p + n)};
+      if (wit->second.inflight_tid == 0 && wit->second.own_queue.empty())
+        exec_on(c, wit->second, std::move(pend));
+      else
+        wit->second.own_queue.emplace_back(std::move(pend));
+      break;
+    }
     case OP_SUBMIT: {
       if (n < 8) return;
       uint64_t rid = get_u64(p);
@@ -288,7 +353,16 @@ void on_frame(Core &c, int fd, const uint8_t *body, size_t len) {
       if (conn.inflight_tid != tid) return;
       conn.inflight_tid = 0;
       complete(c, tid, kind, p + 9, n - 9);
-      // worker is free again
+      if (conn.tag) {
+        // targeted worker: strictly its own FIFO, never the pool
+        if (!conn.own_queue.empty()) {
+          Pending pend = std::move(conn.own_queue.front());
+          conn.own_queue.pop_front();
+          exec_on(c, conn, std::move(pend));
+        }
+        break;
+      }
+      // pool worker is free again
       c.free_workers.push_back(fd);
       dispatch(c);
       break;
@@ -297,7 +371,8 @@ void on_frame(Core &c, int fd, const uint8_t *body, size_t len) {
       if (n < 8) return;
       uint64_t rid = get_u64(p);
       uint8_t force = (n >= 9) ? p[8] : 0;
-      // still queued? drop + CANCELLED
+      // still queued? drop + CANCELLED (pool queue, then per-worker
+      // targeted queues)
       for (auto qit = c.queue.begin(); qit != c.queue.end(); ++qit) {
         if (qit->rid == rid && qit->driver_fd == fd &&
             qit->driver_gen == conn.gen) {
@@ -306,6 +381,19 @@ void on_frame(Core &c, int fd, const uint8_t *body, size_t len) {
           reply_driver(c, pend.driver_fd, pend.driver_gen, rid,
                        KIND_CANCELLED, nullptr, 0);
           return;
+        }
+      }
+      for (auto &kv : c.conns) {
+        if (!kv.second.is_worker) continue;
+        auto &oq = kv.second.own_queue;
+        for (auto qit = oq.begin(); qit != oq.end(); ++qit) {
+          if (qit->rid == rid && qit->driver_fd == fd &&
+              qit->driver_gen == conn.gen) {
+            oq.erase(qit);
+            reply_driver(c, fd, conn.gen, rid, KIND_CANCELLED,
+                         nullptr, 0);
+            return;
+          }
         }
       }
       // in flight? forward to the executing worker — soft interrupt,

@@ -232,17 +232,15 @@ class DaemonHandle:
                     return None
             return self._fast
 
-    def _execute_fast(self, fl, spec, fid: str, args_blob: bytes):
-        """One frame out, one frame in — the daemon's Python never sees
-        the task. Outcome contract matches execute_task's; returns None
-        when the caller should take the classic path instead (and the
-        task did NOT run here)."""
+    def _lane_roundtrip(self, fl, spec, submit_fn, gen_kind_handler):
+        """ONE lane submit/wait/decode cycle, shared by the plain-task
+        and targeted-actor paths. Returns the (kind, value) outcome
+        contract, or None when the caller should take the classic path
+        (nothing ran here). ``gen_kind_handler(kind, blob)`` resolves
+        the path-specific generator kind (fallback vs drained list)."""
         from ray_tpu._private import fast_lane as _fle
-        payload = _fle.build_payload(spec, fid, args_blob,
-                                     getattr(self, "_job_id", None),
-                                     self.node_id)
         try:
-            rid, slot = fl.submit(payload)
+            rid, slot = submit_fn()
         except _fle.FastLaneError:
             # nothing was submitted: safe to fall back
             if self.dead:
@@ -254,13 +252,12 @@ class DaemonHandle:
         try:
             kind, blob = fl.wait(slot)
         except _fle.FastLaneError as e:
-            # submitted but the lane died before the outcome: the task
-            # may have executed — surface as a worker crash so the
-            # driver's retry accounting (max_retries) decides, never a
-            # silent duplicate run
+            # submitted but the lane died before the outcome: the call
+            # may have executed — surface as a worker crash so retry
+            # accounting (max_retries) decides, never a silent re-run
             if self.dead:
                 raise DaemonCrashed(str(e))
-            raise RemoteWorkerCrashed(f"fast lane died mid-task: {e}")
+            raise RemoteWorkerCrashed(f"fast lane died mid-call: {e}")
         finally:
             with self._fast_lock:
                 self._fast_rids.pop(task_hex, None)
@@ -270,10 +267,8 @@ class DaemonHandle:
             e, tb = cloudpickle.loads(blob)
             setattr(e, "_remote_traceback", tb)
             return ("err", e)
-        if kind == _fle.KIND_GEN_FALLBACK:
-            # the function returned a live generator (no body code ran
-            # for a generator function): stream it via the classic path
-            return None
+        if kind in (_fle.KIND_GEN_FALLBACK, _fle.KIND_GEN_LIST):
+            return gen_kind_handler(kind, blob)
         if kind == _fle.KIND_CANCELLED:
             # same surface as a classic soft cancel: the driver maps a
             # cancelled in-flight KeyboardInterrupt to TaskCancelledError
@@ -281,6 +276,21 @@ class DaemonHandle:
         if kind == _fle.KIND_CRASHED:
             raise RemoteWorkerCrashed(blob.decode(errors="replace"))
         raise RuntimeError(f"unknown fast-lane outcome kind {kind}")
+
+    def _execute_fast(self, fl, spec, fid: str, args_blob: bytes):
+        """Plain-task lane call; the daemon's Python never sees it."""
+        from ray_tpu._private import fast_lane as _fle
+        payload = _fle.build_payload(spec, fid, args_blob,
+                                     getattr(self, "_job_id", None),
+                                     self.node_id)
+
+        def on_gen(kind, blob):
+            # the function returned a live generator (no body code ran
+            # for a generator function): stream it via the classic path
+            return None
+
+        return self._lane_roundtrip(fl, spec,
+                                    lambda: fl.submit(payload), on_gen)
 
     # -- fused task submit ------------------------------------------------
     def execute_task(self, spec, fid: str, args_blob: bytes):
@@ -386,7 +396,33 @@ class DaemonHandle:
             e, tb = cloudpickle.loads(out["blob"])
             setattr(e, "_remote_traceback", tb)
             raise e
-        return RemoteActorInstance(self, spec.actor_id)
+        return RemoteActorInstance(self, spec.actor_id,
+                                   fast_tag=out.get("fast_tag"))
+
+    def _call_actor_fast(self, fl, tag: int, spec, args_blob: bytes):
+        """Targeted-lane actor call; returns the (kind, value) contract
+        or None when the caller should take the classic path (nothing
+        ran here)."""
+        from ray_tpu._private import fast_lane as _fle
+        payload = _fle.build_actor_payload(
+            spec, args_blob, getattr(self, "_job_id", None),
+            self.node_id)
+
+        def on_gen(kind, blob):
+            # the method returned a generator: items were drained in
+            # the worker (inside its context + actor lock); replay as a
+            # REAL generator so the driver's streaming machinery
+            # (inspect.isgenerator -> _drain_generator) engages exactly
+            # like the classic path
+            items = cloudpickle.loads(blob)
+
+            def replay():
+                yield from items
+
+            return ("gen", replay())
+
+        return self._lane_roundtrip(
+            fl, spec, lambda: fl.submit_targeted(tag, payload), on_gen)
 
     def call_actor_method(self, spec, args_blob: bytes):
         task_hex = spec.task_id.hex()
@@ -535,11 +571,30 @@ def _slim_spec_blob(spec) -> bytes:
 class RemoteActorInstance:
     """Driver-side handle to an actor hosted in a daemon's worker."""
 
-    __slots__ = ("daemon", "actor_id")
+    __slots__ = ("daemon", "actor_id", "fast_tag")
 
-    def __init__(self, daemon: DaemonHandle, actor_id):
+    def __init__(self, daemon: DaemonHandle, actor_id,
+                 fast_tag: Optional[int] = None):
         self.daemon = daemon
         self.actor_id = actor_id
+        # targeted fast-lane address of the actor's dedicated worker
+        # (None: classic RPC path only)
+        self.fast_tag = fast_tag
+
+    def call_actor_method(self, spec, args_blob: bytes):
+        """Same (kind, value) contract as DaemonHandle's classic path;
+        plain calls ride the targeted lane (per-actor FIFO in the
+        native core), streaming/runtime-env calls stay classic."""
+        if (self.fast_tag is not None
+                and spec.num_returns not in ("streaming", "dynamic")
+                and not spec.runtime_env):
+            fl = self.daemon._fast_client()
+            if fl is not None:
+                out = self.daemon._call_actor_fast(fl, self.fast_tag,
+                                                   spec, args_blob)
+                if out is not None:
+                    return out
+        return self.daemon.call_actor_method(spec, args_blob)
 
 
 class RemoteStore:

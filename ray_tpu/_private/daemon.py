@@ -441,6 +441,7 @@ class DaemonService:
         self.fast_port: Optional[int] = None
         self._fast_host = host
         self._fast_workers: list = []
+        self._fast_tag_seq = 0        # targeted-lane (actor) tags
         self._fast_max = max(1, min(16, int(resources.get("CPU", 2) or 2)))
         try:
             from ray_tpu._private.fast_lane import CoreHandle
@@ -860,7 +861,33 @@ class DaemonService:
             actor_id = spec.actor_id
             client.add_death_callback(
                 lambda c, aid=actor_id: router._actor_worker_died(aid, c))
-            conn.reply(rid, outcome="ok", worker_pid=client.proc.pid)
+            # targeted fast lane: actors with DEFAULT (serialized)
+            # execution get a per-actor tag in the native core so
+            # method calls skip the daemon's Python entirely —
+            # max_concurrency>1 / concurrency-group actors keep the
+            # classic thread-per-call path
+            fast_tag = None
+            if (self.fast_core is not None
+                    and getattr(spec, "max_concurrency", 1) == 1
+                    and not getattr(spec, "concurrency_groups", None)):
+                try:
+                    with self._lock:
+                        self._fast_tag_seq += 1
+                        fast_tag = self._fast_tag_seq
+                    lane_host = ("127.0.0.1"
+                                 if self._fast_host in ("0.0.0.0", "")
+                                 else self._fast_host)
+                    trid, tpend = client._request({
+                        "op": "join_fast_lane",
+                        "addr": [lane_host, self.fast_port],
+                        "tag": fast_tag})
+                    tout = client._wait_outcome(trid, tpend)
+                    if tout[0] not in ("ok", "ok_raw"):
+                        fast_tag = None
+                except Exception:
+                    fast_tag = None
+            conn.reply(rid, outcome="ok", worker_pid=client.proc.pid,
+                       fast_tag=fast_tag)
 
         self._task_pool.submit(run)
         return rpc.HOLD

@@ -42,6 +42,9 @@ OP_PING = 0x05
 OP_EXEC = 0x06
 OP_REPLY = 0x07
 OP_CANCEL_EXEC = 0x08
+OP_HELLO_TAGGED = 0x09
+OP_SUBMIT_TARGETED = 0x0A
+OP_HELLO_ACK = 0x0B
 
 KIND_OK = 0x00
 KIND_ERR = 0x01
@@ -51,6 +54,10 @@ KIND_PONG = 0x65
 # the function returned a live generator: the lane cannot stream it —
 # the driver re-runs the task on the classic (streaming) path
 KIND_GEN_FALLBACK = 0x66
+# an ACTOR method returned a generator: the method already ran (state
+# mutated), so no re-run — the worker drains it and ships the item
+# LIST; the driver replays it as a stream
+KIND_GEN_LIST = 0x67
 
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
@@ -171,6 +178,16 @@ class FastLaneClient:
     # -- API --------------------------------------------------------------
     def submit(self, payload: bytes) -> Tuple[int, list]:
         """Send a task payload; returns (rid, slot) to wait on."""
+        return self._submit_op(OP_SUBMIT, b"", payload)
+
+    def submit_targeted(self, tag: int,
+                        payload: bytes) -> Tuple[int, list]:
+        """Send to the TAGGED worker (per-actor FIFO ordering)."""
+        return self._submit_op(OP_SUBMIT_TARGETED, _U64.pack(tag),
+                               payload)
+
+    def _submit_op(self, op: int, extra: bytes,
+                   payload: bytes) -> Tuple[int, list]:
         if self.dead:
             raise FastLaneError("fast lane is down")
         rid = next(self._rids)
@@ -178,7 +195,7 @@ class FastLaneClient:
         with self._plock:
             self._pending[rid] = slot
         try:
-            self._send(OP_SUBMIT, _U64.pack(rid), payload)
+            self._send(op, _U64.pack(rid) + extra, payload)
         except OSError as e:
             self.dead = True
             with self._plock:
@@ -246,13 +263,40 @@ def build_payload(spec, fid: str, args_blob: bytes, job_id,
     }, use_bin_type=True)
 
 
-def worker_fast_lane_start(addr: Tuple[str, int], state) -> None:
+def build_actor_payload(spec, args_blob: bytes, job_id,
+                        node_id) -> bytes:
+    """Driver-side payload for a TARGETED actor-method call."""
+    return msgpack.packb({
+        "method": spec.method_name,
+        "args": args_blob,
+        "job": job_id.binary() if job_id is not None else b"",
+        "task": spec.task_id.binary(),
+        "node": node_id.binary() if node_id is not None else b"",
+        "aid": (spec.actor_id.binary()
+                if spec.actor_id is not None else b""),
+        "name": spec.name or "",
+        "res": {k: float(v) for k, v in (spec.resources or {}).items()},
+        "pg": (spec.placement_group_id.binary()
+               if spec.placement_group_id is not None else b""),
+        "pgc": bool(getattr(spec, "pg_capture", False)),
+    }, use_bin_type=True)
+
+
+def worker_fast_lane_start(addr: Tuple[str, int], state,
+                           tag: Optional[int] = None) -> None:
     """Connect this worker process to the core and serve EXEC frames.
 
     One lane thread reads frames; one persistent exec thread runs tasks
     (no per-task thread creation — at 3k tasks/s a 60us thread spawn is
     20% of the budget). CANCEL_EXEC async-raises KeyboardInterrupt into
-    the exec thread, same soft-cancel contract as the classic path."""
+    the exec thread, same soft-cancel contract as the classic path.
+
+    With ``tag`` the worker registers TARGETED (per-actor lane): the
+    core routes only submits addressed to this tag, strictly FIFO, and
+    the exec thread runs them as ACTOR METHOD calls on
+    ``state.actor_instance`` under the worker's actor lock (so classic
+    streaming calls on the mp channel stay serialized with lane
+    calls)."""
     import os  # noqa: F401 — force-cancel path
 
     sock = socket.create_connection(addr, timeout=10.0)
@@ -266,7 +310,16 @@ def worker_fast_lane_start(addr: Tuple[str, int], state) -> None:
         with wlock:
             sock.sendall(frame)
 
-    send(OP_HELLO_WORKER, b"")
+    if tag is not None:
+        send(OP_HELLO_TAGGED, _U64.pack(tag))
+        # wait for the core's ack: only then is the tag routable, so
+        # the daemon's create-actor reply (and the driver's first
+        # targeted submit) cannot outrun the registration
+        body = _read_frame(sock)
+        if not body or body[0] != OP_HELLO_ACK:
+            raise RuntimeError("targeted lane hello not acknowledged")
+    else:
+        send(OP_HELLO_WORKER, b"")
 
     import queue as _q
     tasks: "_q.Queue[Optional[Tuple[int, dict]]]" = _q.Queue()
@@ -277,7 +330,7 @@ def worker_fast_lane_start(addr: Tuple[str, int], state) -> None:
         import inspect
 
         from ray_tpu._private import runtime_context
-        from ray_tpu._private.ids import (JobID, NodeID,
+        from ray_tpu._private.ids import (ActorID, JobID, NodeID,
                                           PlacementGroupID, TaskID)
         from ray_tpu._private.worker_process import (_current_rid,
                                                      _dump_exc,
@@ -291,7 +344,8 @@ def worker_fast_lane_start(addr: Tuple[str, int], state) -> None:
                 "task_id": TaskID(msg["task"]),
                 "node_id": (NodeID(msg["node"])
                             if msg["node"] else None),
-                "actor_id": None,
+                "actor_id": (ActorID(msg["aid"])
+                             if msg.get("aid") else None),
                 "resources": msg["res"],
                 "task_name": msg["name"],
                 "placement_group_id": (
@@ -299,14 +353,49 @@ def worker_fast_lane_start(addr: Tuple[str, int], state) -> None:
                     if msg["pg"] else None),
                 "pg_capture": msg["pgc"],
             }
+            gen_items = None
             token = runtime_context._set_context(**ctx)
             try:
-                fn = state._fn({"fn_id": msg["fid"]})
                 import cloudpickle
                 args, kwargs = cloudpickle.loads(msg["args"])
-                result = fn(*args, **kwargs)
+                if "method" in msg:
+                    # targeted actor call: run on the live instance,
+                    # serialized with classic-path calls by the actor
+                    # lock (ordering: the core's per-tag FIFO). A
+                    # generator result drains HERE — still inside the
+                    # runtime context and the lock, so the body sees
+                    # its actor/task context and no other method
+                    # interleaves with it.
+                    lock = getattr(state, "actor_lock", None)
+                    method = getattr(state.actor_instance,
+                                     msg["method"])
+                    if lock is not None:
+                        with lock:
+                            result = method(*args, **kwargs)
+                            if inspect.isgenerator(result):
+                                gen_items = list(result)
+                    else:
+                        result = method(*args, **kwargs)
+                        if inspect.isgenerator(result):
+                            gen_items = list(result)
+                else:
+                    fn = state._fn({"fn_id": msg["fid"]})
+                    result = fn(*args, **kwargs)
             finally:
                 runtime_context._reset_context(token)
+            if gen_items is not None:
+                # the ACTOR method already ran — ship the drained
+                # items; the driver replays them as a stream
+                state._flush_metrics()
+                current["tid"] = 0
+                blob = _safe_dumps(gen_items)
+                try:
+                    send(OP_RESULT,
+                         _U64.pack(tid) + bytes([KIND_GEN_LIST]),
+                         blob)
+                except BaseException:  # noqa: BLE001 — partial frame
+                    raise SystemExit from None
+                return
             if inspect.isgenerator(result):
                 # can't stream over the lane; the driver replays this
                 # task on the classic path (creating a generator runs

@@ -1406,7 +1406,7 @@ class Runtime:
             if isinstance(instance, RemoteActorInstance):
                 import cloudpickle as _cp
                 try:
-                    kind, result = instance.daemon.call_actor_method(
+                    kind, result = instance.call_actor_method(
                         spec, _cp.dumps((args, kwargs)))
                 except (DaemonCrashed, RemoteWorkerCrashed) as e:
                     raise exc.ActorDiedError(spec.actor_id, str(e))

@@ -352,6 +352,12 @@ class _WorkerState:
         self._pending_lock = threading.Lock()
         self._task_threads: Dict[str, threading.Thread] = {}
         self.actor_instance: Any = None
+        # serializes actor-method execution between the classic mp
+        # channel (streaming calls) and the targeted fast lane; only
+        # engaged once the lane binds (_lane_bound) so non-lane actors
+        # keep their configured concurrency semantics
+        self.actor_lock = threading.RLock()
+        self._lane_bound = False
         self._fn_cache: Dict[str, Any] = {}
         self._gen_sems: Dict[str, threading.Semaphore] = {}
         self.proxy = WorkerProxyRuntime(self)
@@ -424,11 +430,15 @@ class _WorkerState:
             elif op == "join_fast_lane":
                 # dedicate this worker to the native daemon core's task
                 # lane (fast_lane.py); the mp channel stays open for
-                # host ops (fetch_function, nested core ops, metrics)
+                # host ops (fetch_function, nested core ops, metrics).
+                # With a tag, this is the TARGETED (actor) lane.
                 try:
                     from ray_tpu._private.fast_lane import (
                         worker_fast_lane_start)
-                    worker_fast_lane_start(tuple(msg["addr"]), self)
+                    worker_fast_lane_start(tuple(msg["addr"]), self,
+                                           tag=msg.get("tag"))
+                    if msg.get("tag") is not None:
+                        self._lane_bound = True
                     self.send({"id": msg["id"], "op": "result",
                                "ok": True,
                                "blob": cloudpickle.dumps(None)})
@@ -570,6 +580,8 @@ class _WorkerState:
         return fn
 
     def _handle(self, msg: Dict[str, Any]) -> None:
+        import contextlib
+
         from ray_tpu._private import runtime_context
         from ray_tpu.runtime_env import apply_runtime_env
 
@@ -581,7 +593,8 @@ class _WorkerState:
             try:
                 with apply_runtime_env(
                         self._resolve_runtime_env(msg.get("runtime_env"))), \
-                        _post_mortem_on_error():
+                        _post_mortem_on_error(), \
+                        contextlib.ExitStack() as _alock:
                     if msg["op"] == "create_actor":
                         cls = self._fn(msg)
                         args, kwargs = cloudpickle.loads(msg["args_blob"])
@@ -590,6 +603,13 @@ class _WorkerState:
                     elif msg["op"] == "call_method":
                         method = getattr(self.actor_instance, msg["method"])
                         args, kwargs = cloudpickle.loads(msg["args_blob"])
+                        if self._lane_bound:
+                            # held through the STREAMING drain below
+                            # too (the ExitStack closes after it): a
+                            # lane call must not interleave with a
+                            # classic streaming method's body on a
+                            # serialized actor
+                            _alock.enter_context(self.actor_lock)
                         result = method(*args, **kwargs)
                     elif msg["op"] == "dag_start":
                         result = self._dag_start(

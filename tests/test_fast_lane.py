@@ -173,3 +173,110 @@ def test_core_stats_shape(daemon_cluster):
     stats = fl.ping()
     assert set(stats) == {"queued", "inflight", "workers", "completed"}
     assert stats["workers"] >= 0
+
+
+def _instance(rt, actor_id, timeout=15.0):
+    """Actor creation is async: wait for the driver-side executor."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ex = rt._actor_executors.get(actor_id)
+        if ex is not None and ex.instance is not None:
+            return ex.instance
+        time.sleep(0.05)
+    raise AssertionError("actor executor never appeared")
+
+
+def test_targeted_actor_lane(daemon_cluster):
+    """Default (serialized) actors get a per-actor tag in the native
+    core; method calls ride the targeted lane with strict FIFO ordering
+    (reference: actor_scheduling_queue.h), and the core's submit
+    counter proves the calls actually took the lane."""
+    rt = daemon_cluster
+    handle, fl = _lane(rt)
+    before = fl.ping()["completed"]
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+    c = Counter.remote()
+    vals = ray_tpu.get([c.inc.remote() for _ in range(20)])
+    inst = _instance(rt, c._actor_id)
+    assert getattr(inst, "fast_tag", None), "actor not lane-bound"
+    assert vals == list(range(1, 21))        # strict ordering
+    assert fl.ping()["completed"] - before >= 20
+
+    # generator-returning method: items drained worker-side, replayed
+    # as a stream (the method must run exactly ONCE)
+    @ray_tpu.remote
+    class Gen:
+        def __init__(self):
+            self.calls = 0
+
+        def stream3(self):
+            self.calls += 1
+            yield from ("a", "b", "c")
+
+        def count(self):
+            return self.calls
+
+    g = Gen.remote()
+    out = ray_tpu.get(g.stream3.remote())
+    # PARITY with the classic path: a generator-returning method under
+    # num_returns=1 resolves to the streaming sentinel (use
+    # num_returns="streaming" for item-wise consumption); the items
+    # were drained worker-side and the body ran exactly ONCE
+    assert type(out).__name__ == "_StreamingGeneratorSentinel"
+    assert ray_tpu.get(g.count.remote()) == 1
+
+
+def test_concurrent_actors_keep_classic_path(daemon_cluster):
+    """max_concurrency>1 actors are NOT lane-bound (serialization would
+    break their concurrency contract) and still work."""
+    rt = daemon_cluster
+
+    @ray_tpu.remote(max_concurrency=4)
+    class Par:
+        def ping(self):
+            return "pong"
+
+    p = Par.remote()
+    assert ray_tpu.get(p.ping.remote()) == "pong"
+    inst = _instance(rt, p._actor_id)
+    assert getattr(inst, "fast_tag", None) is None
+
+
+def test_lane_actor_worker_sigkill_restarts(daemon_cluster):
+    """Killing a lane-bound actor's worker restarts the actor; the new
+    incarnation gets a FRESH tag and keeps serving."""
+    import os as _os
+    import signal as _signal
+
+    rt = daemon_cluster
+
+    @ray_tpu.remote(max_restarts=1)
+    class P:
+        def pid(self):
+            import os
+            return os.getpid()
+
+    a = P.remote()
+    pid1 = ray_tpu.get(a.pid.remote())
+    tag1 = _instance(rt, a._actor_id).fast_tag
+    _os.kill(pid1, _signal.SIGKILL)
+    deadline = time.monotonic() + 30
+    pid2 = None
+    while time.monotonic() < deadline:
+        try:
+            pid2 = ray_tpu.get(a.pid.remote(), timeout=10)
+            break
+        except Exception:
+            time.sleep(0.3)
+    assert pid2 is not None and pid2 != pid1
+    tag2 = _instance(rt, a._actor_id).fast_tag
+    assert tag2 and tag2 != tag1
