@@ -1,0 +1,129 @@
+"""End-to-end: Data -> Train -> Tune -> Serve on one runtime.
+
+The canonical "AI libraries compose over the core" walkthrough
+(reference capability: the Ray AIR examples — dataset ingest feeding a
+trainer, a small HPO sweep, then serving the tuned model):
+
+ 1. ray_tpu.data builds a streaming dataset of (x, noisy 3x+1) pairs.
+ 2. JaxTrainer fits a linear model with a jitted SPMD train step,
+    ingesting via iter_batches.
+ 3. Tuner sweeps the learning rate with the native TPE searcher.
+ 4. The best weights deploy as a Serve application; predictions flow
+    through the asyncio HTTP ingress.
+
+Run: python examples/full_stack_pipeline.py
+"""
+
+from __future__ import annotations
+
+
+def main(samples: int = 512, trials: int = 4):
+    import json
+    import urllib.request
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import data, serve, train, tune
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+    from ray_tpu.tune import TPESearcher, TuneConfig, Tuner
+
+    own = not ray_tpu.is_initialized()
+    if own:
+        ray_tpu.init(num_nodes=1, resources={"CPU": 8})
+
+    # 1. dataset: y = 3x + 1 (+ noise)
+    rng = np.random.default_rng(0)
+    xs = rng.uniform(-1, 1, samples).astype("float32")
+    ys = 3.0 * xs + 1.0 + rng.normal(0, 0.05, samples).astype("float32")
+    ds = data.from_items([{"x": float(a), "y": float(b)}
+                          for a, b in zip(xs, ys)])
+
+    # 2-3. trainer inside a Tune sweep over the learning rate
+    def train_loop(config):
+        import jax
+        import jax.numpy as jnp
+
+        lr = config["lr"]
+        w = jnp.zeros(()), jnp.zeros(())
+
+        @jax.jit
+        def step(w, batch_x, batch_y):
+            def loss_fn(wb):
+                pred = wb[0] * batch_x + wb[1]
+                return jnp.mean((pred - batch_y) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(w)
+            return ((w[0] - lr * grads[0], w[1] - lr * grads[1]),
+                    loss)
+
+        shard = train.get_dataset_shard("train")
+        loss = None
+        for _ in range(3):
+            for batch in shard.iter_batches(batch_size=64,
+                                            batch_format="numpy"):
+                w, loss = step(w, jnp.asarray(batch["x"]),
+                               jnp.asarray(batch["y"]))
+        train.report({"loss": float(loss),
+                      "w": float(w[0]), "b": float(w[1])})
+
+    trainer = JaxTrainer(
+        train_loop, train_loop_config={"lr": 0.1},
+        scaling_config=ScalingConfig(num_workers=1),
+        datasets={"train": ds},
+        run_config=RunConfig(name="fullstack"))
+    results = Tuner(
+        trainer,
+        param_space={"train_loop_config": {
+            "lr": tune.loguniform(1e-2, 1.0)}},
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               num_samples=trials,
+                               max_concurrent_trials=2,
+                               search_alg=TPESearcher(n_startup=2,
+                                                      seed=0))).fit()
+    if results.errors:
+        raise RuntimeError(f"sweep trials failed: {results.errors}")
+    best = results.get_best_result()
+    best_lr = best.config["lr"]    # train_loop_config is flattened
+    # re-fit at the tuned lr to obtain the weights: trial metrics
+    # surface the tuned objective, so the production parameters come
+    # from one direct fit (also the natural place to train longer than
+    # the sweep's per-trial budget)
+    final = JaxTrainer(
+        train_loop, train_loop_config={"lr": best_lr},
+        scaling_config=ScalingConfig(num_workers=1),
+        datasets={"train": ds},
+        run_config=RunConfig(name="fullstack-final")).fit()
+    if final.error:
+        raise RuntimeError(f"final fit failed: {final.error}")
+    w, b = final.metrics["w"], final.metrics["b"]
+
+    # 4. serve the tuned model over HTTP; the finally guarantees the
+    # module-global proxy state is torn down even when the request
+    # fails (a leaked proxy would poison later serve use in-process)
+    @serve.deployment
+    def predict(payload):
+        x = float(payload["x"])
+        return {"y": w * x + b}
+
+    try:
+        serve.run(predict.bind())
+        port = serve.start_http_proxy(port=0)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/",
+            data=json.dumps({"x": 0.5}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            served = json.loads(resp.read())["y"]
+    finally:
+        serve.shutdown()
+        if own:
+            ray_tpu.shutdown()
+    return {"w": w, "b": b, "loss": final.metrics["loss"],
+            "best_lr": best_lr, "served_prediction": served}
+
+
+if __name__ == "__main__":
+    out = main()
+    print(out)
+    assert abs(out["w"] - 3.0) < 0.5 and abs(out["b"] - 1.0) < 0.5

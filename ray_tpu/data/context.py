@@ -53,6 +53,20 @@ class DatasetStats:
         self.created_at = time.time()
         self._registered = False
 
+    # Datasets travel inside Trainers (Tune trials pickle the whole
+    # trainer, datasets included — reference: train+tune integration);
+    # stats are per-process observability, so the lock/ring membership
+    # stay out of the pickle and a fresh lock is minted on arrival.
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_lock", None)
+        state["_registered"] = False
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
     def _register(self) -> None:
         # ring membership starts at the FIRST record(): every lazy
         # transform builds a Dataset (and stats) that never executes —

@@ -321,7 +321,9 @@ class Dataset:
                             continue
                 yield ray_tpu.get(ref)
 
-        return [DataIterator(lambda i=i: pull_for(i)) for i in range(n)]
+        return [DataIterator(lambda i=i: pull_for(i),
+                             pickle_recipe=(self, n, i))
+                for i in range(n)]
 
     def train_test_split(self, test_size: float, *,
                          shuffle: bool = False,

@@ -92,11 +92,41 @@ def _prefetched(it: Iterator, n: int) -> Iterator:
         yield item
 
 
+def _rebuild_strided_iterator(dataset, n: int, index: int
+                              ) -> "DataIterator":
+    """Pickle-side reconstruction of a streaming_split shard: a
+    process-local pass over the blocks whose ARRIVAL index is
+    ``index (mod n)``. The in-process shards share ONE execution behind
+    a lock; a shard that crossed a process boundary cannot share that
+    generator, so it degrades to its own pass over the same disjoint,
+    covering strided subset."""
+    def pull():
+        for j, block in enumerate(dataset.iter_blocks()):
+            if j % n == index:
+                yield block
+
+    return DataIterator(pull, pickle_recipe=(dataset, n, index))
+
+
 class DataIterator:
     """Iterator facade over a stream of blocks (one per consumer shard)."""
 
-    def __init__(self, block_iter_factory: Callable[[], Iterator[Block]]):
+    def __init__(self, block_iter_factory: Callable[[], Iterator[Block]],
+                 pickle_recipe=None):
         self._factory = block_iter_factory
+        # (dataset, n, index) for shards that may travel between
+        # processes (Tune trials pickle whole Trainers, datasets and
+        # shard iterators included); the live shared-pass closure holds
+        # a lock and cannot cross the boundary itself
+        self._pickle_recipe = pickle_recipe
+
+    def __reduce__(self):
+        if self._pickle_recipe is None:
+            raise TypeError(
+                "this DataIterator wraps a process-local stream and "
+                "cannot be pickled; build it from streaming_split for "
+                "a transferable shard")
+        return (_rebuild_strided_iterator, self._pickle_recipe)
 
     def iter_blocks(self) -> Iterator[Block]:
         return self._factory()

@@ -53,3 +53,14 @@ def test_compiled_dag_pipeline_example():
         assert all(isinstance(o, float) for o in outs)
     finally:
         ray_tpu.shutdown()
+
+
+def test_full_stack_pipeline_example(ray_start_regular):
+    """Data -> Train -> Tune (TPE) -> Serve/HTTP in one runtime."""
+    import full_stack_pipeline
+
+    out = full_stack_pipeline.main(samples=256, trials=3)
+    assert abs(out["w"] - 3.0) < 0.5
+    assert abs(out["b"] - 1.0) < 0.5
+    expected = out["w"] * 0.5 + out["b"]
+    assert abs(out["served_prediction"] - expected) < 1e-6
