@@ -252,10 +252,21 @@ class BOHBSearcher(TPESearcher):
         # budget -> {trial_id: (config, latest value at that budget)}
         self._by_budget: Dict[int, Dict[str, Tuple[Dict[str, Any],
                                                    float]]] = {}
+        # trial_id -> config, kept past completion: the controller can
+        # drain a trial's intermediate reports AFTER its final result
+        # (poll/finalize ordering), and those rung observations must
+        # still land in the per-budget pools
+        self._configs: Dict[str, Dict[str, Any]] = {}
+
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        config = super().suggest(trial_id)
+        self._configs[trial_id] = config
+        return config
 
     def on_trial_result(self, trial_id: str, budget: Any,
                         metric_value: Optional[float]) -> None:
-        config = self._pending.get(trial_id)
+        config = (self._pending.get(trial_id)
+                  or self._configs.get(trial_id))
         if (config is None or metric_value is None
                 or not math.isfinite(metric_value)):
             return
