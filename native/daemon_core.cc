@@ -119,6 +119,15 @@ struct Core {
 
   std::atomic<uint64_t> stat_submitted{0};
   std::atomic<uint64_t> stat_completed{0};
+  // Gauges mirrored from the loop-thread-owned containers so
+  // rtdc_stats (called from arbitrary Python threads) never reads
+  // queue/inflight/free_workers cross-thread — container size reads
+  // race with the loop thread's mutations (UB, and a deque mid-resize
+  // can return garbage). Refreshed by the loop thread each iteration,
+  // the same ownership discipline as stat_submitted/stat_completed.
+  std::atomic<uint64_t> stat_queue_depth{0};
+  std::atomic<uint64_t> stat_inflight{0};
+  std::atomic<uint64_t> stat_free{0};
 };
 
 Core *g_core = nullptr;
@@ -527,6 +536,11 @@ void *loop_main(void *) {
       if (evs[i].events & EPOLLIN) on_readable(c, fd);
       if (evs[i].events & EPOLLOUT) on_writable(c, fd);
     }
+    // publish the stats gauges from the loop thread (sole owner of the
+    // containers); cross-thread rtdc_stats reads only these atomics
+    c.stat_queue_depth.store(c.queue.size(), std::memory_order_relaxed);
+    c.stat_inflight.store(c.inflight.size(), std::memory_order_relaxed);
+    c.stat_free.store(c.free_workers.size(), std::memory_order_relaxed);
   }
   return nullptr;
 }
@@ -592,10 +606,9 @@ void rtdc_stats(uint64_t *out) {
     out[0] = out[1] = out[2] = out[3] = 0;
     return;
   }
-  // racy reads are fine for stats
-  out[0] = g_core->queue.size();
-  out[1] = g_core->inflight.size();
-  out[2] = g_core->free_workers.size();
+  out[0] = g_core->stat_queue_depth.load(std::memory_order_relaxed);
+  out[1] = g_core->stat_inflight.load(std::memory_order_relaxed);
+  out[2] = g_core->stat_free.load(std::memory_order_relaxed);
   out[3] = g_core->stat_submitted.load(std::memory_order_relaxed);
 }
 

@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
+from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
 from ray_tpu._private import daemon as _daemon_schemas  # noqa: F401 — declares the daemon RPC schemas
 from ray_tpu._private.head import HeadClient
@@ -148,7 +149,14 @@ class DaemonHandle:
         self.fast_port: Optional[int] = None
         self._fast = None
         self._fast_lock = threading.Lock()
-        self._fast_rids: Dict[str, int] = {}   # task hex -> lane rid
+        # reconnects (with their backoff sleeps) serialize on their OWN
+        # lock: holding _fast_lock through a retry window would stall
+        # every concurrent submit's _fast_rids bookkeeping and cancels
+        self._fast_dial_lock = threading.Lock()
+        # task hex -> (lane client, rid): the CLIENT pins the rid to its
+        # generation — a reconnected lane restarts rid numbering, so a
+        # bare rid could cancel an unrelated task on the new client
+        self._fast_rids: Dict[str, Tuple[Any, int]] = {}
         self.runtime = None                    # bound by the backend
 
     # -- push demux -------------------------------------------------------
@@ -221,16 +229,31 @@ class DaemonHandle:
         fl = self._fast
         if fl is not None and not fl.dead:
             return fl
-        with self._fast_lock:
-            if self._fast is None or self._fast.dead:
-                from ray_tpu._private.fast_lane import FastLaneClient
-                try:
-                    self._fast = FastLaneClient(
-                        (self.addr[0], self.fast_port))
-                except OSError:
-                    self.fast_port = None    # core gone: stop retrying
-                    return None
-            return self._fast
+        with self._fast_dial_lock:
+            fl = self._fast
+            if fl is not None and not fl.dead:
+                return fl                    # a racer reconnected
+            port = self.fast_port
+            if port is None:
+                return None
+            from ray_tpu._private.fast_lane import (FastLaneClient,
+                                                    lane_reconnect_policy)
+
+            def connect():
+                if _fp.ENABLED:
+                    _fp.fire("cluster.lane_reconnect",
+                             node=self.node_id.hex()[:8])
+                return FastLaneClient((self.addr[0], port))
+
+            try:
+                fl = lane_reconnect_policy().run(
+                    connect, loop="fast_lane.reconnect",
+                    retry_on=(OSError, _fp.FailpointError))
+            except (OSError, _fp.FailpointError):
+                self.fast_port = None        # core gone: stop retrying
+                return None
+            self._fast = fl
+            return fl
 
     def _lane_roundtrip(self, fl, spec, submit_fn, gen_kind_handler):
         """ONE lane submit/wait/decode cycle, shared by the plain-task
@@ -248,7 +271,7 @@ class DaemonHandle:
             return None
         task_hex = spec.task_id.hex()
         with self._fast_lock:
-            self._fast_rids[task_hex] = rid
+            self._fast_rids[task_hex] = (fl, rid)
         try:
             kind, blob = fl.wait(slot)
         except _fle.FastLaneError as e:
@@ -257,7 +280,9 @@ class DaemonHandle:
             # accounting (max_retries) decides, never a silent re-run
             if self.dead:
                 raise DaemonCrashed(str(e))
-            raise RemoteWorkerCrashed(f"fast lane died mid-call: {e}")
+            crash = RemoteWorkerCrashed(f"fast lane died mid-call: {e}")
+            crash.fast_lane = True
+            raise crash
         finally:
             with self._fast_lock:
                 self._fast_rids.pop(task_hex, None)
@@ -274,7 +299,11 @@ class DaemonHandle:
             # cancelled in-flight KeyboardInterrupt to TaskCancelledError
             return ("err", KeyboardInterrupt())
         if kind == _fle.KIND_CRASHED:
-            raise RemoteWorkerCrashed(blob.decode(errors="replace"))
+            crash = RemoteWorkerCrashed(blob.decode(errors="replace"))
+            # lane workers' task ids live in the C++ core: the OOM
+            # check must use the lane-scoped (time-window) attribution
+            crash.fast_lane = True
+            raise crash
         raise RuntimeError(f"unknown fast-lane outcome kind {kind}")
 
     def _execute_fast(self, fl, spec, fid: str, args_blob: bytes):
@@ -285,8 +314,12 @@ class DaemonHandle:
                                      self.node_id)
 
         def on_gen(kind, blob):
-            # the function returned a live generator (no body code ran
-            # for a generator function): stream it via the classic path
+            if kind == _fle.KIND_GEN_LIST:
+                # the function body already ran and returned a live
+                # generator; the worker drained it in place — replay
+                # the items as a stream, never re-run the body
+                return ("gen", _fle.replay_gen_list(blob))
+            # legacy KIND_GEN_FALLBACK (old worker): classic re-run
             return None
 
         return self._lane_roundtrip(fl, spec,
@@ -414,12 +447,7 @@ class DaemonHandle:
             # REAL generator so the driver's streaming machinery
             # (inspect.isgenerator -> _drain_generator) engages exactly
             # like the classic path
-            items = cloudpickle.loads(blob)
-
-            def replay():
-                yield from items
-
-            return ("gen", replay())
+            return ("gen", _fle.replay_gen_list(blob))
 
         return self._lane_roundtrip(
             fl, spec, lambda: fl.submit_targeted(tag, payload), on_gen)
@@ -442,15 +470,23 @@ class DaemonHandle:
 
     def cancel_task(self, task_id, force: bool) -> bool:
         task_hex = task_id.hex()
+        if _fp.ENABLED:
+            act = _fp.fire("cluster.cancel", task=task_hex)
+            if act is _fp.DROP:
+                return False        # cancel request lost in transit
         with self._fast_lock:
-            rid = self._fast_rids.get(task_hex)
-            fl = self._fast
-        if rid is not None and fl is not None and not fl.dead:
+            entry = self._fast_rids.get(task_hex)
+        if entry is not None:
             # fast-lane task: the C++ core drops it if still queued;
             # running → soft interrupt, or force → the lane worker
             # exits (surfacing as a crash, which a cancelled task maps
-            # to TaskCancelledError — the classic force-kill contract)
-            fl.cancel(rid, force=force)
+            # to TaskCancelledError — the classic force-kill contract).
+            # The cancel goes to the CLIENT the task was submitted on:
+            # after a lane death + reconnect, the new client's restarted
+            # rid counter must never receive a stale rid.
+            lane_client, rid = entry
+            if not lane_client.dead:
+                lane_client.cancel(rid, force=force)
             return True
         try:
             return self._call("cancel_task", task_id=task_hex,
@@ -902,6 +938,16 @@ class ClusterBackend:
             time.sleep(0.25)
             if self._shutting_down or self.head_proc.poll() is None:
                 continue
+            if _fp.ENABLED:
+                try:
+                    # delay arm extends the outage window; ANY error
+                    # arm simulates a failed respawn attempt (next
+                    # tick retries, like a lingering TIME_WAIT port) —
+                    # an escape here would kill the supervisor thread
+                    # and permanently disable head respawn
+                    _fp.fire("head.respawn")
+                except Exception:  # noqa: BLE001 — injected faults
+                    continue
             try:
                 proc, _ = _spawn(
                     "ray_tpu._private.head",

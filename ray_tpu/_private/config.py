@@ -105,6 +105,18 @@ FLAG_DEFS = [
     Flag("lock_sanitizer", bool, False, "track runtime lock acquisition "
          "order and warn on inversion cycles (potential deadlocks); "
          "see _private/lock_sanitizer.py"),
+    # -- fault injection / retry discipline (_private/failpoints.py,
+    # _private/retry.py) --
+    Flag("failpoints", str, "", "failpoint spec activating deterministic "
+         "fault injection, e.g. 'rpc.client.send=drop:every=3'; also "
+         "honored as the RAY_TPU_FAILPOINTS env var by spawned "
+         "daemon/head/worker processes"),
+    Flag("failpoints_seed", int, 0, "RNG seed for probabilistic "
+         "failpoint arms (0 = unseeded); same seed => same schedule"),
+    Flag("retry_base_backoff_s", float, 0.05, "RetryPolicy.default "
+         "first-backoff cap (exponential, full jitter)"),
+    Flag("retry_max_backoff_s", float, 2.0, "RetryPolicy.default "
+         "backoff cap ceiling"),
 ]
 
 FLAGS: Dict[str, Flag] = {f.name: f for f in FLAG_DEFS}

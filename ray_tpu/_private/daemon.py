@@ -40,6 +40,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import cloudpickle
 
+from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
 from ray_tpu._private.head import HeadClient, _hb_interval
 from ray_tpu._private.ids import ActorID, NodeID, TaskID
@@ -79,7 +80,7 @@ declare("daemon_stop")
 declare("daemon_stats")
 declare("syncer_exchange", "view")
 declare("syncer_view")
-declare("oom_check", "task_id")
+declare("oom_check", "task_id", "fast_lane")
 declare("set_memory_limit", "limit")
 declare("core_op", "call", "payload", "task")
 declare("core_release", "task")
@@ -305,6 +306,11 @@ class PullManager:
                 pull.event.set()
 
     def _transfer(self, pull: _Pull) -> None:
+        if _fp.ENABLED:
+            # error arm fails this transfer attempt (waiter sees the
+            # error and may fall back to the owner directory); delay
+            # arm stretches the transfer window
+            _fp.fire("daemon.pull_transfer")
         if self.objects.contains(pull.oid):
             return  # a deduped predecessor already landed it
         peer = self._peer(tuple(pull.from_addr))
@@ -640,6 +646,10 @@ class DaemonService:
         WorkerPool::PopWorker)."""
         from ray_tpu._private import worker_process as wp
 
+        if _fp.ENABLED:
+            # delay arm = slow lease grant; error arm = lease denied
+            # (surfaces as a RemoteError at the driver)
+            _fp.fire("daemon.lease")
         client = wp.acquire_worker()
         client.raw_outcomes = True
         client.runtime = self.runtime
@@ -775,6 +785,10 @@ class DaemonService:
             from ray_tpu._private.worker_process import WorkerCrashed
 
             try:
+                if _fp.ENABLED:
+                    # crash arm here kills the DAEMON mid-push (node
+                    # death); error arm fails just this task's push
+                    _fp.fire("daemon.push_task", task=task_hex)
                 wrid, pend = client._request({
                     "op": "execute_task", "fn_id": msg["fid"],
                     "args_blob": msg["args"],
@@ -1390,8 +1404,11 @@ class DaemonService:
 
     def handle_oom_check(self, conn, rid, msg):
         """Did this node's monitor OOM-kill the worker running
-        ``task_id`` (or ANY worker very recently — fast-lane tasks are
-        attributed by time, their ids live in the C++ core)?"""
+        ``task_id`` (or, for FAST-LANE crashes only, ANY worker very
+        recently — lane tasks are attributed by time, their ids live in
+        the C++ core)?"""
+        if _fp.ENABLED:
+            _fp.fire("daemon.oom_check", task=msg.get("task_id", ""))
         mon = getattr(self, "memory_monitor", None)
         if mon is None:
             return {"oom": False, "kills": 0}
@@ -1399,10 +1416,14 @@ class DaemonService:
                 (t.hex() if hasattr(t, "hex") else t) == msg["task_id"]
                 for t in mon.oom_killed_tasks):
             return {"oom": True, "kills": mon.kills}
-        # fallback covers ONLY un-attributed kills (fast-lane workers,
-        # whose task ids live in the C++ core), and CONSUMES the entry:
-        # one kill explains one crash — it must not keep painting
-        # later, unrelated crashes (e.g. a segfault) as OOM
+        # the un-attributed-kill fallback applies ONLY to lane crashes
+        # (their task ids live in the C++ core); a classic worker's
+        # segfault inside the attribution window must not steal — and
+        # consume — the lane crash's OOM entry
+        if not msg.get("fast_lane"):
+            return {"oom": False, "kills": mon.kills}
+        # CONSUMES the entry: one kill explains one crash — it must not
+        # keep painting later, unrelated crashes as OOM
         return {"oom": mon.consume_unattributed_kill(),
                 "kills": mon.kills}
 
@@ -1563,16 +1584,30 @@ def main() -> None:
     grace = cfg().head_grace_s
 
     def reconnect() -> "HeadClient | None":
-        deadline = time.monotonic() + grace
-        while time.monotonic() < deadline:
+        from ray_tpu._private.retry import RetryPolicy
+
+        if grace <= 0:
+            # head FT disabled: the window is already expired
+            # (RetryPolicy reads deadline_s=0 as "no deadline", which
+            # would dial the dead head forever)
+            return None
+
+        def attempt() -> HeadClient:
+            client = HeadClient(head_addr)
             try:
-                client = HeadClient(head_addr)
                 client.register_node(args.node_id, resources, labels,
                                      server.addr)
-                return client
-            except (OSError, rpc.RpcError):
-                time.sleep(0.25)
-        return None
+            except BaseException:
+                client.close()
+                raise
+            return client
+
+        try:
+            return RetryPolicy.default(deadline_s=grace).run(
+                attempt, loop="daemon.head_reconnect",
+                retry_on=(OSError, rpc.RpcError))
+        except (OSError, rpc.RpcError):
+            return None     # head stayed down past the grace window
 
     while True:  # heartbeat loop; exit if the head declared us dead
         time.sleep(_hb_interval())

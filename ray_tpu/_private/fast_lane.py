@@ -33,6 +33,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import msgpack
 
+from ray_tpu._private import failpoints as _fp
+
 # ops (mirror daemon_core.cc)
 OP_HELLO_WORKER = 0x01
 OP_SUBMIT = 0x02
@@ -51,12 +53,16 @@ KIND_ERR = 0x01
 KIND_CRASHED = 0x63
 KIND_CANCELLED = 0x64
 KIND_PONG = 0x65
-# the function returned a live generator: the lane cannot stream it —
-# the driver re-runs the task on the classic (streaming) path
+# LEGACY: the function returned a live generator and the worker asked
+# the driver to re-run classically. No longer emitted — re-running a
+# plain function whose body already ran doubled its side effects; the
+# worker now drains and ships KIND_GEN_LIST instead. Drivers keep
+# decoding it (classic replay) for old workers mid-upgrade.
 KIND_GEN_FALLBACK = 0x66
-# an ACTOR method returned a generator: the method already ran (state
-# mutated), so no re-run — the worker drains it and ships the item
-# LIST; the driver replays it as a stream
+# the callable returned a generator: the body already ran (actor state
+# mutated / plain-function side effects done), so no re-run — the
+# worker drains it and ships the item LIST; the driver replays it as a
+# stream
 KIND_GEN_LIST = 0x67
 
 _U32 = struct.Struct("<I")
@@ -127,6 +133,30 @@ class FastLaneError(Exception):
     """Transport failure on the fast lane (core/daemon died)."""
 
 
+def replay_gen_list(blob: bytes):
+    """Decode a KIND_GEN_LIST payload into a live generator replaying
+    the worker-drained items — ONE decoder for every driver path
+    (cluster handle + in-process router), so protocol changes can't
+    drift between them. The body already ran worker-side; the driver's
+    streaming machinery consumes the replay exactly like a classic
+    stream without re-running anything."""
+    import cloudpickle
+    items = cloudpickle.loads(blob)
+
+    def replay():
+        yield from items
+
+    return replay()
+
+
+def lane_reconnect_policy():
+    """The shared reconnect schedule for lane clients: a brief backoff
+    window (the daemon may be mid-core-restart); persistent failure is
+    the caller's cue to disable the lane."""
+    from ray_tpu._private.retry import RetryPolicy
+    return RetryPolicy(max_attempts=3, base_s=0.02, max_backoff_s=0.2)
+
+
 class FastLaneClient:
     """One connection to a daemon's C++ core; thread-safe submit."""
 
@@ -195,8 +225,18 @@ class FastLaneClient:
         with self._plock:
             self._pending[rid] = slot
         try:
+            # DROP surfaces as a send failure: the lane is a stream
+            # socket, so a lost frame desyncs framing — peers treat it
+            # as connection loss, and the caller's classic fallback
+            # stays safe (nothing was submitted)
+            if _fp.ENABLED and _fp.fire("fast_lane.submit",
+                                        op=op) is _fp.DROP:
+                raise OSError("frame dropped by failpoint")
             self._send(op, _U64.pack(rid) + extra, payload)
-        except OSError as e:
+        except Exception as e:  # noqa: BLE001 — any send-path failure
+            # (socket death OR an injected error of any class) must pop
+            # the slot and mark the lane dead; a narrower catch leaked
+            # one pending slot per escape
             self.dead = True
             with self._plock:
                 self._pending.pop(rid, None)
@@ -219,11 +259,19 @@ class FastLaneClient:
             pass
 
     def ping(self, timeout: float = 5.0) -> Dict[str, int]:
-        rid = next(self._rids)
-        slot = [threading.Event(), None, None]
-        with self._plock:
-            self._pending[rid] = slot
-        self._send(OP_PING, _U64.pack(rid))
+        # mirrors _submit_op: a send failure must pop the pending slot
+        # and mark the lane dead, not leak the slot and surface a raw
+        # OSError into daemon stats paths
+        if _fp.ENABLED:
+            try:
+                if _fp.fire("fast_lane.ping") is _fp.DROP:
+                    raise OSError("ping dropped by failpoint")
+            except Exception as e:  # noqa: BLE001 — any injected class
+                # must mark the lane dead and surface as the typed
+                # error, mirroring _submit_op's broadened catch
+                self.dead = True
+                raise FastLaneError(str(e))
+        rid, slot = self._submit_op(OP_PING, b"", b"")
         kind, blob = self.wait(slot, timeout)
         if kind != KIND_PONG or len(blob) < 32:
             raise FastLaneError("bad pong")
@@ -280,6 +328,28 @@ def build_actor_payload(spec, args_blob: bytes, job_id,
                if spec.placement_group_id is not None else b""),
         "pgc": bool(getattr(spec, "pg_capture", False)),
     }, use_bin_type=True)
+
+
+# worker-side drain bound for generator-returning callables: the lane
+# ships the drained items as ONE reply frame, so an unbounded (or
+# infinite) generator must error out instead of wedging the lane worker
+# / materializing gigabytes — true streaming belongs to the classic
+# path (num_returns="streaming" or a generator function)
+GEN_DRAIN_MAX_ITEMS = 100_000
+
+
+def _drain_capped(gen) -> list:
+    items: list = []
+    for item in gen:
+        items.append(item)
+        if len(items) > GEN_DRAIN_MAX_ITEMS:
+            gen.close()
+            raise RuntimeError(
+                f"fast-lane task returned a generator exceeding "
+                f"{GEN_DRAIN_MAX_ITEMS} items; use "
+                f"num_returns='streaming' (or a generator function) "
+                f"for unbounded streams")
+    return items
 
 
 def worker_fast_lane_start(addr: Tuple[str, int], state,
@@ -373,19 +443,31 @@ def worker_fast_lane_start(addr: Tuple[str, int], state,
                         with lock:
                             result = method(*args, **kwargs)
                             if inspect.isgenerator(result):
-                                gen_items = list(result)
+                                gen_items = _drain_capped(result)
                     else:
                         result = method(*args, **kwargs)
                         if inspect.isgenerator(result):
-                            gen_items = list(result)
+                            gen_items = _drain_capped(result)
                 else:
                     fn = state._fn({"fn_id": msg["fid"]})
                     result = fn(*args, **kwargs)
+                    if inspect.isgenerator(result):
+                        # a PLAIN function returned a live generator:
+                        # its body already ran (side effects included),
+                        # so the lane must NOT hand the task back for a
+                        # classic re-run (KIND_GEN_FALLBACK re-executed
+                        # the body). Drain here — inside the runtime
+                        # context — and ship the item list; the driver
+                        # replays it as a stream. Generator FUNCTIONS
+                        # never ride the lane (driver eligibility), so
+                        # draining only ever covers already-run bodies.
+                        gen_items = _drain_capped(result)
             finally:
                 runtime_context._reset_context(token)
             if gen_items is not None:
-                # the ACTOR method already ran — ship the drained
-                # items; the driver replays them as a stream
+                # the body already ran (actor method or plain function
+                # that returned a generator) — ship the drained items;
+                # the driver replays them as a stream
                 state._flush_metrics()
                 current["tid"] = 0
                 blob = _safe_dumps(gen_items)
@@ -395,16 +477,6 @@ def worker_fast_lane_start(addr: Tuple[str, int], state,
                          blob)
                 except BaseException:  # noqa: BLE001 — partial frame
                     raise SystemExit from None
-                return
-            if inspect.isgenerator(result):
-                # can't stream over the lane; the driver replays this
-                # task on the classic path (creating a generator runs
-                # no body code, so the replay is side-effect-safe for
-                # generator functions)
-                result.close()
-                current["tid"] = 0
-                send(OP_RESULT,
-                     _U64.pack(tid) + bytes([KIND_GEN_FALLBACK]), b"")
                 return
             state._flush_metrics()
             # clear BEFORE the send: once the driver sees the result a

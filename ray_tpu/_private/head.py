@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import msgpack
 
+from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
 from ray_tpu._private.rpc import HOLD, Client, Connection, Server, declare
 
@@ -303,6 +304,10 @@ class HeadService:
 
     # -- internal KV -----------------------------------------------------
     def handle_kv_put(self, conn, rid, msg):
+        if _fp.ENABLED:
+            # crash arm = head dies mid-put (the respawn/redial drill);
+            # error arm surfaces as a RemoteError at the caller
+            _fp.fire("head.kv_put")
         key = msg["ns"] + b":" + msg["key"]
         with self._lock:
             if not msg["overwrite"] and key in self._kv:
@@ -350,6 +355,9 @@ class HeadService:
         return HOLD
 
     def _publish(self, channel: str, event: Any) -> None:
+        if _fp.ENABLED and _fp.fire("head.pubsub_publish",
+                                    channel=channel) is _fp.DROP:
+            return      # event lost before the log (subscribers starve)
         with self._lock:
             log = self._events.setdefault(channel, [])
             log.append(event)
@@ -452,6 +460,7 @@ class HeadClient:
         self._dial_lock = threading.Lock()
         self._sub_stop = threading.Event()
         self._sub_threads: List[threading.Thread] = []
+        self._retry_policy = None   # built lazily; immutable once made
 
     def _redial(self) -> None:
         with self._dial_lock:
@@ -464,18 +473,27 @@ class HeadClient:
     def _call(self, method: str, timeout: Optional[float] = None, **kw):
         if self._reconnect_window <= 0:
             return self._client.call(method, timeout=timeout, **kw)
-        deadline = time.monotonic() + self._reconnect_window
-        while True:
+        if self._retry_policy is None:
+            # built once: cfg() reads + dataclass construction must not
+            # ride every head RPC on the control-plane hot path
+            from ray_tpu._private.retry import RetryPolicy
+            self._retry_policy = RetryPolicy.default(
+                deadline_s=self._reconnect_window)
+
+        def attempt():
             try:
+                # the client may have been swapped by a redial; read it
+                # fresh each attempt
                 return self._client.call(method, timeout=timeout, **kw)
             except rpc.RpcError:
-                if time.monotonic() >= deadline:
-                    raise
-                time.sleep(0.25)
                 try:
                     self._redial()
                 except OSError:
-                    pass
+                    pass        # head still down: next attempt retries
+                raise
+
+        return self._retry_policy.run(
+            attempt, loop="head.redial", retry_on=(rpc.RpcError,))
 
     # node info
     def register_node(self, node_id: str, resources: Dict[str, float],
@@ -539,16 +557,19 @@ class HeadClient:
                         return
                     # Head restart: re-dial and resume from our cursor
                     # (the persisted event log keeps it valid).
-                    deadline = (time.monotonic()
-                                + self._reconnect_window)
-                    while not self._sub_stop.is_set():
-                        if time.monotonic() >= deadline:
-                            return
-                        try:
-                            sub = Client(self.addr, timeout=None)
-                            break
-                        except OSError:
-                            time.sleep(0.25)
+                    from ray_tpu._private.retry import RetryPolicy
+                    try:
+                        sub = RetryPolicy.default(
+                            deadline_s=self._reconnect_window).run(
+                            lambda: Client(self.addr, timeout=None),
+                            loop="head.subscribe_redial",
+                            retry_on=(OSError,),
+                            abort=self._sub_stop.is_set)
+                    except OSError:
+                        return
+                    if self._sub_stop.is_set():
+                        sub.close()     # dial won the race with stop
+                        return
                     continue
                 cursor = out["cursor"]
                 for event in out["events"]:

@@ -36,6 +36,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import msgpack
 
+from ray_tpu._private import failpoints as _fp
+
 _LEN = struct.Struct("!I")
 MAX_FRAME = 1 << 31
 
@@ -140,6 +142,10 @@ class Client:
         try:
             while True:
                 msg = _recv_frame(self._sock)
+                if _fp.ENABLED and _fp.fire(
+                        "rpc.client.recv",
+                        method=msg.get("m", "")) is _fp.DROP:
+                    continue    # reply/push lost in transit
                 rid = msg.get("i")
                 if rid is None:
                     # server push (no correlation id)
@@ -154,7 +160,9 @@ class Client:
                 if slot is not None:
                     slot[1] = msg
                     slot[0].set()
-        except (RpcError, OSError):
+        except Exception:   # transport death AND injected faults: any
+            # reader exit must fail pending slots, or timeout=None
+            # callers hang forever on a zombie connection
             self._fail_all()
 
     def _fail_all(self) -> None:
@@ -172,6 +180,19 @@ class Client:
         _validate(method, kw)
         if self.dead:
             raise RpcError(f"connection to {self.addr} is dead")
+        # failpoint BEFORE the pending slot exists: an error arm must
+        # not leak a slot; a DROP arm skips the send so the caller times
+        # out exactly like real frame loss
+        dropped = (_fp.ENABLED and _fp.fire(
+            "rpc.client.send", method=method) is _fp.DROP)
+        if dropped and (timeout if timeout is not None
+                        else self._timeout) is None:
+            # a deadline-less caller (long-poll subscribers) can never
+            # observe a lost frame as a timeout — surface the drop as
+            # transport failure instead of wedging the waiter forever
+            # (on healthy TCP, silent frame loss IS connection death)
+            self._fail_all()
+            raise RpcError(f"send to {self.addr} dropped by failpoint")
         with self._id_lock:
             self._id += 1
             rid = self._id
@@ -182,7 +203,8 @@ class Client:
         msg["m"] = method
         msg["i"] = rid
         try:
-            _send_frame(self._sock, msg, self._wlock)
+            if not dropped:
+                _send_frame(self._sock, msg, self._wlock)
         except (OSError, RpcError):
             self._fail_all()
             raise RpcError(f"send to {self.addr} failed")
@@ -202,6 +224,9 @@ class Client:
     def notify(self, method: str, **kw) -> None:
         """Fire-and-forget (no reply expected)."""
         _validate(method, kw)
+        if (_fp.ENABLED and _fp.fire("rpc.client.send",
+                                     method=method) is _fp.DROP):
+            return              # notification lost in transit
         msg = dict(kw)
         msg["m"] = method
         try:
@@ -326,6 +351,9 @@ class Server:
             while not self._stop:
                 msg = _recv_frame(conn.sock)
                 method = msg.get("m", "")
+                if _fp.ENABLED and _fp.fire(
+                        "rpc.server.recv", method=method) is _fp.DROP:
+                    continue    # request lost before dispatch
                 rid = msg.get("i")
                 handler = getattr(self.service, f"handle_{method}", None)
                 if handler is None:
@@ -377,11 +405,20 @@ class Server:
 
 
 def wait_for_server(addr: Tuple[str, int], timeout: float = 15.0) -> None:
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        try:
-            with socket.create_connection(addr, timeout=1.0):
-                return
-        except OSError:
-            time.sleep(0.05)
-    raise RpcError(f"server at {addr} did not come up in {timeout}s")
+    from ray_tpu._private.retry import RetryPolicy
+
+    if timeout <= 0:
+        # an exhausted budget means fail NOW (RetryPolicy reads
+        # deadline_s=0 as "no deadline" and would probe forever)
+        raise RpcError(f"server at {addr} did not come up in {timeout}s")
+
+    def probe() -> None:
+        with socket.create_connection(addr, timeout=1.0):
+            return
+
+    try:
+        RetryPolicy(base_s=0.05, max_backoff_s=0.5,
+                    deadline_s=timeout).run(
+            probe, loop="rpc.wait_for_server", retry_on=(OSError,))
+    except OSError:
+        raise RpcError(f"server at {addr} did not come up in {timeout}s")

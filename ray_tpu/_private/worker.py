@@ -215,6 +215,11 @@ class Runtime:
         # sub-slices instead of landing by resource count
         # (bundle_scheduling_policy.h role; SURVEY §2.3 gang row).
         from ray_tpu._private.config import cfg as _cfg
+        # deterministic fault injection: the `failpoints` flag activates
+        # the registry for this process (spawned daemons/heads/workers
+        # activate from the inherited RAY_TPU_FAILPOINTS env var)
+        from ray_tpu._private import failpoints as _failpoints
+        _failpoints.maybe_activate_from_config(_cfg())
         self.tpu_topology = None
         _topo_spec = _cfg().tpu_topology
         if _topo_spec:
@@ -917,7 +922,8 @@ class Runtime:
                 exc.TaskCancelledError(spec.task_id), spec.name))
             return
         oom = self.memory_monitor.was_oom_killed(spec.task_id)
-        if not oom and getattr(crash, "fast_lane", False):
+        fast_lane = bool(getattr(crash, "fast_lane", False))
+        if not oom and fast_lane:
             # lane workers' task ids live in the native core: attribute
             # by claiming ONE recent un-attributed monitor kill, scoped
             # to lane crashes only so a classic worker's segfault near
@@ -926,13 +932,16 @@ class Runtime:
         if not oom and node is not None:
             # remote workers are policed by THEIR node's monitor (the
             # raylet role): ask the daemon whether this crash was its
-            # OOM kill
+            # OOM kill. The fast_lane flag rides along so the daemon
+            # only takes its un-attributed-kill fallback for lane
+            # crashes — a classic segfault must not consume a lane
+            # crash's OOM entry.
             daemon = getattr(node, "daemon", None)
             if daemon is not None and not daemon.dead:
                 try:
                     oom = daemon.client.call(
                         "oom_check", task_id=spec.task_id.hex(),
-                        timeout=5.0)["oom"]
+                        fast_lane=fast_lane, timeout=5.0)["oom"]
                 except Exception:
                     pass
         if _retries_left(spec):
@@ -1077,11 +1086,76 @@ class Runtime:
 
     def _retry(self, spec: TaskSpec) -> None:
         self.stats["tasks_retried"] += 1
+        from ray_tpu._private import failpoints as _fp
+        from ray_tpu._private.retry import TASK_RETRY, record_retry
+        if _fp.ENABLED:
+            # ANY injected error turns the would-be retry into a
+            # terminal failure (an escape here would leave the task
+            # neither retried nor failed, futures hanging); delay arm
+            # stretches the retry storm
+            try:
+                _fp.fire("worker.retry", task=spec.task_id.hex(),
+                         attempt=spec.attempt_number)
+            except Exception as e:  # noqa: BLE001 — routed to the task
+                self._fail_task(spec, exc.TaskError(e, spec.name))
+                return
+        # unified backoff before the resubmit (exponential, full
+        # jitter, short caps): a crash-looping task must not hammer the
+        # scheduler, and the attempt shows up in the retry counters.
+        # The wait is DEFERRED, never a blocking sleep: node-death
+        # fans out retries for a whole backlog on one thread, and
+        # serialized sleeps there would stall every task behind the
+        # ones before it.
+        backoff = TASK_RETRY.backoff_s(spec.attempt_number)
+        record_retry("worker.task_retry", backoff)
+        if backoff >= 0.01:
+            # ONE shared timer thread services every deferred retry: a
+            # node-death fan-out over a 10k-task backlog must not spawn
+            # 10k Timer threads (thread exhaustion raises out of the
+            # crash-handling path). A resubmit that raises must fail
+            # the task — the wheel's own backstop would silently drop
+            # it and leave its futures hanging forever.
+            from ray_tpu._private.retry import defer
+
+            def fire_retry(spec=spec):
+                try:
+                    self._resubmit_retry(spec)
+                except Exception as e:  # noqa: BLE001 — routed to task
+                    try:
+                        self._fail_task(spec, exc.TaskError(e, spec.name))
+                    except Exception:
+                        pass
+
+            defer(backoff, fire_retry)
+            return
+        self._resubmit_retry(spec)
+
+    def _resubmit_retry(self, spec: TaskSpec) -> None:
+        if self._shutdown:
+            return
         respec = _clone_spec_for_retry(spec)
+        # ONE critical section for check + replace: a gap between the
+        # pop and the reinsert would hide the task from a concurrent
+        # cancel() scan, silently losing the cancel
         with self._tasks_lock:
-            self._tasks.pop(spec.task_id, None)
-            inflight = _InFlightTask(respec)
-            self._tasks[respec.task_id] = inflight
+            old = self._tasks.get(spec.task_id)
+            if old is None:
+                # terminal state reached during the deferred window
+                # (e.g. a force cancel already ran _fail_task and
+                # removed the entry): resurrecting it would re-run a
+                # body the user was told is cancelled/failed
+                return
+            if not old.cancelled:
+                inflight = _InFlightTask(respec)
+                self._tasks[respec.task_id] = inflight
+        if old.cancelled:
+            # a cancel() landed during the deferred-backoff window: the
+            # lane/daemon cancel paths found nothing running, so honor
+            # the flag here instead of resurrecting the task
+            # (_fail_task's _on_task_done drops the stale entry)
+            self._fail_task(spec, exc.TaskError(
+                exc.TaskCancelledError(spec.task_id), spec.name))
+            return
         deps = respec.dependencies()
         if respec.kind == TaskKind.ACTOR_TASK:
             # Replay on the (possibly restarting) actor, not the task path.
@@ -1106,8 +1180,14 @@ class Runtime:
         # On a retry, skip items already reported by the previous attempt
         # (streams are assumed deterministic, as in lineage reconstruction).
         skip = len(state.items)
+        from ray_tpu._private import failpoints as _fp
         try:
             for item in gen:
+                if _fp.ENABLED:
+                    # per-item seam: error arm kills the stream mid-way
+                    # (consumer sees a typed error); delay arm throttles
+                    _fp.fire("worker.generator_stream",
+                             task=spec.task_id.hex())
                 if skip > 0:
                     skip -= 1
                     continue
@@ -1588,19 +1668,29 @@ class Runtime:
     # ------------------------------------------------------------------
     def cancel(self, ref: ObjectRef, force: bool = False,
                recursive: bool = True) -> None:
-        with self._tasks_lock:
-            target = None
-            for inflight in self._tasks.values():
-                if ref.id in inflight.spec.return_ids:
-                    target = inflight
-                    break
-        if target is None:
-            return
-        with target.lock:
-            if target.state in (TaskState.FINISHED, TaskState.FAILED):
+        while True:
+            with self._tasks_lock:
+                target = None
+                for inflight in self._tasks.values():
+                    if ref.id in inflight.spec.return_ids:
+                        target = inflight
+                        break
+            if target is None:
                 return
-            target.cancelled = True
-            was_running = target.state == TaskState.RUNNING
+            with target.lock:
+                if target.state in (TaskState.FINISHED,
+                                    TaskState.FAILED):
+                    return
+                target.cancelled = True
+                was_running = target.state == TaskState.RUNNING
+            # a retry resubmit replaces the _tasks entry (same task_id,
+            # fresh _InFlightTask): if that happened between our lookup
+            # and the flag set, the flag landed on a stale object —
+            # re-loop and cancel the live incarnation (converges: a
+            # flagged live entry stops the retry chain)
+            with self._tasks_lock:
+                if self._tasks.get(target.spec.task_id) is target:
+                    break
         if was_running:
             # Running in a worker process: force → SIGTERM the process
             # (the crash handler reports TaskCancelledError); non-force →

@@ -1668,7 +1668,9 @@ class ProcessRouter:
         self._fast_core = None            # CoreHandle
         self._fast_lock = threading.Lock()
         self._fast_workers: List[WorkerClient] = []
-        self._fast_rids: Dict[str, int] = {}     # task hex -> lane rid
+        # task hex -> (lane client, rid): the client pins the rid to
+        # its generation (see cancel_task)
+        self._fast_rids: Dict[str, Tuple[Any, int]] = {}
         self._fast_disabled = os.environ.get(
             "RAY_TPU_FAST_LANE", "1") == "0"
         self._fast_max = max(2, min(8, (os.cpu_count() or 4)))
@@ -1773,12 +1775,13 @@ class ProcessRouter:
     def _fast_client(self):
         if self._fast is not None and not self._fast.dead:
             return self._fast
-        with self._fast_lock:
-            if self._fast is not None and not self._fast.dead:
-                return self._fast
-            try:
-                from ray_tpu._private.fast_lane import (CoreHandle,
-                                                        FastLaneClient)
+        from ray_tpu._private.fast_lane import (CoreHandle,
+                                                FastLaneClient,
+                                                lane_reconnect_policy)
+        try:
+            with self._fast_lock:
+                if self._fast is not None and not self._fast.dead:
+                    return self._fast
                 if self._fast_core is None:
                     core = CoreHandle()
                     if core.start("127.0.0.1", 0) is None:
@@ -1788,12 +1791,28 @@ class ProcessRouter:
                     threading.Thread(target=self._fast_pool_loop,
                                      daemon=True,
                                      name="router-fastlane").start()
-                self._fast = FastLaneClient(
-                    ("127.0.0.1", self._fast_core.port))
+                port = self._fast_core.port
+            # connect OUTSIDE the lock: the retry window's backoff
+            # sleeps must not stall cancel_task/_fast_rids bookkeeping
+            from ray_tpu._private import failpoints as _fp
+
+            def connect():
+                if _fp.ENABLED:
+                    _fp.fire("fast_lane.reconnect")
+                return FastLaneClient(("127.0.0.1", port))
+
+            fl = lane_reconnect_policy().run(
+                connect, loop="fast_lane.reconnect",
+                retry_on=(OSError, _fp.FailpointError))
+            with self._fast_lock:
+                if self._fast is None or self._fast.dead:
+                    self._fast = fl
+                else:
+                    fl.close()      # lost the reconnect race
                 return self._fast
-            except Exception:
-                self._fast_disabled = True
-                return None
+        except Exception:
+            self._fast_disabled = True
+            return None
 
     def _fast_dedicate(self) -> WorkerClient:
         core = self._fast_core
@@ -1864,7 +1883,10 @@ class ProcessRouter:
             return None                  # nothing submitted: classic
         task_hex = spec.task_id.hex()
         with self._fast_lock:
-            self._fast_rids[task_hex] = rid
+            # store the CLIENT with the rid: after a lane death +
+            # reconnect the new client's rid counter restarts at 1, so
+            # a bare rid could cancel an unrelated task on the new lane
+            self._fast_rids[task_hex] = (fl, rid)
         try:
             kind, blob = fl.wait(slot)
         except _fle.FastLaneError as e:
@@ -1882,8 +1904,13 @@ class ProcessRouter:
             e, tb = cloudpickle.loads(blob)
             setattr(e, "_remote_traceback", tb)
             return ("err", e)
+        if kind == _fle.KIND_GEN_LIST:
+            # the function body already ran and the worker drained its
+            # returned generator: replay as a real generator so the
+            # streaming machinery engages without re-running the body
+            return ("gen", _fle.replay_gen_list(blob))
         if kind == _fle.KIND_GEN_FALLBACK:
-            return None                  # stream via the classic path
+            return None     # legacy worker: stream via the classic path
         if kind == _fle.KIND_CANCELLED:
             return ("err", KeyboardInterrupt())
         if kind == _fle.KIND_CRASHED:
@@ -1910,10 +1937,14 @@ class ProcessRouter:
     def cancel_task(self, task_id: TaskID, force: bool) -> bool:
         task_hex = task_id.hex()
         with self._fast_lock:
-            rid = self._fast_rids.get(task_hex)
-            fl = self._fast
-        if rid is not None and fl is not None and not fl.dead:
-            fl.cancel(rid, force=force)
+            entry = self._fast_rids.get(task_hex)
+        if entry is not None:
+            # cancel on the client GENERATION the task was submitted on
+            # — a reconnected lane restarts its rid counter, and a
+            # stale rid sent there would kill an unrelated task
+            lane_client, rid = entry
+            if not lane_client.dead:
+                lane_client.cancel(rid, force=force)
             return True
         with self._lock:
             entry = self._running.get(task_id)

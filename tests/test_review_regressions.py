@@ -180,3 +180,156 @@ def test_stable_hash_partition_deterministic():
         ).stdout.strip()
         for seed in ("0", "1", "12345")}
     assert len(outs) == 1 and "[" in next(iter(outs))
+
+
+# ---------------------------------------------------------------------------
+# round-6 fault-path regressions (ISSUE 1), driven through failpoints
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _failpoints_reset():
+    from ray_tpu._private import failpoints as fp
+    yield fp
+    fp.reset()
+
+
+def test_lane_gen_fallback_runs_body_once(ray_start_regular, tmp_path):
+    """A plain function with a side effect that RETURNS a generator
+    object must run its body exactly once per attempt. The old
+    KIND_GEN_FALLBACK protocol re-ran it classically after the lane
+    execution (side effects doubled); the worker now drains the
+    generator in place and ships KIND_GEN_LIST."""
+    marker = str(tmp_path / "ran.log")
+
+    @ray_tpu.remote
+    def gen_side_effect():
+        with open(marker, "a") as fh:
+            fh.write("x")
+        return (i * 10 for i in range(4))
+
+    # a plain task returning a generator resolves to the streaming
+    # sentinel; drain the stream it names end to end
+    from ray_tpu._private.worker import _StreamingGeneratorSentinel
+    from ray_tpu.remote_function import ObjectRefGenerator
+
+    sentinel = ray_tpu.get(gen_side_effect.remote())
+    assert isinstance(sentinel, _StreamingGeneratorSentinel)
+    out = [ray_tpu.get(r) for r in ObjectRefGenerator(sentinel.task_id)]
+    assert out == [0, 10, 20, 30]
+    import os
+    assert os.path.exists(marker)
+    with open(marker) as fh:
+        assert fh.read() == "x", "generator-returning body ran twice"
+
+
+def test_oom_check_fast_lane_scoping():
+    """daemon.handle_oom_check: the un-attributed-kill fallback must be
+    claimed ONLY for fast-lane crashes — a classic segfault inside the
+    attribution window must not steal (and consume) the lane crash's
+    OOM entry."""
+    import time as _time
+
+    from ray_tpu._private.daemon import DaemonService
+    from ray_tpu._private.memory_monitor import MemoryMonitor
+
+    svc = DaemonService.__new__(DaemonService)   # handler needs only
+    mon = MemoryMonitor.__new__(MemoryMonitor)   # these attributes
+    mon.kills = 1
+    mon.oom_killed_tasks = set()
+    mon.kill_log = [(1234, _time.time(), False)]  # one unattributed kill
+    svc.memory_monitor = mon
+
+    # classic crash: fallback NOT taken, entry NOT consumed
+    out = DaemonService.handle_oom_check(
+        svc, None, None, {"task_id": "feedface", "fast_lane": False})
+    assert out["oom"] is False
+    assert mon.kill_log[0][2] is False, "classic crash consumed the entry"
+
+    # the lane crash that the kill actually explains still claims it
+    out = DaemonService.handle_oom_check(
+        svc, None, None, {"task_id": "", "fast_lane": True})
+    assert out["oom"] is True
+    assert mon.kill_log[0][2] is True
+    # and one kill explains exactly one crash
+    out = DaemonService.handle_oom_check(
+        svc, None, None, {"task_id": "", "fast_lane": True})
+    assert out["oom"] is False
+
+
+class _FakeLane:
+    def __init__(self, dead=False):
+        self.dead = dead
+        self.cancelled = []
+
+    def cancel(self, rid, force=False):
+        self.cancelled.append((rid, force))
+
+
+def test_cancel_uses_submitting_lane_generation_daemon_handle():
+    """DaemonHandle.cancel_task must send the cancel to the lane CLIENT
+    the task was submitted on. After a lane death + reconnect the new
+    client's rid counter restarts at 1 — a stale rid sent there would
+    kill an unrelated task."""
+    import threading
+
+    from ray_tpu._private.cluster import DaemonHandle
+    from ray_tpu._private.ids import TaskID
+
+    handle = DaemonHandle.__new__(DaemonHandle)
+    handle._fast_lock = threading.Lock()
+    old_lane, new_lane = _FakeLane(), _FakeLane()
+    task_id = TaskID.from_random()
+    handle._fast_rids = {task_id.hex(): (old_lane, 7)}
+    handle._fast = new_lane                      # reconnected client
+    assert handle.cancel_task(task_id, force=False) is True
+    assert old_lane.cancelled == [(7, False)]
+    assert new_lane.cancelled == []              # never the new client
+
+    # dead submitting lane: no cancel bytes anywhere, no crash
+    old_lane.dead = True
+    old_lane.cancelled.clear()
+    handle._fast_rids = {task_id.hex(): (old_lane, 7)}
+    assert handle.cancel_task(task_id, force=True) is True
+    assert old_lane.cancelled == [] and new_lane.cancelled == []
+
+
+def test_cancel_uses_submitting_lane_generation_process_router(
+        monkeypatch):
+    """Same generation rule for the driver-local lane
+    (ProcessRouter.cancel_task)."""
+    monkeypatch.setenv("RAY_TPU_PROCESS_WORKERS", "0")
+    from ray_tpu._private.ids import TaskID
+    from ray_tpu._private.worker_process import ProcessRouter
+
+    router = ProcessRouter(runtime=None)
+    old_lane, new_lane = _FakeLane(), _FakeLane()
+    task_id = TaskID.from_random()
+    router._fast_rids = {task_id.hex(): (old_lane, 3)}
+    router._fast = new_lane
+    assert router.cancel_task(task_id, force=True) is True
+    assert old_lane.cancelled == [(3, True)]
+    assert new_lane.cancelled == []
+
+
+def test_fast_lane_ping_slot_leak(_failpoints_reset):
+    """FastLaneClient.ping send failure: pending slot popped, lane
+    marked dead, typed FastLaneError raised (not a raw OSError leaking
+    into daemon stats paths)."""
+    import socket
+
+    from ray_tpu._private import fast_lane as fle
+
+    fp = _failpoints_reset
+    srv = socket.create_server(("127.0.0.1", 0))
+    client = fle.FastLaneClient(srv.getsockname())
+    try:
+        # the fast_lane.submit seam fires inside _submit_op AFTER the
+        # pending slot is installed: ping() now routes through it, so a
+        # revert of the pop-on-send-failure cleanup fails this assert
+        fp.activate("fast_lane.submit=error(OSError)")
+        with pytest.raises(fle.FastLaneError):
+            client.ping(timeout=0.5)
+        assert client.dead and not client._pending
+    finally:
+        client.close()
+        srv.close()

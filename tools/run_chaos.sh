@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Chaos tier: replay the seeded failpoint schedules across BOTH
+# execution topologies (in-process virtual nodes and
+# RAY_TPU_CLUSTER=daemons head+daemon OS processes).
+#
+# The schedules themselves are deterministic per seed (see
+# tests/test_chaos.py and docs/fault_tolerance.md); this script sweeps
+# the topologies — the daemons-specific tests boot their own cluster
+# regardless of the env var, the topology-agnostic tests (the rpc-drop
+# replays, stream kill) run under whichever topology the env selects.
+#
+# Usage: tools/run_chaos.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "=== chaos tier: in-process topology ==="
+RAY_TPU_CLUSTER= python -m pytest tests/test_chaos.py -q -m chaos \
+    -p no:cacheprovider -p no:randomly "$@"
+
+echo "=== chaos tier: daemons topology ==="
+RAY_TPU_CLUSTER=daemons python -m pytest tests/test_chaos.py -q -m chaos \
+    -p no:cacheprovider -p no:randomly "$@"
+
+echo "chaos tier: OK (both topologies)"
