@@ -34,7 +34,7 @@ __all__ = [
     "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
     "kill", "cancel", "get_actor", "get_runtime_context", "ObjectRef",
     "ObjectRefGenerator", "ActorHandle", "exit_actor", "cluster_resources",
-    "available_resources", "nodes", "exceptions", "method",
+    "available_resources", "nodes", "drain_node", "exceptions", "method",
     "NodeAffinitySchedulingStrategy", "NodeLabelSchedulingStrategy",
     "PlacementGroupSchedulingStrategy",
 ]
@@ -153,10 +153,28 @@ def nodes() -> List[Dict[str, Any]]:
     rt = _worker.global_worker()
     out = []
     for info in rt.gcs.nodes.values():
+        node = rt.get_node(info.node_id)
         out.append({
             "NodeID": info.node_id.hex(),
             "Alive": info.alive,
+            "Draining": bool(node is not None
+                             and getattr(node, "draining", False)),
             "Resources": dict(info.resources),
             "Labels": dict(info.labels),
         })
     return out
+
+
+def drain_node(node_id: Union[str, Any],
+               deadline_s: Optional[float] = None,
+               reason: str = "preemption") -> bool:
+    """Gracefully drain a node (planned departure: preemption notice,
+    downscale, maintenance): new placements avoid it, queued tasks
+    resubmit elsewhere, primary object replicas and actors migrate off
+    proactively, and once its running work finishes it leaves the
+    cluster with no reconstruction debt. If ``deadline_s`` (default:
+    the ``drain_deadline_s`` flag) expires first, the drain escalates
+    into the ordinary node-death path. Returns True if a drain started."""
+    return _worker.global_worker().drain_node(node_id,
+                                              deadline_s=deadline_s,
+                                              reason=reason)

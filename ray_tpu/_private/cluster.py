@@ -685,6 +685,12 @@ class RemoteStore:
             entry = self._meta.get(object_id)
         return entry[1] if entry else 0
 
+    def meta_of(self, object_id) -> Tuple[bytes, int]:
+        """(daemon store key, nbytes) — the handle a peer daemon needs
+        to pull this object directly (drain migration path)."""
+        with self._lock:
+            return self._meta[object_id]
+
     def has_daemon_key(self, daemon_key: bytes) -> bool:
         """Directory support: does this node hold the given store key?"""
         with self._lock:
@@ -1011,16 +1017,38 @@ class ClusterBackend:
             except Exception:
                 pass
         if add_runtime_node:
-            self.runtime.add_remote_node(handle,
-                                         dict(info["resources"]))
+            node = self.runtime.add_remote_node(handle,
+                                                dict(info["resources"]))
+            if info.get("draining"):
+                # joined mid-drain (e.g. we subscribed after the drain
+                # event): start migration with the remaining window
+                self.runtime.begin_node_drain(
+                    node, float(info.get("drain_deadline_s") or 0.0),
+                    info.get("drain_reason") or "drain")
         return handle
 
     def _on_node_event(self, event: Dict[str, Any]) -> None:
-        if event.get("kind") == "added":
+        kind = event.get("kind")
+        if kind == "added":
             self._join_node(event.get("node") or {},
                             add_runtime_node=True)
             return
-        if event.get("kind") != "death":
+        if kind == "drain":
+            # Graceful drain announced (self-announced preemption, or
+            # another driver / the CLI): start proactive migration.
+            # begin_node_drain is idempotent, so the initiating driver's
+            # own direct call and this event coexist.
+            try:
+                node = self.runtime.get_node(
+                    NodeID.from_hex(event["node_id"]))
+            except (KeyError, ValueError):
+                return
+            if node is not None:
+                self.runtime.begin_node_drain(
+                    node, float(event.get("deadline_s") or 0.0),
+                    event.get("reason") or "drain")
+            return
+        if kind != "death":
             return
         node_id = NodeID.from_hex(event["node_id"])
         with self._lock:
@@ -1036,6 +1064,10 @@ class ClusterBackend:
         # task retries, actor restarts).
         node = self.runtime.get_node(node_id)
         if node is not None:
+            if event.get("drain_expired"):
+                # the HEAD's deadline escalation beat the driver's own
+                # timer (exactly-once accounting lives in the runtime)
+                self.runtime.count_drain_escalation(node)
             try:
                 self.runtime.remove_node(node, _from_cluster=True)
             except Exception:

@@ -68,6 +68,15 @@ FLAG_DEFS = [
     Flag("heartbeat_interval_s", float, 0.2, "daemon->head heartbeat period"),
     Flag("node_dead_after_s", float, 1.5, "missed-heartbeat window before "
          "the head declares a node dead"),
+    # -- graceful drain / preemption --
+    Flag("drain_deadline_s", float, 30.0, "default graceful-drain window: "
+         "planned departures (preemption notice, downscale, maintenance) "
+         "migrate objects/actors and finish running work for up to this "
+         "long before escalating to the hard node-death path"),
+    Flag("drain_notice_file", str, "", "path the daemon's preemption "
+         "watcher polls; the file appearing (content = reason) triggers "
+         "a self-announced graceful drain — the air-gapped stand-in for "
+         "the cloud metadata server's maintenance/preemption notice"),
     # -- object plane --
     Flag("native_store", bool, True, "use the C++ shm arena for large "
          "objects (False = pure-dict store)"),

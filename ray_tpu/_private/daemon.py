@@ -87,6 +87,96 @@ declare("core_release", "task")
 
 
 # ---------------------------------------------------------------------------
+# preemption watcher: self-announced graceful drain
+# ---------------------------------------------------------------------------
+
+class PreemptionWatcher:
+    """Funnels preemption/maintenance notices into ONE self-announced
+    graceful drain to the head (reference: spot TPU-VM preemption — the
+    ACPI SIGTERM plus the metadata server's maintenance-event endpoint).
+
+    Sources, all converging on :meth:`notify`:
+
+    - **SIGTERM** — ``install_sigterm()`` (daemon ``main()`` installs it
+      before entering the heartbeat loop; the handler only sets an
+      event, the announce RPC runs on the watcher thread);
+    - **notice file** — ``drain_notice_file`` flag: the file appearing
+      is the notice, its content the reason (the pluggable, air-gapped
+      stand-in for polling the cloud metadata server);
+    - **programmatic** — ``notify(reason)`` from any integration hook.
+
+    After announcing, the daemon keeps serving: the head's DRAINING
+    state fences new placements, the driver migrates work off, and the
+    head escalates to the death path at the deadline — at which point
+    the heartbeat's ``{"dead": True}`` reply makes this process exit.
+    """
+
+    def __init__(self, node_id_hex: str, head_addr: Tuple[str, int],
+                 deadline_s: float, notice_file: str = ""):
+        self.node_id_hex = node_id_hex
+        self.head_addr = head_addr
+        self.deadline_s = deadline_s
+        self.notice_file = notice_file
+        self.announced = False
+        self._reason = "preemption"
+        self._event = threading.Event()
+
+    def notify(self, reason: str = "preemption") -> None:
+        self._reason = reason
+        self._event.set()
+
+    def install_sigterm(self) -> None:
+        import signal
+
+        def handler(signum, frame):
+            self.notify("sigterm")
+
+        try:
+            signal.signal(signal.SIGTERM, handler)
+        except ValueError:
+            pass    # not the main thread (embedded use): file/hook only
+
+    def start(self) -> None:
+        threading.Thread(target=self._loop, daemon=True,
+                         name="preemption-watch").start()
+
+    def _loop(self) -> None:
+        while not self._event.wait(0.2):
+            if self.notice_file and os.path.exists(self.notice_file):
+                try:
+                    with open(self.notice_file) as fh:
+                        reason = fh.read().strip() or "maintenance notice"
+                except OSError:
+                    reason = "maintenance notice"
+                self.notify(reason)
+        self._announce()
+
+    def _announce(self) -> None:
+        if self.announced:
+            return
+        self.announced = True
+        if _fp.ENABLED:
+            try:
+                # drop/error arm = the notice never reaches the head
+                # (the VM then just dies: the ordinary crash path is
+                # the backstop); delay arm shrinks the drain window
+                if _fp.fire("drain.announce",
+                            node=self.node_id_hex) is _fp.DROP:
+                    return
+            except Exception:
+                return
+        try:
+            head = HeadClient(self.head_addr)
+            try:
+                head.drain_node(self.node_id_hex, self.deadline_s,
+                                self._reason)
+            finally:
+                head.close()
+        except (OSError, rpc.RpcError):
+            pass    # head unreachable: crash-path recovery covers us
+
+
+# ---------------------------------------------------------------------------
 # object table: dict for small blobs, C++ shm arena for large ones
 # ---------------------------------------------------------------------------
 
@@ -1574,7 +1664,9 @@ def main() -> None:
     service.start_memory_monitor()
     labels = json.loads(args.labels)
     head = HeadClient(head_addr)
-    head.register_node(args.node_id, resources, labels, server.addr)
+    out = head.register_node(args.node_id, resources, labels, server.addr)
+    if out.get("dead"):
+        os._exit(0)     # fenced: this node_id was declared dead
 
     # Head-FT (reference: raylets resync after a GCS restart,
     # gcs_init_data.h): on transport failure keep re-dialing the head for
@@ -1582,6 +1674,16 @@ def main() -> None:
     # one that explicitly declares us dead — ends the session.
     from ray_tpu._private.config import cfg
     grace = cfg().head_grace_s
+
+    # Preemption watcher: SIGTERM / the maintenance-notice file trigger
+    # a self-announced graceful drain (the head then fences placements,
+    # the driver migrates, and the deadline escalates to node death).
+    watcher = PreemptionWatcher(args.node_id, head_addr,
+                                cfg().drain_deadline_s,
+                                cfg().drain_notice_file)
+    watcher.install_sigterm()
+    watcher.start()
+    service.preemption_watcher = watcher
 
     def reconnect() -> "HeadClient | None":
         from ray_tpu._private.retry import RetryPolicy
@@ -1625,10 +1727,12 @@ def main() -> None:
         if out.get("unknown"):
             # Restarted head with empty membership: re-register.
             try:
-                head.register_node(args.node_id, resources, labels,
-                                   server.addr)
+                out2 = head.register_node(args.node_id, resources,
+                                          labels, server.addr)
             except rpc.RpcError:
-                pass
+                continue
+            if out2.get("dead"):
+                os._exit(0)     # fenced out: never rejoin as a zombie
 
 
 if __name__ == "__main__":

@@ -18,6 +18,14 @@ Services:
 - NodeInfo: register_node / heartbeat / list_nodes / drain_node;
   a monitor thread marks nodes dead after ``DEAD_AFTER_S`` without a
   heartbeat and publishes ``node_death`` (active health checking).
+  ``drain_node(node_id, deadline_s, reason)`` moves the node to a
+  DRAINING membership state and publishes a ``node_drain`` event so the
+  scheduling authority can migrate work off it; when the deadline
+  expires the monitor escalates into the ordinary death path
+  (reference: the GCS DrainNode RPC + autoscaler drain protocol,
+  ``gcs_node_manager.cc HandleDrainNode``). A node that was declared
+  dead may NOT re-register under the same id (zombie fencing,
+  mirroring the heartbeat ``{"dead": True}`` contract).
 - InternalKV: kv_put / kv_get / kv_del / kv_keys (bytes in, bytes out).
 - Pubsub: subscribe(channel) parks the request (long-poll HOLD); publish
   completes every parked subscriber with the event batch.
@@ -61,7 +69,7 @@ HEARTBEAT_S = 0.2
 declare("register_node", "node_id", "resources", "labels", "addr")
 declare("heartbeat", "node_id", "available")
 declare("list_nodes")
-declare("drain_node", "node_id")
+declare("drain_node", "node_id", "deadline_s", "reason")
 declare("mark_node_dead", "node_id", "reason")
 declare("kv_put", "key", "value", "overwrite", "ns")
 declare("kv_get", "key", "ns")
@@ -83,7 +91,8 @@ TRANSIENT_WINDOW = 200
 
 class _NodeEntry:
     __slots__ = ("node_id", "resources", "labels", "addr", "alive",
-                 "last_beat", "available", "reason", "avail_gossip_ts")
+                 "last_beat", "available", "reason", "avail_gossip_ts",
+                 "draining", "drain_deadline", "drain_reason")
 
     def __init__(self, node_id: str, resources: Dict[str, float],
                  labels: Dict[str, str], addr: Tuple[str, int]):
@@ -96,12 +105,22 @@ class _NodeEntry:
         self.available = dict(resources)
         self.reason = ""
         self.avail_gossip_ts = 0.0   # last syncer report for this node
+        # graceful-drain state: alive + draining = no NEW placements,
+        # running work may finish; past drain_deadline the monitor
+        # escalates to the death path
+        self.draining = False
+        self.drain_deadline = 0.0    # monotonic
+        self.drain_reason = ""
 
     def view(self) -> Dict[str, Any]:
         return {"node_id": self.node_id, "resources": self.resources,
                 "labels": self.labels, "addr": list(self.addr),
                 "alive": self.alive, "available": self.available,
-                "reason": self.reason}
+                "reason": self.reason, "draining": self.draining,
+                "drain_reason": self.drain_reason,
+                "drain_deadline_s": (
+                    max(0.0, self.drain_deadline - time.monotonic())
+                    if self.draining else 0.0)}
 
 
 class _HeadStore:
@@ -195,6 +214,13 @@ class _HeadStore:
         self._db.commit()
 
 
+# Persisted drain records live in the head store's kv table under this
+# raw prefix. Client-visible keys are stored as ``ns + b":" + key`` —
+# they ALWAYS contain a colon — so a colon-free prefix can never collide
+# with (or leak into) any namespace's kv_get/kv_keys view.
+_DRAIN_KEY = b"\x00drain\x00"
+
+
 class HeadService:
     def __init__(self, state_path: Optional[str] = None):
         self._lock = threading.Lock()
@@ -213,9 +239,22 @@ class HeadService:
         self._gossip_loads: Dict[str, Dict[str, Any]] = {}
         from collections import deque as _deque
         self._task_events: Any = _deque(maxlen=self._task_events_cap)
+        # node_id -> (wall-clock deadline, reason): drains survive a
+        # head restart (membership does not, so the record re-attaches
+        # when the draining daemon re-registers after the respawn).
+        self._drains: Dict[str, Tuple[float, str]] = {}
         if state_path:
             self._store = _HeadStore(state_path)
             self._kv, self._events = self._store.load()
+            for key in [k for k in self._kv if k.startswith(_DRAIN_KEY)]:
+                blob = self._kv.pop(key)
+                try:
+                    rec = msgpack.unpackb(blob, raw=False)
+                    self._drains[key[len(_DRAIN_KEY):].decode()] = (
+                        float(rec["deadline_wall"]), str(rec["reason"]))
+                except Exception:
+                    # a malformed record must not keep the head down
+                    self._store.delete(key)
         self._stop = threading.Event()
         self._monitor = threading.Thread(target=self._health_loop,
                                          daemon=True, name="head-health")
@@ -223,13 +262,37 @@ class HeadService:
 
     # -- node membership / health ---------------------------------------
     def handle_register_node(self, conn, rid, msg):
-        entry = _NodeEntry(msg["node_id"], msg["resources"],
+        node_id = msg["node_id"]
+        entry = _NodeEntry(node_id, msg["resources"],
                            msg["labels"], tuple(msg["addr"]))
         with self._lock:
-            self._nodes[msg["node_id"]] = entry
-        conn.meta["node_id"] = msg["node_id"]
+            cur = self._nodes.get(node_id)
+            if cur is not None and not cur.alive:
+                # Zombie fencing: this node was declared dead (death
+                # published, owners already recovered its work); a
+                # re-registration would resurrect it with stale state.
+                # Same contract as the heartbeat {"dead": True} reply —
+                # the daemon must exit.
+                return {"ok": False, "dead": True, "reason": cur.reason}
+            drain = self._drains.get(node_id)
+            if drain is not None:
+                # A drain survived a head restart: re-attach it with the
+                # remaining wall-clock window.
+                entry.draining = True
+                entry.drain_deadline = time.monotonic() + max(
+                    0.0, drain[0] - time.time())
+                entry.drain_reason = drain[1]
+            self._nodes[node_id] = entry
+        conn.meta["node_id"] = node_id
         self._publish("node", {"kind": "added", "node": entry.view()})
-        return {"ok": True}
+        if entry.draining:
+            # re-announce so a (re)subscribed driver resumes migration
+            self._publish("node", {
+                "kind": "drain", "node_id": node_id,
+                "deadline_s": max(0.0, entry.drain_deadline
+                                  - time.monotonic()),
+                "reason": entry.drain_reason})
+        return {"ok": True, "draining": entry.draining}
 
     def handle_heartbeat(self, conn, rid, msg):
         with self._lock:
@@ -244,12 +307,13 @@ class HeadService:
             if time.monotonic() - entry.avail_gossip_ts > 2.0:
                 entry.available = msg["available"]
             was_dead = not entry.alive
+            draining = entry.draining
         if was_dead:
             # A heartbeat from a node we declared dead: tell it to exit
             # (reference: raylets that lost GCS contact must not rejoin
             # with stale state).
             return {"ok": False, "dead": True}
-        return {"ok": True}
+        return {"ok": True, "draining": draining}
 
     def handle_list_nodes(self, conn, rid, msg):
         with self._lock:
@@ -262,7 +326,36 @@ class HeadService:
             return {"nodes": nodes}
 
     def handle_drain_node(self, conn, rid, msg):
-        self._mark_dead(msg["node_id"], "drained")
+        """Graceful drain: publish ``node_drain`` and keep the node
+        alive-but-DRAINING so running work can finish and the driver can
+        migrate objects/actors off it; the health monitor escalates to
+        the death path when ``deadline_s`` expires. (The former behavior
+        — an immediate ``_mark_dead`` — made every planned departure as
+        expensive as a crash.)"""
+        node_id = msg["node_id"]
+        deadline_s = max(0.0, float(msg.get("deadline_s") or 0.0))
+        reason = msg.get("reason") or "drain"
+        with self._lock:
+            entry = self._nodes.get(node_id)
+            if entry is None or not entry.alive:
+                return {"ok": False, "unknown": True}
+            if entry.draining:
+                # idempotent: the first drain's deadline stands
+                return {"ok": True, "already": True}
+            entry.draining = True
+            entry.drain_deadline = time.monotonic() + deadline_s
+            entry.drain_reason = reason
+            self._drains[node_id] = (time.time() + deadline_s, reason)
+            if self._store is not None:
+                self._store.put(
+                    _DRAIN_KEY + node_id.encode(),
+                    msgpack.packb({"deadline_wall": time.time()
+                                   + deadline_s,
+                                   "reason": reason},
+                                  use_bin_type=True))
+        self._publish("node", {"kind": "drain", "node_id": node_id,
+                               "deadline_s": deadline_s,
+                               "reason": reason})
         return {"ok": True}
 
     def handle_mark_node_dead(self, conn, rid, msg):
@@ -271,27 +364,47 @@ class HeadService:
         self._mark_dead(msg["node_id"], msg["reason"])
         return {"ok": True}
 
-    def _mark_dead(self, node_id: str, reason: str) -> None:
+    def _mark_dead(self, node_id: str, reason: str,
+                   drain_expired: bool = False) -> None:
         with self._lock:
             entry = self._nodes.get(node_id)
             if entry is None or not entry.alive:
                 return
             entry.alive = False
             entry.reason = reason
+            was_draining = entry.draining
+            entry.draining = False
+            self._drains.pop(node_id, None)
+            if self._store is not None:
+                self._store.delete(_DRAIN_KEY + node_id.encode())
         self._publish("node", {"kind": "death", "node_id": node_id,
-                               "reason": reason})
+                               "reason": reason,
+                               "was_draining": was_draining,
+                               "drain_expired": drain_expired})
 
     def _health_loop(self) -> None:
         while not self._stop.wait(_hb_interval()):
             now = time.monotonic()
             dead: List[str] = []
+            expired: List[str] = []
             window = _dead_after()
             with self._lock:
                 for entry in self._nodes.values():
-                    if entry.alive and now - entry.last_beat > window:
+                    if not entry.alive:
+                        continue
+                    if now - entry.last_beat > window:
                         dead.append(entry.node_id)
+                    elif entry.draining and now > entry.drain_deadline:
+                        expired.append(entry.node_id)
             for node_id in dead:
                 self._mark_dead(node_id, "missed heartbeats")
+            for node_id in expired:
+                # escalation: the drain window closed with the node
+                # still up — fall back to the ordinary death path
+                # (lineage reconstruction covers whatever did not
+                # migrate in time)
+                self._mark_dead(node_id, "drain deadline expired",
+                                drain_expired=True)
 
     def on_disconnect(self, conn: Connection) -> None:
         node_id = conn.meta.get("node_id")
@@ -460,6 +573,11 @@ class HeadClient:
         self._dial_lock = threading.Lock()
         self._sub_stop = threading.Event()
         self._sub_threads: List[threading.Thread] = []
+        # live per-channel subscriber connections, tracked so close()
+        # can actually close them (a parked long-poll otherwise holds
+        # its socket open forever)
+        self._sub_clients: List[Client] = []
+        self._sub_lock = threading.Lock()
         self._retry_policy = None   # built lazily; immutable once made
 
     def _redial(self) -> None:
@@ -521,6 +639,13 @@ class HeadClient:
     def mark_node_dead(self, node_id: str, reason: str) -> None:
         self._call("mark_node_dead", node_id=node_id, reason=reason)
 
+    def drain_node(self, node_id: str, deadline_s: float,
+                   reason: str = "drain") -> Dict[str, Any]:
+        """Ask the head to move a node into the DRAINING state (graceful
+        departure); escalates to the death path after ``deadline_s``."""
+        return self._call("drain_node", node_id=node_id,
+                          deadline_s=deadline_s, reason=reason)
+
     def report_resources(self, loads: Dict[str, Dict[str, float]]) -> None:
         """Push per-node availability views (syncer gossip)."""
         self._call("report_resources", loads=loads, timeout=5.0)
@@ -542,41 +667,69 @@ class HeadClient:
         return self._call("kv_keys", prefix=prefix, ns=namespace)["keys"]
 
     # pubsub
+    def _sub_swap(self, old: Optional[Client],
+                  new: Optional[Client]) -> None:
+        """Track the live subscriber connection for close(). If close()
+        already ran, the fresh client is closed on the spot (the dial
+        won the race with stop)."""
+        with self._sub_lock:
+            if old is not None:
+                try:
+                    self._sub_clients.remove(old)
+                except ValueError:
+                    pass
+            if new is not None:
+                self._sub_clients.append(new)
+                if self._sub_stop.is_set():
+                    new.close()
+
     def subscribe(self, channel: str, callback) -> None:
         """Long-poll subscription: dedicated connection per channel (a
         parked poll must not block other requests' replies)."""
         def loop():
             cursor = 0
-            sub = Client(self.addr, timeout=None)
-            while not self._sub_stop.is_set():
-                try:
-                    out = sub.call("subscribe", channel=channel,
-                                   cursor=cursor, timeout=None)
-                except rpc.RpcError:
-                    if self._reconnect_window <= 0:
-                        return
-                    # Head restart: re-dial and resume from our cursor
-                    # (the persisted event log keeps it valid).
-                    from ray_tpu._private.retry import RetryPolicy
+            try:
+                sub = Client(self.addr, timeout=None)
+            except OSError:
+                return
+            self._sub_swap(None, sub)
+            try:
+                while not self._sub_stop.is_set():
                     try:
-                        sub = RetryPolicy.default(
-                            deadline_s=self._reconnect_window).run(
-                            lambda: Client(self.addr, timeout=None),
-                            loop="head.subscribe_redial",
-                            retry_on=(OSError,),
-                            abort=self._sub_stop.is_set)
-                    except OSError:
-                        return
-                    if self._sub_stop.is_set():
-                        sub.close()     # dial won the race with stop
-                        return
-                    continue
-                cursor = out["cursor"]
-                for event in out["events"]:
-                    try:
-                        callback(event)
-                    except Exception:
-                        pass
+                        out = sub.call("subscribe", channel=channel,
+                                       cursor=cursor, timeout=None)
+                    except rpc.RpcError:
+                        if (self._sub_stop.is_set()
+                                or self._reconnect_window <= 0):
+                            return
+                        # Head restart: re-dial and resume from our
+                        # cursor (the persisted event log keeps it
+                        # valid).
+                        from ray_tpu._private.retry import RetryPolicy
+                        try:
+                            # stop-interruptible backoff: close() must
+                            # not wait out a multi-second redial sleep
+                            new = RetryPolicy.default(
+                                deadline_s=self._reconnect_window).run(
+                                lambda: Client(self.addr, timeout=None),
+                                loop="head.subscribe_redial",
+                                retry_on=(OSError,),
+                                abort=self._sub_stop.is_set,
+                                sleep=self._sub_stop.wait)
+                        except OSError:
+                            return
+                        self._sub_swap(sub, new)
+                        sub = new
+                        continue
+                    cursor = out["cursor"]
+                    for event in out["events"]:
+                        try:
+                            callback(event)
+                        except Exception:
+                            pass
+            finally:
+                self._sub_swap(sub, None)
+                sub.close()
 
         t = threading.Thread(target=loop, daemon=True,
                              name=f"head-sub-{channel}")
@@ -584,7 +737,10 @@ class HeadClient:
         self._sub_threads.append(t)
 
     def publish(self, channel: str, event: Any) -> None:
-        self._client.call("publish", channel=channel, event=event)
+        # rides _call: with reconnect_window > 0 a publish survives a
+        # head restart like every other head RPC (a direct client.call
+        # here bypassed the redial path and failed mid-restart)
+        self._call("publish", channel=channel, event=event)
 
     def stop_head(self) -> None:
         try:
@@ -594,6 +750,16 @@ class HeadClient:
 
     def close(self) -> None:
         self._sub_stop.set()
+        # closing the per-channel sub clients unblocks their parked
+        # long-polls, so the threads exit instead of leaking sockets
+        with self._sub_lock:
+            subs = list(self._sub_clients)
+        for sub in subs:
+            sub.close()
+        cur = threading.current_thread()
+        for t in self._sub_threads:
+            if t is not cur:        # close() from a callback thread
+                t.join(timeout=2.0)
         self._client.close()
 
 

@@ -30,6 +30,9 @@ from ray_tpu._private.task_spec import TaskKind, TaskSpec
 
 _DISPATCH_POLL_S = 5.0
 
+# Queue sentinel that only wakes the dispatch loop (None means exit).
+_WAKE = object()
+
 
 class ResourceLedger:
     """Tracks total/available resources with blocking acquire."""
@@ -301,6 +304,10 @@ class Node:
         self.store = store
         self._execute_task = execute_task
         self.alive = True
+        # Graceful drain: alive + draining = finish running work, take
+        # no new placements; the dispatch loop hands queued-but-
+        # unstarted tasks back to the runtime for resubmission elsewhere.
+        self.draining = False
         self.actors: Dict[ActorID, ActorExecutor] = {}
         self._actors_lock = threading.Lock()
         self._queue: "queue.Queue[Optional[TaskSpec]]" = queue.Queue()
@@ -375,6 +382,9 @@ class Node:
                     spec = self._queue.get(timeout=timeout)
                     if spec is None:
                         return
+                    if spec is _WAKE:
+                        timeout = 0.0
+                        continue
                     key = tuple(sorted(spec.resources.items()))
                     bucket = self._backlog.get(key)
                     if bucket is None:
@@ -387,6 +397,11 @@ class Node:
             if not self.alive:
                 self._fail_backlog()
                 continue
+            if self.draining and self._backlog_n:
+                # Hand queued-but-unstarted work back to the cluster
+                # scheduler (no retry consumed). Whatever bounces back
+                # (nowhere else fits) falls through and dispatches here.
+                self._resubmit_backlog()
             progressed = False
             self.loop_stats["dispatch_iterations"] += 1
             for key in list(self._backlog):
@@ -451,6 +466,41 @@ class Node:
         if rt is not None:
             for spec in backlog:
                 rt.on_node_task_lost(spec, self)
+
+    def start_drain(self) -> None:
+        """Enter the DRAINING state: running tasks finish, the dispatch
+        loop returns queued work to the runtime, the scheduler stops
+        placing here. Runs on any thread; the backlog itself is only
+        touched by the dispatch thread (woken via the sentinel)."""
+        self.draining = True
+        self._queue.put(_WAKE)
+
+    def _resubmit_backlog(self) -> None:
+        """Graceful-drain pass (dispatch thread only): queued tasks that
+        have not been bounced before go back to the cluster scheduler;
+        a task the scheduler sent BACK here (nothing else fits) keeps
+        its spot and dispatches locally — no resubmit ping-pong."""
+        from ray_tpu._private import worker
+        rt = worker.global_runtime()
+        if rt is None:
+            return
+        keep: "OrderedDict[tuple, deque]" = OrderedDict()
+        moved: List[TaskSpec] = []
+        for key, bucket in self._backlog.items():
+            stay: deque = deque()
+            for spec in bucket:
+                if getattr(spec, "_drain_bounced", False):
+                    stay.append(spec)
+                else:
+                    moved.append(spec)
+            if stay:
+                keep[key] = stay
+        self._backlog = keep
+        self._backlog_n = sum(len(b) for b in keep.values())
+        for spec in moved:
+            self._drop_pending(spec)
+        for spec in moved:
+            rt.on_node_task_drained(spec, self)
 
     # -- actor hosting -----------------------------------------------------
     def host_actor(self, executor: ActorExecutor) -> None:

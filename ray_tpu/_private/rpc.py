@@ -238,9 +238,17 @@ class Client:
     def close(self) -> None:
         self.dead = True
         try:
+            # a bare close() does NOT wake a reader blocked in recv()
+            # (the fd may even be reused); shutdown() delivers EOF so
+            # the reader exits and deadline-less callers unblock
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass
+        self._fail_all()    # idempotent: close() means dead for callers
 
 
 # ---------------------------------------------------------------------------

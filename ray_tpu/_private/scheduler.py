@@ -47,19 +47,36 @@ class ClusterScheduler:
         alive = [n for n in nodes if n.alive]
         if not alive:
             raise SchedulingError("no alive nodes in cluster")
+        # DRAINING nodes take no NEW placements while their running work
+        # finishes (graceful preemption). When every alive node is
+        # draining, fall back to them — running the task somewhere beats
+        # failing a feasible demand.
+        schedulable = [n for n in alive
+                       if not getattr(n, "draining", False)] or alive
 
         strategy = spec.scheduling_strategy
         if isinstance(strategy, PlacementGroupSchedulingStrategy):
             return self._pick_pg(spec, strategy, alive)
         if isinstance(strategy, NodeAffinitySchedulingStrategy):
-            return self._pick_affinity(spec, strategy, alive)
+            return self._pick_affinity(spec, strategy, alive, schedulable)
         if isinstance(strategy, NodeLabelSchedulingStrategy):
+            # filter BOTH pools: the draining fallback below must never
+            # widen past the label selector, and a selector whose only
+            # match is draining still runs there rather than failing
             alive = self._filter_labels(strategy, alive)
             if not alive:
                 raise SchedulingError("no node matches label selector")
+            schedulable = [n for n in alive
+                           if not getattr(n, "draining", False)] or alive
             strategy = "DEFAULT"
 
-        feasible = [n for n in alive if n.ledger.can_fit_total(spec.resources)]
+        feasible = [n for n in schedulable
+                    if n.ledger.can_fit_total(spec.resources)]
+        if not feasible:
+            # a demand only a draining node can hold still runs there
+            # (letting it fail while capacity exists would be a loss)
+            feasible = [n for n in alive
+                        if n.ledger.can_fit_total(spec.resources)]
         if not feasible:
             raise SchedulingError(
                 f"resource demand {spec.resources} is infeasible on every "
@@ -111,18 +128,25 @@ class ClusterScheduler:
 
     def _pick_affinity(self, spec: TaskSpec,
                        strategy: NodeAffinitySchedulingStrategy,
-                       alive: List[Node]) -> Node:
+                       alive: List[Node],
+                       schedulable: Optional[List[Node]] = None) -> Node:
+        if schedulable is None:
+            schedulable = alive
         target = None
         for n in alive:
             if n.node_id.hex() == strategy.node_id:
                 target = n
                 break
         if target is not None and target.ledger.can_fit_total(spec.resources):
-            return target
+            # hard pins still land on a draining target (the user chose
+            # the node); soft affinity prefers somewhere that will live
+            if not (strategy.soft and getattr(target, "draining", False)):
+                return target
         if strategy.soft:
             return self._pick_hybrid(spec, [
-                n for n in alive if n.ledger.can_fit_total(spec.resources)
-            ] or alive, None)
+                n for n in schedulable
+                if n.ledger.can_fit_total(spec.resources)
+            ] or schedulable, None)
         raise SchedulingError(
             f"node {strategy.node_id[:8]} is dead or cannot fit "
             f"{spec.resources} (hard affinity)")
@@ -157,10 +181,19 @@ class ClusterScheduler:
         candidates = (pg.bundle_nodes() if idx == -1
                       else [pg.bundle_nodes()[idx]])
         node_by_id = {n.node_id: n for n in alive}
+        fallback = None
         for node_id in candidates:
             n = node_by_id.get(node_id)
             if n is not None and n.ledger.can_fit_total(spec.resources):
+                if getattr(n, "draining", False):
+                    # bundle pinned to a draining node: use it only when
+                    # no other bundle fits (the PG re-places on the
+                    # node's eventual death)
+                    fallback = fallback or n
+                    continue
                 return n
+        if fallback is not None:
+            return fallback
         raise SchedulingError(
             "no bundle in the placement group can fit the task")
 

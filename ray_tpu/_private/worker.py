@@ -231,7 +231,12 @@ class Runtime:
         self._shutdown = False
         self.stats = {"tasks_submitted": 0, "tasks_finished": 0,
                       "tasks_retried": 0, "objects_reconstructed": 0,
-                      "actor_restarts": 0}
+                      "actor_restarts": 0,
+                      # graceful-drain counters (surfaced on /metrics as
+                      # ray_tpu_drains_total etc. by prometheus_text)
+                      "drains_total": 0, "drain_objects_migrated": 0,
+                      "drain_actors_migrated": 0,
+                      "drain_escalations_total": 0}
         from ray_tpu._private.events import TaskEventBuffer
         self.task_events = TaskEventBuffer()
 
@@ -452,6 +457,13 @@ class Runtime:
     def remove_node(self, node: Node, _from_cluster: bool = False) -> None:
         """Simulate node failure: lose its objects, tasks, and actors.
         For daemon-backed nodes this hard-kills the daemon process."""
+        with self._nodes_lock:
+            present = self._nodes.pop(node.node_id, None) is not None
+        if not present:
+            # already removed — a clean drain completion and the head's
+            # death event (or deadline escalation) race here; the death
+            # flow must run exactly once
+            return
         handle = getattr(node, "daemon", None)
         if handle is not None and not _from_cluster:
             handle.sigkill()
@@ -461,8 +473,6 @@ class Runtime:
                         node.node_id.hex(), "removed")
                 except Exception:
                     pass
-        with self._nodes_lock:
-            self._nodes.pop(node.node_id, None)
         pending_by_actor = node.shutdown()
         self.gcs.mark_node_dead(node.node_id)
         # Objects on this node are lost.
@@ -491,6 +501,13 @@ class Runtime:
     def alive_nodes(self) -> List[Node]:
         return [n for n in self.nodes() if n.alive]
 
+    def schedulable_nodes(self) -> List[Node]:
+        """Alive nodes accepting NEW placements (draining excluded);
+        falls back to every alive node when all are draining."""
+        alive = self.alive_nodes()
+        return [n for n in alive
+                if not getattr(n, "draining", False)] or alive
+
     def get_node(self, node_id: NodeID) -> Optional[Node]:
         with self._nodes_lock:
             return self._nodes.get(node_id)
@@ -514,6 +531,258 @@ class Runtime:
             for k, v in n.ledger.available().items():
                 out[k] = out.get(k, 0.0) + v
         return out
+
+    # ------------------------------------------------------------------
+    # graceful node drain (preemption / downscale / maintenance)
+    # ------------------------------------------------------------------
+    def drain_node(self, node, deadline_s: Optional[float] = None,
+                   reason: str = "preemption") -> bool:
+        """Gracefully drain a node: no new placements land on it, its
+        queued tasks resubmit elsewhere, primary object replicas and
+        actors migrate off it proactively, and when its in-flight work
+        completes it leaves the cluster cleanly. If the deadline expires
+        first, the drain escalates into the ordinary node-death path
+        (lineage reconstruction covers anything unmigrated).
+
+        ``node`` is a Node, NodeID, or node-id hex string. Returns True
+        if a drain was started (False: unknown/dead/already draining).
+        """
+        if not isinstance(node, Node):
+            node_id = (NodeID.from_hex(node) if isinstance(node, str)
+                       else node)
+            node = self.get_node(node_id)
+            if node is None:
+                return False
+        if deadline_s is None:
+            from ray_tpu._private.config import cfg
+            deadline_s = cfg().drain_deadline_s
+        backend = self.cluster_backend
+        if backend is not None and getattr(node, "daemon", None) is not None:
+            # Publish through the head so the DRAINING membership state
+            # (and its deadline escalation) outlives this driver — and
+            # survives a head restart via the persisted drain record.
+            try:
+                backend.head.drain_node(node.node_id.hex(), deadline_s,
+                                        reason)
+            except Exception:
+                pass        # head unreachable: drain locally anyway
+        started = self.begin_node_drain(node, deadline_s, reason)
+        # the head's own node_drain event may have won the race to start
+        # the local migration — that still counts as "draining now"
+        return started or bool(getattr(node, "draining", False))
+
+    def begin_node_drain(self, node: Node, deadline_s: float,
+                         reason: str) -> bool:
+        """Idempotent driver-side entry (also fed by the head's
+        ``node_drain`` pubsub event): flips the node to DRAINING and
+        starts the migration worker."""
+        with self._nodes_lock:
+            if (not node.alive or getattr(node, "draining", False)
+                    or self._nodes.get(node.node_id) is not node):
+                return False
+            node.start_drain()
+        self.stats["drains_total"] += 1
+        threading.Thread(
+            target=self._drain_node_worker,
+            args=(node, deadline_s, reason), daemon=True,
+            name=f"drain-{node.node_id.hex()[:8]}").start()
+        return True
+
+    def _drain_node_worker(self, node: Node, deadline_s: float,
+                           reason: str) -> None:
+        deadline = time.monotonic() + max(0.0, deadline_s)
+        try:
+            self._migrate_node_objects(node)
+            self._migrate_node_actors(node, reason, deadline=deadline)
+        except Exception:
+            pass    # escalation still bounds the drain; lineage recovers
+        while time.monotonic() < deadline:
+            with node._running_lock:
+                busy = bool(node._running)
+            if not busy and node._backlog_n == 0 and node._queue.empty():
+                # Clean drain: sweep again — results stored (and actors
+                # created) WHILE draining live on this node too — then
+                # leave the cluster with zero reconstruction debt.
+                try:
+                    self._migrate_node_actors(node, reason,
+                                              deadline=deadline)
+                    self._migrate_node_objects(node)
+                except Exception:
+                    pass
+                self._finish_drain(node, reason)
+                return
+            time.sleep(0.05)
+        self._escalate_drain(node, reason)
+
+    def _migrate_node_objects(self, node: Node) -> int:
+        """Copy primary (sole-replica) objects off the draining node so
+        the eventual departure loses nothing (``objects_reconstructed``
+        stays 0 when migration wins the race against the deadline)."""
+        targets = [n for n in self.alive_nodes()
+                   if n.node_id != node.node_id
+                   and not getattr(n, "draining", False)]
+        if not targets:
+            return 0
+        from ray_tpu._private import failpoints as _fp
+        moved = 0
+        i = 0
+        for oid in node.store.object_ids():
+            with self._loc_lock:
+                locs = self._locations.get(oid, set())
+                if locs - {node.node_id}:
+                    continue        # a replica already lives elsewhere
+            target = targets[i % len(targets)]
+            i += 1
+            if _fp.ENABLED:
+                try:
+                    _fp.fire("drain.migrate_object", oid=oid.hex())
+                except Exception:
+                    continue    # this object stays; lineage covers it
+            try:
+                src_daemon = getattr(node, "daemon", None)
+                dst_daemon = getattr(target, "daemon", None)
+                if src_daemon is not None and dst_daemon is not None:
+                    # daemon→daemon transfer: bytes move directly over
+                    # the object plane (chunked/deduped PullManager),
+                    # never through the driver
+                    key, nbytes = node.store.meta_of(oid)
+                    if not dst_daemon.pull_object(
+                            key, from_addr=src_daemon.addr, priority=1):
+                        continue
+                    target.store.register_remote(oid, key, nbytes)
+                else:
+                    value = node.store.get(oid)
+                    target.store.put(oid, value,
+                                     nbytes=_nbytes_of(value))
+            except Exception:
+                continue
+            with self._loc_lock:
+                self._locations.setdefault(oid, set()).add(
+                    target.node_id)
+            moved += 1
+        if moved:
+            self.stats["drain_objects_migrated"] += moved
+        return moved
+
+    def _migrate_node_actors(self, node: Node, reason: str,
+                             deadline: Optional[float] = None) -> int:
+        """Restart the draining node's actors on surviving nodes via the
+        existing restart machinery — graceful, so pending tasks replay
+        on the new incarnation instead of failing, and the planned move
+        does not consume the actors' max_restarts budget."""
+        from ray_tpu._private.task_spec import (
+            NodeAffinitySchedulingStrategy)
+        with node._actors_lock:
+            actors = dict(node.actors)
+        migrate: Dict[ActorID, ActorExecutor] = {}
+        for actor_id, executor in actors.items():
+            info = self.gcs.get_actor_info(actor_id)
+            strat = getattr(getattr(info, "creation_spec", None),
+                            "scheduling_strategy", None)
+            if (isinstance(strat, NodeAffinitySchedulingStrategy)
+                    and not strat.soft
+                    and strat.node_id == node.node_id.hex()):
+                # hard-pinned HERE: it cannot live anywhere else —
+                # leave it to finish work until the node departs
+                continue
+            migrate[actor_id] = executor
+        with node._actors_lock:
+            for actor_id in migrate:
+                node.actors.pop(actor_id, None)
+        moved = 0
+        cause = f"node draining ({reason})"
+        for actor_id, executor in migrate.items():
+            pending = executor.kill(cause)
+            # Let an IN-FLIGHT method finish before the actor's worker
+            # process is recycled: kill() stops dispatch, so the
+            # executor threads exit right after the current call — a
+            # planned move should not crash a running call. Bounded by
+            # the drain deadline (a stuck call escalates instead).
+            for t in executor._threads:
+                budget = 1.0
+                if deadline is not None:
+                    budget = min(budget, max(
+                        0.0, deadline - time.monotonic()))
+                t.join(timeout=budget)
+            try:
+                self._handle_actor_death(actor_id, cause,
+                                         pending_tasks=pending,
+                                         may_restart=True, graceful=True)
+                moved += 1
+            except Exception:
+                continue
+        if moved:
+            self.stats["drain_actors_migrated"] += moved
+        return moved
+
+    def _finish_drain(self, node: Node, reason: str) -> None:
+        """Clean completion: the node leaves via the normal removal flow,
+        but with its objects replicated and actors already elsewhere."""
+        if self.get_node(node.node_id) is None:
+            return      # a death event won the race
+        backend = self.cluster_backend
+        handle = getattr(node, "daemon", None)
+        if backend is not None and handle is not None:
+            try:
+                backend.head.mark_node_dead(node.node_id.hex(),
+                                            f"drained ({reason})")
+            except Exception:
+                pass
+            try:
+                handle.stop()
+            except Exception:
+                pass
+        try:
+            self.remove_node(node, _from_cluster=True)
+        except Exception:
+            pass
+
+    def count_drain_escalation(self, node: Node) -> None:
+        """Exactly-once escalation accounting: the driver's own deadline
+        timer and the head's death event race to escalate the same
+        drain — whichever wins counts, the loser is a no-op."""
+        with self._nodes_lock:
+            if getattr(node, "_drain_escalated", False):
+                return
+            node._drain_escalated = True
+        self.stats["drain_escalations_total"] += 1
+
+    def _escalate_drain(self, node: Node, reason: str) -> None:
+        """Deadline expired with work still on the node: fall back to
+        the ordinary node-death path (hard kill; retries + lineage
+        reconstruction recover whatever did not migrate in time)."""
+        if self.get_node(node.node_id) is None:
+            return      # drained cleanly / head escalated first
+        self.count_drain_escalation(node)
+        from ray_tpu._private import failpoints as _fp
+        if _fp.ENABLED:
+            try:
+                # delay arm stretches the escalation window; an error
+                # arm must NOT suppress the escalation (the node would
+                # linger draining forever)
+                _fp.fire("drain.deadline", node=node.node_id.hex())
+            except Exception:
+                pass
+        try:
+            self.remove_node(node)
+        except Exception:
+            pass
+
+    def on_node_task_drained(self, spec: TaskSpec, node: Node) -> None:
+        """A queued-but-unstarted task handed back by a draining node:
+        reschedule it elsewhere WITHOUT consuming a retry (planned
+        departure, not a failure)."""
+        with self._tasks_lock:
+            inflight = self._tasks.get(spec.task_id)
+        if inflight is None:
+            return
+        with inflight.lock:
+            if inflight.cancelled:
+                return
+        # one bounce only: if the scheduler sends it back (nothing else
+        # fits), the draining node's dispatch loop runs it locally
+        spec._drain_bounced = True
+        self._schedule(spec, inflight)
 
     # ------------------------------------------------------------------
     # objects
@@ -717,10 +986,22 @@ class Runtime:
             node = self.scheduler.pick_node(spec, self.nodes(),
                                             preferred=self._locality_node(spec))
         except SchedulingError as e:
-            self._fail_task(spec, exc.TaskError(e, spec.name))
+            self._fail_unschedulable(spec, exc.TaskError(e, spec.name))
             return
         inflight.node_id = node.node_id
         node.enqueue(spec)
+
+    def _fail_unschedulable(self, spec: TaskSpec,
+                            error: exc.TaskError) -> None:
+        """An infeasible placement must fail the ACTOR too, not just the
+        creation task: plain _fail_task left the actor RESTARTING
+        forever with its method calls buffering (reachable whenever a
+        restart's target — e.g. a hard-affinity node — left the
+        cluster)."""
+        if spec.kind == TaskKind.ACTOR_CREATION:
+            self._actor_creation_failed(spec, error)
+        else:
+            self._fail_task(spec, error)
 
     def _schedule_into_pg(self, spec: TaskSpec,
                           inflight: _InFlightTask) -> None:
@@ -743,13 +1024,13 @@ class Runtime:
             threading.Thread(target=wait_then_schedule, daemon=True).start()
             return
         if pg.state != "CREATED":
-            self._fail_task(spec, exc.TaskError(
+            self._fail_unschedulable(spec, exc.TaskError(
                 exc.PlacementGroupUnschedulableError(
                     f"placement group is {pg.state}"), spec.name))
             return
         idx = strat.placement_group_bundle_index
         if idx != -1 and not (0 <= idx < len(pg.bundles)):
-            self._fail_task(spec, exc.TaskError(
+            self._fail_unschedulable(spec, exc.TaskError(
                 ValueError(
                     f"placement_group_bundle_index={idx} out of range for "
                     f"{len(pg.bundles)} bundles"), spec.name))
@@ -760,6 +1041,15 @@ class Runtime:
             spec.pg_demand = dict(spec.resources)
         demand = spec.pg_demand
         candidates = (pg.bundles if idx == -1 else [pg.bundles[idx]])
+        # Prefer bundles on non-draining hosts: a bundle pinned to a
+        # draining node is a last resort (the PG re-places when the
+        # node finally leaves).
+        if idx == -1 and len(candidates) > 1:
+            def _bundle_draining(b) -> int:
+                n = self.get_node(b.node_id) if b.node_id else None
+                return 1 if (n is not None
+                             and getattr(n, "draining", False)) else 0
+            candidates = sorted(candidates, key=_bundle_draining)
         chosen = None
         for bundle in candidates:
             if all(bundle.resources.get(k, 0.0) >= v - 1e-9
@@ -777,7 +1067,7 @@ class Runtime:
                                for k, v in scoped.items()):
                             break
         if chosen is None:
-            self._fail_task(spec, exc.TaskError(
+            self._fail_unschedulable(spec, exc.TaskError(
                 SchedulingError(
                     f"demand {demand} does not fit any bundle of "
                     f"the placement group"), spec.name))
@@ -1521,6 +1811,13 @@ class Runtime:
                             cause="exit_actor() called")
             return
         except BaseException as e:  # noqa: BLE001
+            if (isinstance(e, exc.ActorDiedError)
+                    and getattr(node, "draining", False)
+                    and self._resubmit_drained_actor_task(spec)):
+                # the drain's worker recycle caught this call mid-flight:
+                # a planned migration replays it on the new incarnation
+                # instead of failing it
+                return
             self._finish_task(spec, node, error=exc.ActorError(
                 e, spec.name, spec.actor_id))
             return
@@ -1531,6 +1828,23 @@ class Runtime:
             self._drain_generator(spec, node, result)
             return
         self._finish_task(spec, node, result=result)
+
+    def _resubmit_drained_actor_task(self, spec: TaskSpec) -> bool:
+        """Replay an actor task whose worker was recycled by a graceful
+        drain. Only while the actor is still restartable — a genuinely
+        DEAD actor keeps the normal failure surface."""
+        info = self.gcs.get_actor_info(spec.actor_id)
+        if info is None or info.state == ActorState.DEAD:
+            return False
+        with self._tasks_lock:
+            inflight = self._tasks.get(spec.task_id)
+        if inflight is None:
+            return False
+        with inflight.lock:
+            if inflight.cancelled:
+                return False
+        self._submit_actor_task(spec, inflight, spec.dependencies())
+        return True
 
     async def _execute_actor_task_async(self, spec: TaskSpec, instance: Any,
                                         node: Node) -> None:
@@ -1604,7 +1918,12 @@ class Runtime:
 
     def _handle_actor_death(self, actor_id: ActorID, cause: str,
                             pending_tasks: List[TaskSpec],
-                            may_restart: bool) -> None:
+                            may_restart: bool,
+                            graceful: bool = False) -> None:
+        """``graceful=True`` is the planned-migration variant (node
+        drain): the restart neither consumes the actor's max_restarts
+        budget nor fails its pending tasks — they replay on the new
+        incarnation regardless of max_task_retries."""
         self.process_router.discard_actor(actor_id)
         # Actor-lifetime borrows die with the incarnation (a restart
         # rebuilds state from creation args; the old in-worker refs are
@@ -1628,13 +1947,14 @@ class Runtime:
                 host.ledger.release(info.creation_spec.resources)
             info.node_id = None
         can_restart = (may_restart and info.creation_spec is not None
-                       and (info.max_restarts == -1
+                       and (graceful or info.max_restarts == -1
                             or info.num_restarts < info.max_restarts))
         if can_restart:
             self.stats["actor_restarts"] += 1
-            info.num_restarts += 1
+            if not graceful:    # planned moves don't burn the budget
+                info.num_restarts += 1
             self.gcs.update_actor_state(actor_id, ActorState.RESTARTING)
-            if info.max_task_retries != 0:
+            if graceful or info.max_task_retries != 0:
                 # Pending tasks survive the restart and replay on the new
                 # incarnation (reference: actor_task_submitter.cc resubmit
                 # queue on ConnectActor).

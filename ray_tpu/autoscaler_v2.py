@@ -271,14 +271,17 @@ class Reconciler:
     the GCS view, then close the gap between desired and actual."""
 
     def __init__(self, runtime, provider: CloudProvider, *,
-                 max_instances: int = 16, idle_timeout_s: float = 5.0):
+                 max_instances: int = 16, idle_timeout_s: float = 5.0,
+                 drain_deadline_s: float = 5.0):
         self.runtime = runtime
         self.provider = provider
         self.instance_manager = InstanceManager()
         self.max_instances = max_instances
         self.idle_timeout_s = idle_timeout_s
+        self.drain_deadline_s = drain_deadline_s
         self._idle_since: Dict[str, float] = {}
-        self.stats = {"reconciles": 0, "launched": 0, "terminated": 0}
+        self.stats = {"reconciles": 0, "launched": 0, "terminated": 0,
+                      "drained": 0}
 
     # -- helpers ----------------------------------------------------------
     def _pick_node_type(self, unmet: Dict[str, float],
@@ -372,8 +375,22 @@ class Reconciler:
                 else:
                     self._idle_since.pop(inst.instance_id, None)
 
-        # 6. RAY_STOPPING -> TERMINATED
+        # 6. RAY_STOPPING: graceful drain first, then TERMINATED. The
+        # drain migrates any leftover primary object replicas off the
+        # idle node BEFORE it disappears — a downscale must never pay
+        # lineage reconstruction for data that was sitting on a node we
+        # chose to remove. The drain's own deadline escalation bounds
+        # how long an instance can linger here.
         for inst in im.list(InstanceStatus.RAY_STOPPING):
+            node = inst.node
+            still_in = (node is not None
+                        and self.runtime.get_node(node.node_id)
+                        is not None)
+            if still_in and node.alive:
+                if self.runtime.begin_node_drain(
+                        node, self.drain_deadline_s, "idle downscale"):
+                    self.stats["drained"] += 1
+                continue        # re-check next pass: drain in flight
             inst.transition(InstanceStatus.TERMINATING)
             try:
                 self.provider.terminate(inst.cloud_instance_id)

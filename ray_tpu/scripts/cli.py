@@ -232,6 +232,25 @@ def cmd_cluster_status(args) -> int:
     return 0
 
 
+def cmd_drain(args) -> int:
+    """Gracefully drain a node of a running cluster (no runtime init):
+    the head fences new placements, connected drivers migrate work off,
+    and the deadline escalates to the death path."""
+    address = _resolve_address(args)
+    host, port = address.rsplit(":", 1)
+    from ray_tpu._private.head import HeadClient
+
+    head = HeadClient((host, int(port)))
+    try:
+        out = head.drain_node(args.node_id, args.deadline_s, args.reason)
+    finally:
+        head.close()
+    out.pop("i", None)      # rpc correlation id, not user-facing
+    print(json.dumps({"address": address, "node_id": args.node_id,
+                      **out}, indent=2, default=str))
+    return 0 if out.get("ok") else 1
+
+
 def cmd_serve_deploy(args) -> int:
     """Deploy Serve applications from a YAML/JSON config (the
     `serve deploy` role)."""
@@ -382,6 +401,13 @@ def main(argv=None) -> int:
     p.add_argument("--address", default="")
     p = sub.add_parser("cluster-status")
     p.add_argument("--address", default="")
+    p = sub.add_parser("drain")
+    p.add_argument("node_id", help="node id (hex) to drain gracefully")
+    p.add_argument("--address", default="")
+    p.add_argument("--deadline-s", type=float, default=30.0,
+                   dest="deadline_s",
+                   help="drain window before escalating to node death")
+    p.add_argument("--reason", default="manual drain")
     sub.add_parser("status")
     sub.add_parser("summary")
     sub.add_parser("memory")
@@ -420,7 +446,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     handler = {
         "start": cmd_start, "stop": cmd_stop,
-        "cluster-status": cmd_cluster_status,
+        "cluster-status": cmd_cluster_status, "drain": cmd_drain,
         "status": cmd_status, "summary": cmd_summary,
         "memory": cmd_memory, "timeline": cmd_timeline,
         "microbenchmark": cmd_microbenchmark, "dashboard": cmd_dashboard,

@@ -304,7 +304,10 @@ class PlacementGroupManager:
                     pg._ready_event.set()
 
     def _try_place(self, pg: PlacementGroup) -> bool:
-        nodes = self._rt.alive_nodes()
+        # Draining nodes accept no new bundles (their capacity is on the
+        # way out); schedulable_nodes falls back to them only when
+        # nothing else is alive.
+        nodes = self._rt.schedulable_nodes()
         if not nodes:
             return False
         assignment = self._assign(pg, nodes)

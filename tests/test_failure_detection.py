@@ -213,3 +213,173 @@ def test_serve_grpc_streaming(ray_start_regular):
         client.close()
         stop_grpc_proxy()
         serve.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain: head membership state machine + HeadClient fixes
+# ---------------------------------------------------------------------------
+
+class _FakeConn:
+    """Just enough Connection for direct HeadService handler calls."""
+
+    def __init__(self):
+        self.meta = {}
+        self.replies = []
+
+    def reply(self, rid, **kw):
+        self.replies.append((rid, kw))
+
+
+def _register(svc, node_id="n1", port=7001):
+    return svc.handle_register_node(_FakeConn(), 1, {
+        "node_id": node_id, "resources": {"CPU": 4.0}, "labels": {},
+        "addr": ["127.0.0.1", port]})
+
+
+def test_head_drain_state_and_deadline_escalation(tmp_path):
+    """drain_node moves the node to alive+DRAINING (publishing
+    node_drain, NOT node_death); the health loop escalates into the
+    death path once the deadline expires."""
+    from ray_tpu._private.head import HeadService
+
+    svc = HeadService()
+    try:
+        assert _register(svc)["ok"]
+        out = svc.handle_drain_node(_FakeConn(), 2, {
+            "node_id": "n1", "deadline_s": 0.2, "reason": "preempt"})
+        assert out["ok"]
+        view = svc.handle_list_nodes(_FakeConn(), 3, {})["nodes"][0]
+        assert view["alive"] and view["draining"]
+        assert view["drain_reason"] == "preempt"
+        events = svc._events.get("node", [])
+        assert any(e.get("kind") == "drain" for e in events)
+        assert not any(e.get("kind") == "death" for e in events)
+        # deadline passes -> the monitor escalates to death
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            view = svc.handle_list_nodes(_FakeConn(), 4, {})["nodes"][0]
+            if not view["alive"]:
+                break
+            time.sleep(0.05)
+        assert not view["alive"]
+        assert view["reason"] == "drain deadline expired"
+        assert any(e.get("kind") == "death" and e.get("was_draining")
+                   for e in svc._events["node"])
+    finally:
+        svc._stop.set()
+
+
+def test_head_drain_survives_restart(tmp_path):
+    """With --state-path, a drain outlives a head restart: when the
+    draining daemon re-registers at the fresh head, the DRAINING state
+    (and its remaining deadline) re-attaches and is re-announced."""
+    from ray_tpu._private.head import HeadService
+
+    state = str(tmp_path / "head_state.db")
+    svc = HeadService(state_path=state)
+    try:
+        _register(svc)
+        svc.handle_drain_node(_FakeConn(), 2, {
+            "node_id": "n1", "deadline_s": 60.0, "reason": "maint"})
+    finally:
+        svc._stop.set()
+    svc._store._db.close()
+
+    svc2 = HeadService(state_path=state)    # the respawned head
+    try:
+        # membership is not persisted (daemons re-register themselves)
+        assert svc2.handle_list_nodes(_FakeConn(), 1, {})["nodes"] == []
+        out = _register(svc2)
+        assert out["ok"] and out["draining"]
+        view = svc2.handle_list_nodes(_FakeConn(), 2, {})["nodes"][0]
+        assert view["draining"] and view["drain_reason"] == "maint"
+        assert 0 < view["drain_deadline_s"] <= 60.0
+        # the drain event is re-announced for (re)subscribed drivers
+        assert any(e.get("kind") == "drain"
+                   for e in svc2._events.get("node", []))
+    finally:
+        svc2._stop.set()
+
+
+def test_head_rejects_zombie_reregistration():
+    """A node_id we declared dead may not re-register with stale state —
+    the register reply mirrors the heartbeat {"dead": True} contract."""
+    from ray_tpu._private.head import HeadService
+
+    svc = HeadService()
+    try:
+        _register(svc)
+        svc._mark_dead("n1", "missed heartbeats")
+        out = _register(svc)
+        assert out.get("dead") and not out.get("ok")
+        view = svc.handle_list_nodes(_FakeConn(), 9, {})["nodes"][0]
+        assert not view["alive"]
+        # a FRESH node id still registers fine
+        assert _register(svc, node_id="n2", port=7002)["ok"]
+    finally:
+        svc._stop.set()
+
+
+def test_head_client_publish_survives_head_restart():
+    """HeadClient.publish rides the reconnect/retry path: with a
+    reconnect window it survives the head process being replaced
+    (the old direct client.call failed mid-restart)."""
+    import threading
+
+    from ray_tpu._private import rpc
+    from ray_tpu._private.head import HeadClient, HeadService
+
+    svc = HeadService()
+    server = rpc.Server(svc, host="127.0.0.1", port=0).start()
+    port = server.addr[1]
+    client = HeadClient(("127.0.0.1", port), reconnect_window=10.0)
+    try:
+        client.publish("chan", {"n": 1})
+        server.stop()
+        svc._stop.set()
+
+        svc2 = HeadService()
+        holder = {}
+
+        def restart():
+            time.sleep(0.4)
+            holder["server"] = rpc.Server(
+                svc2, host="127.0.0.1", port=port).start()
+
+        t = threading.Thread(target=restart, daemon=True)
+        t.start()
+        client.publish("chan", {"n": 2})     # rides the redial window
+        t.join()
+        assert svc2._events["chan"] == [{"n": 2}]
+    finally:
+        client.close()
+        svc2._stop.set()
+        holder["server"].stop()
+
+
+def test_head_client_close_joins_subscriber_threads():
+    """close() closes the per-channel subscriber connections and joins
+    the threads (no leaked sockets / parked long-polls)."""
+    from ray_tpu._private import rpc
+    from ray_tpu._private.head import HeadClient, HeadService
+
+    svc = HeadService()
+    server = rpc.Server(svc, host="127.0.0.1", port=0).start()
+    client = HeadClient(server.addr, reconnect_window=5.0)
+    try:
+        seen = []
+        client.subscribe("events", seen.append)
+        client.publish("events", {"x": 1})
+        deadline = time.monotonic() + 5
+        while not seen and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert seen == [{"x": 1}]
+        client.close()
+        for t in client._sub_threads:
+            t.join(timeout=3.0)
+            assert not t.is_alive(), "subscriber thread leaked"
+        assert all(c.dead for c in client._sub_clients) \
+            or not client._sub_clients
+    finally:
+        svc._stop.set()
+        server.stop()

@@ -159,3 +159,237 @@ def test_retries_exhausted_gives_error(ray_start_regular):
     rt.remove_node(node)
     with pytest.raises(exc.ObjectLostError):
         ray_tpu.get(ref, timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# Graceful drain (preemption-aware planned node departure)
+# ---------------------------------------------------------------------------
+
+def _wait_node_gone(rt, node_id, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if rt.get_node(node_id) is None:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_drain_migrates_objects_without_reconstruction(ray_start_cluster):
+    """A clean drain copies the node's primary object replicas off it
+    BEFORE departure: the value survives with objects_reconstructed
+    still 0 (a hard node kill would have paid a lineage re-execution)."""
+    rt = ray_start_cluster
+
+    @ray_tpu.remote(max_retries=3)
+    def big():
+        import numpy as np
+        return np.ones((1000, 1000))  # 8MB -> node store, not inline
+
+    ref = big.remote()
+    ray_tpu.get(ref)
+    victim = _node_of(rt, ref)
+    assert victim is not None
+    assert ray_tpu.drain_node(victim.node_id.hex(), deadline_s=15,
+                              reason="unit test")
+    assert victim.draining
+    assert _wait_node_gone(rt, victim.node_id)
+    assert ray_tpu.get(ref, timeout=10).shape == (1000, 1000)
+    assert rt.stats["objects_reconstructed"] == 0
+    assert rt.stats["drain_objects_migrated"] >= 1
+    assert rt.stats["drains_total"] == 1
+    assert rt.stats["drain_escalations_total"] == 0
+
+
+def test_drain_restarts_actors_elsewhere_pending_replayed(
+        ray_start_cluster):
+    """A drained node's actors come back ALIVE on surviving nodes with
+    their pending tasks REPLAYED (not failed) — even with
+    max_restarts=0 / max_task_retries=0, because a planned migration is
+    not a failure and must not consume fault budgets."""
+    rt = ray_start_cluster
+
+    @ray_tpu.remote(max_restarts=0, max_task_retries=0)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = Counter.remote()
+    assert ray_tpu.get(a.inc.remote()) == 1
+    victim_hex = ray_tpu.get(a.node.remote())
+    victim = next(n for n in rt.nodes() if n.node_id.hex() == victim_hex)
+    pending = [a.inc.remote() for _ in range(3)]
+    assert ray_tpu.drain_node(victim_hex, deadline_s=15, reason="drill")
+    # pending tasks complete (replayed on whichever incarnation runs
+    # them) instead of failing with ActorDiedError
+    assert ray_tpu.get(pending, timeout=30)
+    assert _wait_node_gone(rt, victim.node_id)
+    # the actor is ALIVE on a surviving node
+    from ray_tpu._private.gcs import ActorState
+    info = rt.gcs.get_actor_info(a._ray_actor_id)
+    assert info.state == ActorState.ALIVE
+    assert info.node_id != victim.node_id
+    assert ray_tpu.get(a.node.remote(), timeout=10) != victim_hex
+    # planned move: the restart budget is untouched
+    assert info.num_restarts == 0
+    assert rt.stats["drain_actors_migrated"] >= 1
+
+
+def test_drain_resubmits_queued_tasks_without_retry(ray_start_cluster):
+    """Queued-but-unstarted tasks on the draining node reschedule onto
+    other nodes without consuming a retry (max_retries=0 still
+    completes)."""
+    rt = ray_start_cluster
+
+    @ray_tpu.remote(num_cpus=4, max_retries=0)
+    def task(i):
+        time.sleep(0.4)
+        return i
+
+    # pin a deep backlog onto one node: each task takes the whole node
+    victim = rt.alive_nodes()[0]
+    strat = ray_tpu.NodeAffinitySchedulingStrategy(
+        victim.node_id.hex(), soft=True)
+    refs = [task.options(scheduling_strategy=strat).remote(i)
+            for i in range(6)]
+    time.sleep(0.2)     # let the first task start + backlog build
+    assert ray_tpu.drain_node(victim.node_id.hex(), deadline_s=20,
+                              reason="downscale")
+    assert sorted(ray_tpu.get(refs, timeout=30)) == list(range(6))
+    assert rt.stats["tasks_retried"] == 0
+    assert _wait_node_gone(rt, victim.node_id)
+
+
+def test_drain_deadline_escalates_to_node_death(ray_start_cluster):
+    """A drain whose deadline expires with work still running escalates
+    into the ordinary node-death path: the task retries elsewhere via
+    the existing machinery and the escalation is counted."""
+    rt = ray_start_cluster
+
+    @ray_tpu.remote(max_retries=3)
+    def slow():
+        time.sleep(2.0)
+        return "done"
+
+    ref = slow.remote()
+    time.sleep(0.3)
+    with rt._tasks_lock:
+        inflight = [t for t in rt._tasks.values()
+                    if t.spec.name.endswith("slow")]
+    assert inflight
+    victim = rt.get_node(inflight[0].node_id)
+    assert ray_tpu.drain_node(victim.node_id.hex(), deadline_s=0.3,
+                              reason="spot reclaim")
+    assert ray_tpu.get(ref, timeout=30) == "done"
+    assert rt.stats["drain_escalations_total"] >= 1
+    assert rt.stats["tasks_retried"] >= 1
+    assert _wait_node_gone(rt, victim.node_id)
+
+
+def test_drain_under_combined_load_integration(ray_start_cluster):
+    """The acceptance scenario: a node under active task+actor load is
+    drained — in-flight work completes or resubmits, its actors come
+    back ALIVE elsewhere with pending tasks replayed, objects migrate
+    (ray_tpu_drain_objects_migrated > 0) and objects_reconstructed
+    stays 0 on the clean-drain path."""
+    rt = ray_start_cluster
+
+    @ray_tpu.remote(max_retries=3)
+    def produce(i):
+        import numpy as np
+        return np.full((600, 600), i)
+
+    @ray_tpu.remote(max_retries=3)
+    def chew(x):
+        time.sleep(0.2)
+        return float(x[0][0])
+
+    @ray_tpu.remote(max_restarts=0, max_task_retries=0)
+    class Stateful:
+        def __init__(self):
+            self.seen = 0
+
+        def hit(self):
+            self.seen += 1
+            time.sleep(0.05)
+            return self.seen
+
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    blobs = [produce.remote(i) for i in range(8)]
+    ray_tpu.get(blobs)
+    actors = [Stateful.remote() for _ in range(4)]
+    for a in actors:
+        ray_tpu.get(a.hit.remote())
+    victim = _node_of(rt, blobs[0]) or rt.alive_nodes()[0]
+    victim_hex = victim.node_id.hex()
+    on_victim = [a for a in actors
+                 if ray_tpu.get(a.node.remote()) == victim_hex]
+
+    downstream = [chew.remote(b) for b in blobs]
+    actor_pending = [a.hit.remote() for a in actors for _ in range(2)]
+    assert ray_tpu.drain_node(victim_hex, deadline_s=20,
+                              reason="preemption notice")
+    # every in-flight piece of work completes or resubmits
+    assert ray_tpu.get(downstream, timeout=40) == list(range(8))
+    assert all(v >= 1 for v in ray_tpu.get(actor_pending, timeout=40))
+    assert _wait_node_gone(rt, victim.node_id, timeout=30)
+    # actors that lived on the victim are ALIVE on surviving nodes
+    from ray_tpu._private.gcs import ActorState
+    for a in on_victim:
+        info = rt.gcs.get_actor_info(a._ray_actor_id)
+        assert info.state == ActorState.ALIVE
+        assert ray_tpu.get(a.node.remote(), timeout=10) != victim_hex
+    # the blobs survived the departure without lineage re-execution
+    assert ray_tpu.get(blobs, timeout=20)[3][0][0] == 3
+    assert rt.stats["objects_reconstructed"] == 0
+    assert rt.stats["drain_objects_migrated"] > 0
+    assert rt.stats["drain_escalations_total"] == 0
+
+
+def test_drain_excluded_from_new_placements(ray_start_cluster):
+    """While DRAINING, the scheduler routes new tasks to other nodes."""
+    rt = ray_start_cluster
+    victim = rt.alive_nodes()[0]
+    victim.draining = True      # flag only: no migration machinery
+    try:
+        @ray_tpu.remote
+        def where():
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        spots = ray_tpu.get([where.remote() for _ in range(12)],
+                            timeout=20)
+        assert victim.node_id.hex() not in spots
+    finally:
+        victim.draining = False
+
+
+def test_drain_label_selector_never_widens(ray_start_regular):
+    """A hard label selector is honored even when its only match is
+    draining: the task runs on the draining matching node rather than
+    leaking onto a non-matching one (or failing outright)."""
+    rt = ray_start_regular
+    labeled = rt.add_node({"CPU": 2}, labels={"accel": "tpu"})
+    labeled.draining = True     # flag only: no migration machinery
+    try:
+        from ray_tpu.util.scheduling_strategies import (
+            NodeLabelSchedulingStrategy)
+
+        @ray_tpu.remote(
+            scheduling_strategy=NodeLabelSchedulingStrategy(
+                hard={"accel": "tpu"}))
+        def where():
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        assert ray_tpu.get(where.remote(),
+                           timeout=15) == labeled.node_id.hex()
+    finally:
+        labeled.draining = False
+        rt.remove_node(labeled)

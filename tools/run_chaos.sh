@@ -9,6 +9,10 @@
 # regardless of the env var, the topology-agnostic tests (the rpc-drop
 # replays, stream kill) run under whichever topology the env selects.
 #
+# Drain-under-chaos rides this sweep: test_chaos_drain_* races a
+# graceful node drain against failpoint-injected migration faults,
+# worker crashes, and deadline escalation, across both topologies.
+#
 # Usage: tools/run_chaos.sh [extra pytest args...]
 set -euo pipefail
 cd "$(dirname "$0")/.."
