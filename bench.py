@@ -201,6 +201,40 @@ def _run_train(error: str | None) -> dict:
     return out
 
 
+def _control_plane_probe(duration_s: float = 1.5) -> float:
+    """Quick control-plane throughput sample (tasks/s through the full
+    submit→schedule→execute→get loop) so every BENCH_*.json tracks the
+    task-dispatch envelope alongside tokens/s. Bounded and best-effort:
+    a failure must never cost the benchmark its tokens/s line."""
+    own = False
+    try:
+        import ray_tpu
+
+        own = not ray_tpu.is_initialized()
+        if own:
+            ray_tpu.init(num_nodes=1, resources={"CPU": 4})
+
+        @ray_tpu.remote
+        def _noop():
+            return None
+
+        ray_tpu.get([_noop.remote() for _ in range(50)])    # warm
+        t0 = time.perf_counter()
+        count = 0
+        while time.perf_counter() - t0 < duration_s:
+            ray_tpu.get([_noop.remote() for _ in range(100)])
+            count += 100
+        return round(count / (time.perf_counter() - t0), 1)
+    except Exception:
+        return 0.0
+    finally:
+        if own:     # never leak the probe's own cluster on a failure
+            try:
+                ray_tpu.shutdown()
+            except Exception:
+                pass
+
+
 def _child() -> int:
     """Run the actual benchmark and print its JSON line."""
     if os.environ.get("BENCH_FORCE_CPU") == "1":
@@ -218,6 +252,9 @@ def _child() -> int:
         result = run_serving_bench(error=error)
     else:
         result = _run_train(error)
+    if os.environ.get("BENCH_CONTROL_PLANE", "1") != "0":
+        result["control_plane"] = {
+            "tasks_per_second": _control_plane_probe()}
     print(json.dumps(result))
     return 0
 

@@ -29,6 +29,7 @@ import subprocess
 import sys
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -131,6 +132,229 @@ class _Stream:
 _STREAM_DEAD = object()
 
 
+class _SubmitCoalescer:
+    """Per-destination driver→daemon submit batching.
+
+    Classic-path task submissions (everything that used one
+    ``submit_task`` RPC per task) enqueue here; ONE flusher thread per
+    daemon drains the queue into ``push_task_batch`` wire frames —
+    up to ``submit_batch_max`` tasks per frame, lingering
+    ``submit_linger_us`` for stragglers (reference: the batched lease
+    requests / coalesced submissions that let Ray survive high task
+    rates). Completions come back coalesced on ``task_batch_done``
+    push frames, demuxed by :meth:`DaemonHandle._on_push`.
+
+    Retry contract: a flush that fails BEFORE reaching the daemon
+    (``batch.submit_flush`` drop/error arms — the deterministic stand-in
+    for a lost frame) resends the same batch; the daemon dedupes by task
+    id, so a retried frame never double-executes a task.
+    """
+
+    _MAX_SEND_ATTEMPTS = 8
+
+    def __init__(self, handle: "DaemonHandle"):
+        from ray_tpu._private.config import cfg
+        self.handle = handle
+        self.batch_max = max(1, int(cfg().submit_batch_max))
+        self.linger_s = max(0.0, float(cfg().submit_linger_us) / 1e6)
+        self._cv = threading.Condition()
+        self._q: deque = deque()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"submit-batch-{handle.node_id.hex()[:8]}")
+        self._thread.start()
+
+    def enqueue(self, entry: Dict[str, Any]) -> None:
+        with self._cv:
+            if self._stopped:
+                raise DaemonCrashed("daemon handle closed")
+            self._q.append(entry)
+            # wake the flusher only out of its IDLE wait (first entry)
+            # or for a full batch: waking it out of the timed linger on
+            # every append would flush 2-element frames and defeat the
+            # coalescing the linger exists for
+            if len(self._q) == 1 or len(self._q) >= self.batch_max:
+                self._cv.notify()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._stopped:
+                    self._cv.wait()
+                if self._stopped:
+                    return      # waiters are failed by mark_dead
+                if (self.linger_s > 0 and len(self._q) < self.batch_max):
+                    # one bounded linger for the rest of a burst; a
+                    # second wait would add latency, not batching
+                    self._cv.wait(self.linger_s)
+                n = min(len(self._q), self.batch_max)
+                batch = [self._q.popleft() for _ in range(n)]
+            if batch:
+                self._flush(batch)
+
+    def _flush(self, batch: List[Dict[str, Any]]) -> None:
+        handle = self.handle
+        # ship each function blob once per (daemon, fid): repeated
+        # submissions of the same remote function send only the fid
+        # (reference: Ray exports function definitions to GCS once)
+        fns: Dict[str, bytes] = {}
+        for entry in batch:
+            fid = entry["fid"]
+            if fid not in handle._fns_shipped and fid not in fns:
+                try:
+                    from ray_tpu._private.worker_process import \
+                        fetch_function_blob
+                    fns[fid] = fetch_function_blob(fid)
+                except KeyError:
+                    pass    # workers fall back to the fetch core op
+        for attempt in range(self._MAX_SEND_ATTEMPTS):
+            if handle.dead:
+                return      # mark_dead already failed the waiters
+            if _fp.ENABLED:
+                try:
+                    act = _fp.fire("batch.submit_flush", n=len(batch),
+                                   attempt=attempt)
+                except Exception:   # noqa: BLE001 — injected error arm:
+                    # the flush attempt "failed in transit"; retry the
+                    # same batch (idempotent at the daemon)
+                    continue
+                if act is _fp.DROP:
+                    continue        # frame lost pre-send; retry
+            try:
+                handle.client.call("push_task_batch", tasks=batch,
+                                   fns=fns, timeout=None)
+            except rpc.RemoteError as e:
+                if "no such method" in str(e):
+                    # old daemon without the batch handler: fall back
+                    # per-task, permanently for this handle
+                    handle._batch_supported = False
+                    self._flush_per_task(batch)
+                    return
+                for entry in batch:
+                    handle._complete_batch_task(
+                        {"task": entry["task"], "e": str(e)})
+                return
+            except rpc.RpcError:
+                handle.mark_dead()      # transport death: node failure
+                return
+            if fns:
+                handle._fns_shipped.update(fns)
+            return
+        # retries exhausted (persistent injected failure): surface as a
+        # daemon-level failure so task retry accounting engages
+        handle.mark_dead()
+
+    def _flush_per_task(self, batch: List[Dict[str, Any]]) -> None:
+        """Compatibility path: one submit_task RPC per entry."""
+        for entry in batch:
+            try:
+                out = dict(self.handle.client.call(
+                    "submit_task", spec=entry["spec"], fid=entry["fid"],
+                    args=entry["args"],
+                    backpressure=entry["backpressure"], timeout=None))
+                out["task"] = entry["task"]
+            except rpc.RemoteError as e:
+                out = {"task": entry["task"], "e": str(e)}
+            except rpc.RpcError:
+                self.handle.mark_dead()
+                return
+            self.handle._complete_batch_task(out)
+
+
+class _FreeCoalescer:
+    """Buffers zero-ref ``free_objects`` ids per daemon and flushes them
+    time/size-bounded (``free_batch_max`` / ``free_flush_ms``) — the
+    on-zero callback used to fire one single-element RPC per freed
+    object. Frees are idempotent at the daemon, so a flush that fails
+    in transit (``batch.free_flush`` failpoint) simply requeues."""
+
+    def __init__(self, handle: "DaemonHandle"):
+        from ray_tpu._private.config import cfg
+        self.handle = handle
+        self.batch_max = max(1, int(cfg().free_batch_max))
+        self.flush_s = max(0.0, float(cfg().free_flush_ms) / 1e3)
+        self._cv = threading.Condition()
+        self._oids: List[bytes] = []
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+
+    def queue(self, oid: bytes) -> None:
+        with self._cv:
+            if self._stopped:
+                return
+            self._oids.append(oid)
+            if self._thread is None:    # lazy: most handles never free
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name=f"free-batch-{self.handle.node_id.hex()[:8]}")
+                self._thread.start()
+            # first element wakes the idle flusher (it parks in an
+            # untimed wait, so a sub-batch_max trickle still leaves
+            # within flush_s); later appends ride the timed linger —
+            # notifying on each would flush tiny frames; a full batch
+            # wakes it early
+            if len(self._oids) == 1 or len(self._oids) >= self.batch_max:
+                self._cv.notify()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._oids and not self._stopped:
+                    self._cv.wait()
+                if self._stopped:
+                    return
+                if len(self._oids) < self.batch_max:
+                    # time-bounded: partial batches leave within flush_s
+                    self._cv.wait(self.flush_s)
+                if self._stopped:
+                    return
+                oids = self._oids[:self.batch_max]
+                del self._oids[:len(oids)]
+            if oids:    # a concurrent flush() may have drained the lot
+                self._send(oids)
+
+    def flush(self) -> None:
+        """Synchronous drain (worker shutdown, node drain): no queued
+        free may be lost to a process exit."""
+        while True:
+            with self._cv:
+                oids, self._oids = self._oids, []
+            if not oids:
+                return
+            self._send(oids)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._oids.clear()      # daemon dead: frees are moot
+            self._cv.notify_all()
+
+    def _send(self, oids: List[bytes]) -> None:
+        if _fp.ENABLED:
+            try:
+                act = _fp.fire("batch.free_flush", n=len(oids))
+            except Exception:   # noqa: BLE001 — injected error arm
+                act = _fp.DROP
+            if act is _fp.DROP:
+                # flush failed in transit: requeue — object deletion is
+                # idempotent at the daemon, so the retry is safe
+                with self._cv:
+                    if not self._stopped:
+                        self._oids[:0] = oids
+                return
+        try:
+            self.handle.client.call("free_objects", oids=oids,
+                                    timeout=None)
+        except (rpc.RpcError, rpc.RemoteError):
+            pass    # daemon dead/erroring: its store dies with it
+
+
 class DaemonHandle:
     """Driver's connection to one node daemon (lease/push/object plane)."""
 
@@ -157,10 +381,23 @@ class DaemonHandle:
         # generation — a reconnected lane restarts rid numbering, so a
         # bare rid could cancel an unrelated task on the new client
         self._fast_rids: Dict[str, Tuple[Any, int]] = {}
+        # control-plane batching (submit coalescer + free buffer)
+        self._batch_supported = False       # daemon advertises in hello
+        self._batch: Optional[_SubmitCoalescer] = None
+        self._batch_lock = threading.Lock()
+        self._batch_waiters: Dict[str, list] = {}   # task hex -> slot
+        self._bw_lock = threading.Lock()
+        self._fns_shipped: set = set()      # fids this daemon holds
+        self._free = _FreeCoalescer(self)
         self.runtime = None                    # bound by the backend
 
     # -- push demux -------------------------------------------------------
     def _on_push(self, method: str, msg: Dict[str, Any]) -> None:
+        if method == "task_batch_done":
+            # batched completion replies: many task outcomes on one frame
+            for out in msg.get("outcomes", ()):
+                self._complete_batch_task(out)
+            return
         if method in ("task_yield", "task_stream_end", "task_stream_crash"):
             with self._slock:
                 stream = self._streams.get(msg["task"])
@@ -192,9 +429,26 @@ class DaemonHandle:
             streams = list(self._streams.values())
         for stream in streams:
             stream.q.put(_STREAM_DEAD)
+        batch = self._batch
+        if batch is not None:
+            batch.stop()
+        self._free.stop()
+        # fail EVERY batch waiter (queued or in flight): slot[1] stays
+        # None, which _submit_batched surfaces as DaemonCrashed
+        with self._bw_lock:
+            waiters, self._batch_waiters = self._batch_waiters, {}
+        for slot in waiters.values():
+            slot[0].set()
         fl = self._fast
         if fl is not None:
             fl.close()
+
+    def _complete_batch_task(self, out: Dict[str, Any]) -> None:
+        with self._bw_lock:
+            slot = self._batch_waiters.pop(out.get("task", ""), None)
+        if slot is not None:
+            slot[1] = out
+            slot[0].set()
 
     def _call(self, method: str, **kw) -> Dict[str, Any]:
         if self.dead:
@@ -219,8 +473,24 @@ class DaemonHandle:
                          job_id=cloudpickle.dumps(job_id),
                          namespace=namespace, sys_path=sys_path)
         self.fast_port = out.get("fast_port")
+        # protocol feature flag: daemons that understand push_task_batch
+        # advertise it; anything older gets the per-task wire protocol
+        from ray_tpu._private.config import cfg
+        self._batch_supported = bool(out.get("batch")) and bool(
+            cfg().submit_batch)
         self._job_id = job_id
         return out
+
+    def _submit_coalescer(self) -> Optional[_SubmitCoalescer]:
+        if not self._batch_supported or self.dead:
+            return None
+        batch = self._batch
+        if batch is not None:
+            return batch
+        with self._batch_lock:
+            if self._batch is None and not self.dead:
+                self._batch = _SubmitCoalescer(self)
+            return self._batch
 
     def _fast_client(self):
         """Lazily-connected fast-lane client; None when unavailable."""
@@ -361,15 +631,55 @@ class DaemonHandle:
             self._streams[task_hex] = stream
         out = None
         try:
-            out = self._call(
-                "submit_task", spec=_slim_spec_blob(spec), fid=fid,
-                args=args_blob,
-                backpressure=spec.backpressure_num_objects)
+            batch = self._submit_coalescer()
+            if batch is not None:
+                out = self._submit_batched(batch, spec, fid, args_blob)
+            else:
+                out = self._call(
+                    "submit_task", spec=_slim_spec_blob(spec), fid=fid,
+                    args=args_blob,
+                    backpressure=spec.backpressure_num_objects)
             return self._decode_outcome(out, spec, stream)
         finally:
             if out_is_final(out):
                 with self._slock:
                     self._streams.pop(task_hex, None)
+
+    def _submit_batched(self, batch: _SubmitCoalescer, spec, fid: str,
+                        args_blob: bytes) -> Dict[str, Any]:
+        """Enqueue on the coalescer and wait for the batched completion;
+        same outcome dict (and error surface) as the submit_task RPC."""
+        task_hex = spec.task_id.hex()
+        slot = [threading.Event(), None]
+        with self._bw_lock:
+            if self.dead:
+                raise DaemonCrashed(
+                    f"daemon {self.node_id.hex()[:8]} is dead")
+            self._batch_waiters[task_hex] = slot
+        try:
+            batch.enqueue({
+                "task": task_hex,
+                # retries reuse the task id: the daemon's duplicate-frame
+                # dedupe keys on (task, attempt) so a retry EXECUTES
+                # instead of replaying the previous attempt's outcome
+                "attempt": spec.attempt_number,
+                "spec": _slim_spec_blob(spec),
+                "fid": fid,
+                "args": args_blob,
+                "backpressure": spec.backpressure_num_objects,
+            })
+        except DaemonCrashed:
+            with self._bw_lock:
+                self._batch_waiters.pop(task_hex, None)
+            raise
+        slot[0].wait()
+        out = slot[1]
+        if out is None:
+            raise DaemonCrashed(
+                f"daemon {self.node_id.hex()[:8]} died (batched submit)")
+        if out.get("e"):
+            raise rpc.RemoteError(out["e"])
+        return out
 
     def _decode_outcome(self, out: Dict[str, Any], spec, stream: _Stream):
         kind = out["outcome"]
@@ -547,6 +857,17 @@ class DaemonHandle:
         except DaemonCrashed:
             pass
 
+    def queue_free(self, oid: bytes) -> None:
+        """Zero-ref free: coalesced (time/size-bounded) instead of one
+        single-element free_objects RPC per object."""
+        if not self.dead:
+            self._free.queue(oid)
+
+    def flush_frees(self) -> None:
+        """Drain the free buffer NOW (worker shutdown, node drain)."""
+        if not self.dead:
+            self._free.flush()
+
     def pull_object(self, oid: bytes,
                     from_addr: Optional[Tuple[str, int]] = None,
                     priority: int = 2) -> bool:
@@ -559,6 +880,7 @@ class DaemonHandle:
 
     # -- lifecycle --------------------------------------------------------
     def stop(self) -> None:
+        self.flush_frees()      # no queued free may outlive the session
         try:
             if not self.dead:
                 self.client.call("daemon_stop", timeout=2.0)
@@ -583,6 +905,7 @@ class DaemonHandle:
     def detach(self) -> None:
         """Disconnect from a daemon we did not spawn (joined cluster):
         close the connection, leave the process running."""
+        self.flush_frees()      # the daemon lives on: release its store
         self.mark_dead()
         self.client.close()
 
@@ -674,7 +997,9 @@ class RemoteStore:
         with self._lock:
             entry = self._meta.pop(object_id, None)
         if entry is not None and not self.daemon.dead:
-            self.daemon.free_objects([entry[0]])
+            # coalesced: the zero-ref callback fires once per object,
+            # but the wire sees size/time-bounded free_objects batches
+            self.daemon.queue_free(entry[0])
 
     def object_ids(self):
         with self._lock:

@@ -107,6 +107,22 @@ FLAG_DEFS = [
     Flag("tpu_topology", str, "", "TPU slice topology for ICI-aware gang "
          "scheduling, '<gen>:<AxBxC>' (e.g. 'v5p:4x4x4'); '' = no "
          "topology (resource-count placement only)"),
+    # -- control-plane batching (docs/performance.md) --
+    Flag("submit_batch", bool, True, "coalesce driver->daemon task "
+         "submissions into push_task_batch wire frames (False = one "
+         "submit_task RPC per task, the pre-batching behavior)"),
+    Flag("submit_batch_max", int, 64, "max tasks per push_task_batch "
+         "frame; the coalescer flushes when this many are queued"),
+    Flag("submit_linger_us", int, 200, "how long (microseconds) the "
+         "submit coalescer waits for more tasks before flushing a "
+         "non-full batch; 0 = flush immediately (batching only under "
+         "concurrent submission pressure)"),
+    Flag("free_batch_max", int, 256, "max object ids per free_objects "
+         "RPC; the zero-ref free buffer flushes when this many are "
+         "queued"),
+    Flag("free_flush_ms", float, 5.0, "max milliseconds a queued "
+         "zero-ref free waits before its buffer is flushed to the "
+         "daemon"),
     # -- bench --
     Flag("bench_total_deadline", int, 540, "bench.py total wall-clock "
          "budget (seconds)"),

@@ -36,6 +36,7 @@ import json
 import os
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, Optional, Tuple
 
 import cloudpickle
@@ -54,6 +55,10 @@ declare("request_worker_lease", "task_meta")
 declare("return_worker", "lease_id")
 declare("push_task", "spec", "fid", "args", "lease_id", "backpressure")
 declare("submit_task", "spec", "fid", "args", "backpressure")
+# coalesced submit: many tasks per frame; `fns` ships each function blob
+# once per (daemon, fid); completions return batched on task_batch_done
+# push frames. Retried frames dedupe by task id (idempotent).
+declare("push_task_batch", "tasks", "fns")
 declare("create_actor", "spec", "fid", "args")
 declare("call_actor_method", "spec", "args")
 declare("kill_actor", "actor_id", "expected")
@@ -438,6 +443,85 @@ class _PullMissing(Exception):
 
 
 # ---------------------------------------------------------------------------
+# batched submit plumbing (driver side: cluster._SubmitCoalescer)
+# ---------------------------------------------------------------------------
+
+class _BatchTaskConn:
+    """Adapts one batched task's reply surface onto the shared
+    ``_run_pushed_task`` machinery: final outcomes ride the coalescing
+    reply pump instead of a per-rid reply frame; stream pushes
+    (task_yield / task_stream_end / task_stream_crash) pass straight
+    through to the real connection. ``key`` is the (task, attempt)
+    dedupe identity — attempt included because task retries reuse the
+    task id and must re-execute, not replay the old outcome."""
+
+    __slots__ = ("service", "conn", "task_hex", "key")
+
+    def __init__(self, service: "DaemonService", conn: Connection,
+                 task_hex: str, key: tuple):
+        self.service = service
+        self.conn = conn
+        self.task_hex = task_hex
+        self.key = key
+
+    @property
+    def closed(self) -> bool:
+        return self.conn.closed
+
+    def reply(self, rid, **kw) -> None:
+        out = dict(kw)
+        out["task"] = self.task_hex
+        self.service._batch_task_done(self.conn, self.key, out)
+
+    def reply_error(self, rid, err: str) -> None:
+        self.reply(rid, e=err)
+
+    def push(self, method: str, **kw) -> None:
+        self.conn.push(method, **kw)
+
+
+class _BatchReplyPump:
+    """Coalesces completed-task outcomes into ``task_batch_done`` push
+    frames — one frame carries every completion that landed within the
+    linger window (the batched-reply leg of the submit coalescer)."""
+
+    LINGER_S = 0.0005
+    MAX_PER_FRAME = 256
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._buf: Dict[Connection, list] = {}
+        threading.Thread(target=self._loop, daemon=True,
+                         name="batch-reply-pump").start()
+
+    def add(self, conn: Connection, out: Dict[str, Any]) -> None:
+        with self._cv:
+            self._buf.setdefault(conn, []).append(out)
+            self._cv.notify()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._buf:
+                    self._cv.wait()
+            # short linger: completions that land together leave together
+            time.sleep(self.LINGER_S)
+            with self._cv:
+                buf, self._buf = self._buf, {}
+            for conn, outs in buf.items():
+                if conn.closed:
+                    continue
+                for i in range(0, len(outs), self.MAX_PER_FRAME):
+                    conn.push("task_batch_done",
+                              outcomes=outs[i:i + self.MAX_PER_FRAME])
+
+
+# completed batched-task outcomes kept for duplicate-frame resend; cap
+# bounds the inline result blobs a slow driver can pin here
+_BATCH_DONE_CAP = 512
+
+
+# ---------------------------------------------------------------------------
 # the daemon's runtime shim (what WorkerClient/_core paths need)
 # ---------------------------------------------------------------------------
 
@@ -502,6 +586,13 @@ class DaemonService:
         self._lease_seq = 0
         # task_id hex -> (client, worker rid) for cancel/gen_ack
         self._task_rids: Dict[str, Tuple[Any, str]] = {}
+        # batched-submit dedupe, keyed (task hex, attempt): a retried
+        # push_task_batch frame must not double-execute — running tasks
+        # are skipped, finished ones get their recorded outcome resent;
+        # a task RETRY bumps the attempt and executes normally
+        self._batch_running: set = set()
+        self._batch_done: "OrderedDict[tuple, Dict[str, Any]]" = OrderedDict()
+        self._batch_pump = _BatchReplyPump()
         self._bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}
         self._peers: Dict[Tuple[str, int], Client] = {}
         # cross-language actors: name -> [actor_id, seqno]
@@ -679,7 +770,10 @@ class DaemonService:
                     break
             time.sleep(0.02)
         return {"ok": True, "pid": os.getpid(),
-                "fast_port": self.fast_port}
+                "fast_port": self.fast_port,
+                # protocol feature flag: this daemon understands
+                # push_task_batch (drivers fall back per-task otherwise)
+                "batch": True}
 
     def notify_driver(self, kind: str, **kw) -> None:
         conn = self.driver_conn
@@ -715,6 +809,8 @@ class DaemonService:
             self._leases.clear()
             self._task_rids.clear()
             self._bundles.clear()
+            self._batch_running.clear()
+            self._batch_done.clear()
         for client in leases:   # leased mid-task: state unknown, kill
             try:
                 client.kill(expected=True)
@@ -838,6 +934,79 @@ class DaemonService:
             # worker (and its _ACTIVE slot) would leak per failed submit.
             wp.release_worker(client)
             raise
+
+    def handle_push_task_batch(self, conn, rid, msg):
+        """Coalesced submit: N tasks on one frame (driver-side
+        _SubmitCoalescer). Each task runs exactly like submit_task —
+        fused lease+push+release on a pooled worker — but the per-task
+        RPC round trip is gone: the frame is acked once, and completions
+        return batched on task_batch_done push frames.
+
+        Idempotent by task id: a retried frame (driver saw its flush
+        fail in transit) skips tasks already running and resends the
+        recorded outcome of tasks already finished — never a second
+        execution."""
+        for fid, blob in (msg.get("fns") or {}).items():
+            # content-addressed (fid == sha1(blob)): registering under
+            # the same id the driver computed lets workers resolve
+            # fetch_function locally with no driver round trip
+            from ray_tpu._private import worker_process as wp
+            wp.register_function_blob(blob)
+        resend = []
+        for entry in msg["tasks"]:
+            # dedupe identity is (task, attempt): a RETRY reuses the
+            # task id but must execute — only a resent frame of the
+            # SAME attempt is a duplicate
+            key = (entry["task"], entry.get("attempt", 0))
+            with self._lock:
+                if key in self._batch_running:
+                    continue        # duplicate of an in-flight task
+                done = self._batch_done.get(key)
+                if done is not None:
+                    resend.append(done)
+                    continue
+                self._batch_running.add(key)
+            self._start_batch_task(conn, entry, key)
+        for out in resend:
+            self._batch_pump.add(conn, out)
+        return {"ok": True, "accepted": len(msg["tasks"])}
+
+    def _start_batch_task(self, conn, entry, key: tuple) -> None:
+        """Acquire a pooled worker OFF the RPC lane thread (the pool may
+        cold-spawn a process) and run the shared pushed-task machinery
+        with the batch reply adapter."""
+        bconn = _BatchTaskConn(self, conn, entry["task"], key)
+
+        def start():
+            from ray_tpu._private import worker_process as wp
+
+            try:
+                client = wp.acquire_worker()
+            except BaseException as e:  # noqa: BLE001 — shipped back
+                bconn.reply_error(None, f"{type(e).__name__}: {e}")
+                return
+            client.raw_outcomes = True
+            client.runtime = self.runtime
+            client.node = self.node_stub
+            try:
+                self._run_pushed_task(bconn, None, entry, client,
+                                      lease_id=None)
+            except BaseException as e:  # noqa: BLE001 — e.g. an
+                # undecodable spec: release the checkout and fail just
+                # this task, not the whole batch
+                wp.release_worker(client)
+                bconn.reply_error(None, f"{type(e).__name__}: {e}")
+
+        self._task_pool.submit(start)
+
+    def _batch_task_done(self, conn, key: tuple,
+                         out: Dict[str, Any]) -> None:
+        with self._lock:
+            self._batch_running.discard(key)
+            self._batch_done[key] = out
+            while len(self._batch_done) > _BATCH_DONE_CAP:
+                self._batch_done.popitem(last=False)
+        self._batch_pump.add(conn, out)
 
     def handle_push_task(self, conn, rid, msg):
         """Execute on the leased worker; replies with the outcome. Big

@@ -69,19 +69,30 @@ _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("fast lane peer closed")
-        buf += chunk
-    return bytes(buf)
+# ONE recv implementation for every wire layer (recv_into, no per-chunk
+# copies): rpc.recv_exact raises ConnectionError on EOF, which this
+# module's except (ConnectionError, OSError) sites already handle.
+from ray_tpu._private.rpc import SEND_CONCAT_MAX as _SEND_CONCAT_MAX
+from ray_tpu._private.rpc import recv_exact as _recv_exact
 
 
-def _read_frame(sock: socket.socket) -> bytes:
+def _read_frame(sock: socket.socket) -> bytearray:
     (blen,) = _U32.unpack(_recv_exact(sock, 4))
     return _recv_exact(sock, blen)
+
+
+def _send_lane_frame(sock: socket.socket, wlock: threading.Lock, op: int,
+                     head: bytes, payload: bytes = b"") -> None:
+    """Lane frame write shared by client and worker sides: header and
+    small payloads concatenate (one syscall); large payloads go as a
+    second sendall under the same lock — no multi-MB concat copy."""
+    prefix = _U32.pack(1 + len(head) + len(payload)) + bytes([op]) + head
+    with wlock:
+        if len(payload) <= _SEND_CONCAT_MAX:
+            sock.sendall(prefix + payload)
+        else:
+            sock.sendall(prefix)
+            sock.sendall(payload)
 
 
 # ---------------------------------------------------------------------------
@@ -176,10 +187,7 @@ class FastLaneClient:
 
     # -- wire -------------------------------------------------------------
     def _send(self, op: int, head: bytes, payload: bytes = b"") -> None:
-        frame = (_U32.pack(1 + len(head) + len(payload))
-                 + bytes([op]) + head + payload)
-        with self._wlock:
-            self._sock.sendall(frame)
+        _send_lane_frame(self._sock, self._wlock, op, head, payload)
 
     def _read_loop(self) -> None:
         try:
@@ -375,10 +383,7 @@ def worker_fast_lane_start(addr: Tuple[str, int], state,
     wlock = threading.Lock()
 
     def send(op: int, head: bytes, payload: bytes = b"") -> None:
-        frame = (_U32.pack(1 + len(head) + len(payload))
-                 + bytes([op]) + head + payload)
-        with wlock:
-            sock.sendall(frame)
+        _send_lane_frame(sock, wlock, op, head, payload)
 
     if tag is not None:
         send(OP_HELLO_TAGGED, _U64.pack(tag))

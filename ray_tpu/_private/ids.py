@@ -16,9 +16,57 @@ import threading
 _counter_lock = threading.Lock()
 _counter = 0
 
+# Amortized entropy pool: one os.urandom syscall refills ~1k IDs. The
+# per-call syscall dominated ID creation on sandboxed kernels (three IDs
+# per task submission put it squarely on the control-plane hot path);
+# the reference sidesteps the same cost by deriving most IDs from a
+# per-process seed + counter (src/ray/common/id.cc).
+_POOL_SIZE = 16384
+_pool = b""
+_pool_off = 0
+_pool_pid = 0
+_pool_lock = threading.Lock()
+
+
+def _refill_locked() -> None:
+    global _pool, _pool_off, _pool_pid
+    _pool = os.urandom(_POOL_SIZE)
+    _pool_off = 0
+    _pool_pid = os.getpid()
+
 
 def _unique_bytes(nbytes: int) -> bytes:
-    return os.urandom(nbytes)
+    global _pool_off
+    with _pool_lock:
+        # pid check: a forked child sharing the parent's buffered bytes
+        # would mint the PARENT'S ids — refill from the kernel instead
+        # (register_at_fork below handles the common path; the pid check
+        # covers forks that bypass os.fork hooks)
+        if _pool_off + nbytes > len(_pool) or _pool_pid != os.getpid():
+            _refill_locked()
+        out = _pool[_pool_off:_pool_off + nbytes]
+        _pool_off += nbytes
+    return out
+
+
+def _drop_pool_after_fork() -> None:
+    global _pool, _pool_off
+    _pool = b""
+    _pool_off = 0
+    try:
+        # the fork snapshotted the lock in its (held) pre-fork state;
+        # release our copy or the child's first ID mint deadlocks
+        _pool_lock.release()
+    except RuntimeError:
+        pass
+
+
+if hasattr(os, "register_at_fork"):
+    # hold the lock ACROSS the fork: a child forked while another
+    # thread was mid-mint would otherwise inherit a forever-held lock
+    os.register_at_fork(before=_pool_lock.acquire,
+                        after_in_parent=_pool_lock.release,
+                        after_in_child=_drop_pool_after_fork)
 
 
 class BaseID:

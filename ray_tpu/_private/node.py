@@ -34,6 +34,12 @@ _DISPATCH_POLL_S = 5.0
 _WAKE = object()
 
 
+def _bump_cluster_epoch() -> None:
+    # lazy import: scheduler.py imports this module at top level
+    from ray_tpu._private.scheduler import bump_cluster_epoch
+    bump_cluster_epoch()
+
+
 class ResourceLedger:
     """Tracks total/available resources with blocking acquire."""
 
@@ -80,6 +86,7 @@ class ResourceLedger:
                 self.total[k] = self.total.get(k, 0.0) + v
                 self._available[k] = self._available.get(k, 0.0) + v
             self._cond.notify_all()
+        _bump_cluster_epoch()   # can_fit_total answers changed
 
     def remove_total(self, extra: Dict[str, float]) -> None:
         with self._cond:
@@ -87,6 +94,28 @@ class ResourceLedger:
                 self.total[k] = max(self.total.get(k, 0.0) - v, 0.0)
                 self._available[k] = max(self._available.get(k, 0.0) - v, 0.0)
             self._cond.notify_all()
+        _bump_cluster_epoch()
+
+    def try_acquire_many(self, demand: Dict[str, float],
+                         max_n: int) -> int:
+        """Admit as many identically-shaped demands as fit — computed
+        and deducted under ONE lock acquisition (the dispatch loop's
+        batch admission; per-task try_acquire paid a lock round-trip
+        per queued task)."""
+        if max_n <= 0:
+            return 0
+        with self._cond:
+            n = max_n
+            for k, v in demand.items():
+                if v <= 0:
+                    continue
+                have = self._available.get(k, 0.0)
+                n = min(n, int((have + 1e-9) // v))
+                if n <= 0:
+                    return 0
+            for k, v in demand.items():
+                self._available[k] = self._available.get(k, 0.0) - v * n
+            return n
 
 
 class _DirectOp:
@@ -355,15 +384,20 @@ class Node:
         self._queue.put(spec)
 
     def _drop_pending(self, spec: TaskSpec) -> None:
+        self._drop_pending_many((spec,))
+
+    def _drop_pending_many(self, specs) -> None:
+        """One pending-lock round-trip for a whole admitted batch."""
         with self._pending_lock:
-            for k, v in spec.resources.items():
-                left = max(self._pending_demand.get(k, 0.0) - v, 0.0)
-                if left <= 1e-12:
-                    # Drop zeroed keys: PG-scoped names are unique per group
-                    # and would otherwise accumulate forever.
-                    self._pending_demand.pop(k, None)
-                else:
-                    self._pending_demand[k] = left
+            for spec in specs:
+                for k, v in spec.resources.items():
+                    left = max(self._pending_demand.get(k, 0.0) - v, 0.0)
+                    if left <= 1e-12:
+                        # Drop zeroed keys: PG-scoped names are unique per
+                        # group and would otherwise accumulate forever.
+                        self._pending_demand.pop(k, None)
+                    else:
+                        self._pending_demand[k] = left
 
     def effective_available(self) -> Dict[str, float]:
         """Available capacity minus demand already queued here."""
@@ -408,29 +442,40 @@ class Node:
                 bucket = self._backlog.get(key)
                 if bucket is None:
                     continue
-                while bucket and self.ledger.try_acquire(
-                        bucket[0].resources):
-                    spec = bucket.popleft()
-                    self._backlog_n -= 1
-                    t0 = time.perf_counter()
-                    if spec.enqueued_at:
-                        lag_ms = (t0 - spec.enqueued_at) * 1000
-                        if lag_ms > self.loop_stats["max_queue_lag_ms"]:
-                            self.loop_stats["max_queue_lag_ms"] = lag_ms
-                    # count BEFORE launch: the task thread may finish (and
-                    # a get() observe it) before control returns here
-                    self.loop_stats["tasks_launched"] += 1
-                    self._launch(spec)
-                    self.loop_stats["launch_ms_total"] += (
-                        time.perf_counter() - t0) * 1000
+                while bucket:
+                    # Batch admission: every task in a bucket shares one
+                    # resource shape, so ONE ledger lock round-trip
+                    # admits as many as currently fit (per-task
+                    # try_acquire paid a lock + dict scan per task).
+                    n = self.ledger.try_acquire_many(bucket[0].resources,
+                                                     len(bucket))
+                    if n <= 0:
+                        break
+                    admitted = [bucket.popleft() for _ in range(n)]
+                    self._backlog_n -= n
+                    self._drop_pending_many(admitted)
+                    for spec in admitted:
+                        t0 = time.perf_counter()
+                        if spec.enqueued_at:
+                            lag_ms = (t0 - spec.enqueued_at) * 1000
+                            if lag_ms > self.loop_stats["max_queue_lag_ms"]:
+                                self.loop_stats["max_queue_lag_ms"] = lag_ms
+                        # count BEFORE launch: the task thread may finish
+                        # (and a get() observe it) before control
+                        # returns here
+                        self.loop_stats["tasks_launched"] += 1
+                        self._launch(spec, drop_pending=False)
+                        self.loop_stats["launch_ms_total"] += (
+                            time.perf_counter() - t0) * 1000
                     progressed = True
                 if not bucket:
                     self._backlog.pop(key, None)
             if self._backlog_n and not progressed:
                 self.ledger.wait_for_change(0.05)
 
-    def _launch(self, spec: TaskSpec) -> None:
-        self._drop_pending(spec)
+    def _launch(self, spec: TaskSpec, drop_pending: bool = True) -> None:
+        if drop_pending:
+            self._drop_pending(spec)
         self._sema.acquire()
         # Pairs this acquire with exactly one release: the worker may
         # release early (before completing futures — see
@@ -473,6 +518,9 @@ class Node:
         placing here. Runs on any thread; the backlog itself is only
         touched by the dispatch thread (woken via the sentinel)."""
         self.draining = True
+        # DRAINING must leave cached pick_node candidate sets NOW, not
+        # at the next natural invalidation
+        _bump_cluster_epoch()
         self._queue.put(_WAKE)
 
     def _resubmit_backlog(self) -> None:
@@ -515,6 +563,7 @@ class Node:
     def shutdown(self, fail_tasks: bool = True) -> Dict[ActorID, List[TaskSpec]]:
         """Stop the node; returns per-actor pending tasks for FT handling."""
         self.alive = False
+        _bump_cluster_epoch()
         self._queue.put(None)
         pending_by_actor: Dict[ActorID, List[TaskSpec]] = {}
         with self._actors_lock:

@@ -130,6 +130,35 @@ def queued_task_drain(n: int = 10_000) -> Dict:
             "drain_per_s": round(n / t_total, 1)}
 
 
+def burst_submit_batched(n: int = 3000) -> Dict:
+    """Burst-submit tasks on the CLASSIC wire path (two returns keeps
+    them off the native fast lane), so the daemons topology measures the
+    submit coalescer end to end: push_task_batch frames out, batched
+    task_batch_done completions back."""
+    import ray_tpu
+
+    own = not ray_tpu.is_initialized()
+    if own:
+        ray_tpu.init(num_nodes=1, resources={"CPU": 8})
+
+    @ray_tpu.remote(num_returns=2)
+    def duo():
+        return None, None
+
+    t0 = time.perf_counter()
+    refs = [duo.remote() for _ in range(n)]
+    t_submit = time.perf_counter() - t0
+    ray_tpu.get([r for ab in refs for r in ab])
+    t_total = time.perf_counter() - t0
+    if own:
+        ray_tpu.shutdown()
+    return {"name": "burst_submit_batched", "n": n,
+            "submit_seconds": round(t_submit, 3),
+            "total_seconds": round(t_total, 3),
+            "submit_per_s": round(n / t_submit, 1),
+            "drain_per_s": round(n / t_total, 1)}
+
+
 def main() -> int:
     """Emit one JSON line per benchmark for the current mode (set
     RAY_TPU_CLUSTER=daemons for cluster mode); used by tools/gen_perf.py
@@ -143,6 +172,8 @@ def main() -> int:
     for row in run_microbenchmarks(duration_s=duration):
         print(json.dumps(row))
         sys.stdout.flush()
+    print(json.dumps(burst_submit_batched()))
+    sys.stdout.flush()
     print(json.dumps(queued_task_drain(drain_n)))
     sys.stdout.flush()
     # scaling TREND: does the drain rate hold at 3x the backlog?

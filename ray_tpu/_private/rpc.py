@@ -88,28 +88,55 @@ def _validate(method: str, kw: Dict[str, Any]) -> None:
         raise RpcError(f"{method}: missing fields {missing}")
 
 
+# Above this size the `len + blob` concatenation copy costs more than a
+# second syscall: send header and payload as two sendalls under the lock
+# (zero extra copy); below it, one small concat + one syscall wins.
+SEND_CONCAT_MAX = 64 * 1024
+
+
+def send_frame_bytes(sock: socket.socket, blob, lock) -> None:
+    """Length-prefixed frame write, shared by rpc and the fast lane.
+    ``blob`` is any bytes-like; large payloads are never copied into a
+    `len + blob` concatenation."""
+    n = len(blob)
+    if n > MAX_FRAME:
+        raise RpcError(f"frame too large: {n}")
+    if n <= SEND_CONCAT_MAX:
+        with lock:
+            sock.sendall(_LEN.pack(n) + blob)
+        return
+    with lock:
+        # two-phase write under the SAME lock hold: the header and its
+        # payload must stay adjacent on the stream
+        sock.sendall(_LEN.pack(n))
+        sock.sendall(blob)
+
+
 def _send_frame(sock: socket.socket, obj: Dict[str, Any],
                 lock: threading.Lock) -> None:
-    blob = msgpack.packb(obj, use_bin_type=True)
-    if len(blob) > MAX_FRAME:
-        raise RpcError(f"frame too large: {len(blob)}")
-    with lock:
-        sock.sendall(_LEN.pack(len(blob)) + blob)
+    send_frame_bytes(sock, msgpack.packb(obj, use_bin_type=True), lock)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise RpcError("connection closed")
-        buf.extend(chunk)
-    return bytes(buf)
+def recv_exact(sock: socket.socket, n: int) -> bytearray:
+    """Read exactly ``n`` bytes via recv_into on one preallocated
+    buffer — no per-chunk bytes allocation + copy. ONE implementation
+    for both wire layers (rpc + fast_lane). Raises ConnectionError on
+    EOF (an OSError subclass, so existing transport-failure handling on
+    both sides catches it unchanged)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if not r:
+            raise ConnectionError("connection closed")
+        got += r
+    return buf
 
 
 def _recv_frame(sock: socket.socket) -> Dict[str, Any]:
-    (n,) = _LEN.unpack(_recv_exact(sock, 4))
-    return msgpack.unpackb(_recv_exact(sock, n), raw=False)
+    (n,) = _LEN.unpack(recv_exact(sock, 4))
+    return msgpack.unpackb(recv_exact(sock, n), raw=False)
 
 
 # ---------------------------------------------------------------------------

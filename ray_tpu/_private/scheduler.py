@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import random
 import threading
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from ray_tpu._private.node import Node
 from ray_tpu._private.task_spec import (
@@ -27,9 +27,37 @@ from ray_tpu._private.task_spec import (
 SPREAD_THRESHOLD = 0.5
 TOP_K_FRACTION = 0.2
 
+# ---------------------------------------------------------------------------
+# Cluster epoch: a process-wide version of cluster MEMBERSHIP + static
+# capacity. Bumped on node add/remove/drain and on placement-group
+# bundle capacity changes — everything can_fit_total() depends on. The
+# feasibility cache below keys on it, so a burst of identically-shaped
+# submissions scans all nodes once per epoch instead of once per task.
+# ---------------------------------------------------------------------------
+
+_EPOCH = 0
+_EPOCH_LOCK = threading.Lock()
+
+
+def bump_cluster_epoch() -> int:
+    """Invalidate cached feasibility (node add/remove/drain, capacity
+    change). Cheap and safe to over-call."""
+    global _EPOCH
+    with _EPOCH_LOCK:
+        _EPOCH += 1
+        return _EPOCH
+
+
+def cluster_epoch() -> int:
+    return _EPOCH
+
 
 class SchedulingError(Exception):
     """Task is infeasible: no alive node can ever satisfy it."""
+
+
+_INFEASIBLE = object()      # negative-cache sentinel
+_FEAS_CACHE_MAX = 512       # distinct resource shapes per epoch
 
 
 class ClusterScheduler:
@@ -37,6 +65,9 @@ class ClusterScheduler:
         from ray_tpu._private.lock_sanitizer import tracked_lock
         self._lock = tracked_lock("scheduler", reentrant=False)
         self._spread_rr = 0  # round-robin cursor for SPREAD
+        # (resource-shape, cluster-epoch) -> feasible candidate nodes
+        self._feas_cache: Dict[tuple, Any] = {}
+        self._feas_epoch = -1
 
     def pick_node(self, spec: TaskSpec, nodes: List[Node],
                   preferred: Optional[Node] = None) -> Optional[Node]:
@@ -44,6 +75,16 @@ class ClusterScheduler:
 
         Raises SchedulingError if no node can ever fit the demand.
         """
+        strategy = spec.scheduling_strategy
+        if strategy == "DEFAULT" or strategy == "SPREAD":
+            # hot path: plain strategies share one feasibility scan per
+            # (resource shape, cluster epoch) — a burst of identical
+            # specs does not re-scan every node per task
+            feasible = self._feasible_cached(spec, nodes)
+            if strategy == "SPREAD":
+                return self._pick_spread(spec, feasible)
+            return self._pick_hybrid(spec, feasible, preferred)
+
         alive = [n for n in nodes if n.alive]
         if not alive:
             raise SchedulingError("no alive nodes in cluster")
@@ -54,7 +95,6 @@ class ClusterScheduler:
         schedulable = [n for n in alive
                        if not getattr(n, "draining", False)] or alive
 
-        strategy = spec.scheduling_strategy
         if isinstance(strategy, PlacementGroupSchedulingStrategy):
             return self._pick_pg(spec, strategy, alive)
         if isinstance(strategy, NodeAffinitySchedulingStrategy):
@@ -70,6 +110,15 @@ class ClusterScheduler:
                            if not getattr(n, "draining", False)] or alive
             strategy = "DEFAULT"
 
+        feasible = self._compute_feasible(spec, alive, schedulable)
+        if strategy == "SPREAD":
+            return self._pick_spread(spec, feasible)
+        return self._pick_hybrid(spec, feasible, preferred)
+
+    # -- feasibility cache -------------------------------------------------
+    @staticmethod
+    def _compute_feasible(spec: TaskSpec, alive: List[Node],
+                          schedulable: List[Node]) -> List[Node]:
         feasible = [n for n in schedulable
                     if n.ledger.can_fit_total(spec.resources)]
         if not feasible:
@@ -81,10 +130,55 @@ class ClusterScheduler:
             raise SchedulingError(
                 f"resource demand {spec.resources} is infeasible on every "
                 f"alive node")
+        return feasible
 
-        if strategy == "SPREAD":
-            return self._pick_spread(spec, feasible)
-        return self._pick_hybrid(spec, feasible, preferred)
+    def _feasible_cached(self, spec: TaskSpec,
+                         nodes: List[Node]) -> List[Node]:
+        epoch = _EPOCH
+        key = tuple(sorted(spec.resources.items()))
+        with self._lock:
+            if self._feas_epoch != epoch:
+                self._feas_cache.clear()
+                self._feas_epoch = epoch
+                entry = None
+            else:
+                entry = self._feas_cache.get(key)
+        if entry is _INFEASIBLE:
+            raise SchedulingError(
+                f"resource demand {spec.resources} is infeasible on every "
+                f"alive node")
+        if entry is not None:
+            # epoch bumps cover membership/drain transitions; this cheap
+            # re-check makes a missed bump degrade to a recompute
+            # instead of a placement on a dead/draining node
+            live = [n for n in entry
+                    if n.alive and not getattr(n, "draining", False)]
+            if len(live) == len(entry):
+                return entry
+        alive = [n for n in nodes if n.alive]
+        if not alive:
+            raise SchedulingError("no alive nodes in cluster")
+        schedulable = [n for n in alive
+                       if not getattr(n, "draining", False)] or alive
+        try:
+            feasible = self._compute_feasible(spec, alive, schedulable)
+        except SchedulingError:
+            self._feas_store(epoch, key, _INFEASIBLE)
+            raise
+        # only cache clean candidate sets: a draining-fallback pick must
+        # re-evaluate per task (the fallback is a last resort, not a
+        # steady state)
+        if all(not getattr(n, "draining", False) for n in feasible):
+            self._feas_store(epoch, key, feasible)
+        return feasible
+
+    def _feas_store(self, epoch: int, key: tuple, value: Any) -> None:
+        with self._lock:
+            if self._feas_epoch != epoch or _EPOCH != epoch:
+                return      # the cluster moved underneath the scan
+            if len(self._feas_cache) >= _FEAS_CACHE_MAX:
+                self._feas_cache.clear()
+            self._feas_cache[key] = value
 
     # -- policies ----------------------------------------------------------
     def _pick_hybrid(self, spec: TaskSpec, feasible: List[Node],

@@ -316,6 +316,8 @@ class Runtime:
         with self._nodes_lock:
             self._nodes[node_id] = node
         self.gcs.register_node(node.info())
+        from ray_tpu._private.scheduler import bump_cluster_epoch
+        bump_cluster_epoch()
         return node
 
     def add_remote_node(self, handle, resources: Dict[str, float]) -> Node:
@@ -331,6 +333,8 @@ class Runtime:
         with self._nodes_lock:
             self._nodes[handle.node_id] = node
         self.gcs.register_node(node.info())
+        from ray_tpu._private.scheduler import bump_cluster_epoch
+        bump_cluster_epoch()
         return node
 
     def _execute_on_remote_node(self, spec: TaskSpec, node: Node) -> None:
@@ -457,6 +461,8 @@ class Runtime:
     def remove_node(self, node: Node, _from_cluster: bool = False) -> None:
         """Simulate node failure: lose its objects, tasks, and actors.
         For daemon-backed nodes this hard-kills the daemon process."""
+        from ray_tpu._private.scheduler import bump_cluster_epoch
+        bump_cluster_epoch()    # before the pop: no stale cache window
         with self._nodes_lock:
             present = self._nodes.pop(node.node_id, None) is not None
         if not present:
@@ -591,6 +597,16 @@ class Runtime:
     def _drain_node_worker(self, node: Node, deadline_s: float,
                            reason: str) -> None:
         deadline = time.monotonic() + max(0.0, deadline_s)
+        # flush coalesced frees first: the draining daemon's store should
+        # not migrate (or hold) objects the driver already released
+        for handle in ([getattr(node, "daemon", None)]
+                       + [getattr(n, "daemon", None)
+                          for n in self.alive_nodes()]):
+            if handle is not None:
+                try:
+                    handle.flush_frees()
+                except Exception:
+                    pass
         try:
             self._migrate_node_objects(node)
             self._migrate_node_actors(node, reason, deadline=deadline)

@@ -22,6 +22,18 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def axis_size(axis_name) -> int:
+    """Static size of a bound mesh axis, across jax versions:
+    ``jax.lax.axis_size`` only exists in newer releases, and on older
+    ones ``jax.core.axis_frame`` returns either the size itself or a
+    frame object carrying it."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    frame = jax.core.axis_frame(axis_name)
+    return frame if isinstance(frame, int) else frame.size
+
+
 def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
     """GQA: repeat kv heads to match q heads. [B,S,Hkv,D] -> [B,S,H,D]."""
     num_kv = k.shape[-2]
@@ -186,6 +198,21 @@ def _interpret_default() -> bool:
         return True
 
 
+def _compiler_params(**kw):
+    """Pallas-TPU compiler params across the TPUCompilerParams ->
+    CompilerParams rename; a clear error beats a NoneType call when a
+    jax release exposes neither name."""
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams",
+                  getattr(pltpu, "TPUCompilerParams", None))
+    if cls is None:
+        raise RuntimeError(
+            f"jax {jax.__version__}: pallas.tpu exposes neither "
+            f"CompilerParams nor TPUCompilerParams; flash attention "
+            f"needs a supported jax release")
+    return cls(**kw)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("causal", "block_q", "block_k",
                                     "interpret"))
@@ -232,7 +259,7 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt)

@@ -20,7 +20,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ray_tpu.ops.attention import NEG_INF, _repeat_kv
+from ray_tpu.ops.attention import NEG_INF, _repeat_kv, axis_size
 
 
 def _block_attn(q, k, v, q_offset, k_offset, scale, causal):
@@ -56,7 +56,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     k = _repeat_kv(k, q.shape[-2])
     v = _repeat_kv(v, q.shape[-2])
 
-    sp = jax.lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     chunk = q.shape[1]
     q_offset = idx * chunk

@@ -39,9 +39,10 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     [B, S_local, H|H_kv, D]. Requires H % sp == 0 (and H_kv % sp == 0, so
     grouped-query K/V are repeated up to H first when needed).
     """
-    from ray_tpu.ops.attention import _repeat_kv, blockwise_attention
+    from ray_tpu.ops.attention import (_repeat_kv, axis_size,
+                                       blockwise_attention)
 
-    sp = jax.lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     heads = q.shape[2]
     if sp == 1:
         k = _repeat_kv(k, heads)

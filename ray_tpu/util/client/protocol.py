@@ -8,6 +8,12 @@ from typing import Any
 
 import cloudpickle
 
+# ONE recv implementation for every wire layer (recv_into + memoryview,
+# no per-chunk copies); raises ConnectionError on EOF like the local
+# helper it replaced.
+from ray_tpu._private.rpc import SEND_CONCAT_MAX
+from ray_tpu._private.rpc import recv_exact as _recv_exact
+
 _HDR = struct.Struct("!Q")
 MAX_FRAME = 1 << 34
 
@@ -15,7 +21,11 @@ MAX_FRAME = 1 << 34
 def send_msg(sock: socket.socket, obj: Any) -> None:
     from ray_tpu._private.device_objects import wire_dumps
     payload = wire_dumps(obj)   # sharding-preserving jax wire format
-    sock.sendall(_HDR.pack(len(payload)) + payload)
+    if len(payload) <= SEND_CONCAT_MAX:
+        sock.sendall(_HDR.pack(len(payload)) + payload)
+    else:   # big tensors: skip the header+payload concat copy
+        sock.sendall(_HDR.pack(len(payload)))
+        sock.sendall(payload)
 
 
 def recv_msg(sock: socket.socket) -> Any:
@@ -24,15 +34,3 @@ def recv_msg(sock: socket.socket) -> Any:
     if length > MAX_FRAME:
         raise ValueError(f"frame too large: {length}")
     return cloudpickle.loads(_recv_exact(sock, length))
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    got = 0
-    while got < n:
-        chunk = sock.recv(min(n - got, 1 << 20))
-        if not chunk:
-            raise ConnectionError("peer closed")
-        chunks.append(chunk)
-        got += len(chunk)
-    return b"".join(chunks)

@@ -25,6 +25,10 @@ def run_mode(mode: str, envelope: bool = False) -> list:
     env["RAY_TPU_LOG_TO_DRIVER"] = "0"
     if envelope:
         env["PERF_ENVELOPE"] = "1"
+    else:
+        # the degraded retry must not re-inherit an exported
+        # PERF_ENVELOPE=1 from the caller's environment
+        env.pop("PERF_ENVELOPE", None)
     if mode == "daemons":
         env["RAY_TPU_CLUSTER"] = "daemons"
     else:
@@ -34,6 +38,14 @@ def run_mode(mode: str, envelope: bool = False) -> list:
         capture_output=True, text=True, env=env,
         timeout=(3600 if envelope else 900))
     if out.returncode != 0:
+        if envelope:
+            # The envelope slices (100k drain / 5000 actors) exceed some
+            # sandboxes' thread/PID limits and get SIGKILLed; degrade to
+            # the core rows — the envelope section simply drops out of
+            # PERF.md on hosts that cannot hold it.
+            print(f"# {mode} envelope run failed (rc={out.returncode}); "
+                  f"retrying without envelope", file=sys.stderr)
+            return run_mode(mode, envelope=False)
         raise RuntimeError(f"{mode} perf run failed:\n{out.stderr[-2000:]}")
     return [json.loads(line) for line in out.stdout.splitlines()
             if line.strip().startswith("{")]
@@ -103,7 +115,17 @@ def main() -> int:
           "the single-node scheduler backlog (reference envelope: "
           "1M+ queued; this record uses 10k and 30k per run to stay "
           "CI-sized; the 3x row shows the drain rate HOLDS as the "
-          "backlog grows — no superlinear degradation).")
+          "backlog grows — no superlinear degradation). "
+          "`burst_submit_batched` bursts two-return tasks — off the "
+          "native fast lane — so the daemons column measures the "
+          "batched push_task_batch wire path end to end "
+          "(docs/performance.md). The envelope section appears only "
+          "on hosts whose thread/PID limits can hold the 100k-task / "
+          "5000-actor slices. Numbers are only comparable within one "
+          "host generation: see tools/evidence/batching_ab_r6.md for "
+          "the same-box A/B that isolates code changes from hardware "
+          "changes (control-plane submit 4.4-6.5x, round-trip rows "
+          "execution-bound).")
     return 0
 
 

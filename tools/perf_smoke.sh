@@ -1,0 +1,112 @@
+#!/usr/bin/env bash
+# Control-plane perf smoke: a ~10-second mini envelope (tasks/s + a
+# queued-submit drain) compared against the committed floor. Fails
+# (exit 1) when any probe regresses more than 30% below its floor —
+# wire it into CI next to the tier-1 tests (see docs/performance.md).
+#
+# Usage:
+#   tools/perf_smoke.sh                      # in-process topology
+#   tools/perf_smoke.sh daemons              # head+daemon wire topology
+#   tools/perf_smoke.sh [daemons] --rebaseline   # rewrite the floor
+#
+# Floors live per topology: tools/perf_floor.json (in-process) and
+# tools/perf_floor_daemons.json (daemons).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+REBASE=""
+FLOOR="tools/perf_floor.json"
+for arg in "$@"; do
+    case "$arg" in
+        --rebaseline) REBASE="--rebaseline" ;;
+        daemons)
+            export RAY_TPU_CLUSTER=daemons
+            FLOOR="tools/perf_floor_daemons.json"
+            ;;
+        local|in-process) ;;
+        *) echo "unknown argument: $arg" >&2; exit 2 ;;
+    esac
+done
+export JAX_PLATFORMS=cpu
+export RAY_TPU_LOG_TO_DRIVER=0
+export PERF_SMOKE_FLOOR="$FLOOR"
+
+python - $REBASE <<'EOF'
+import json
+import os
+import sys
+import time
+
+FLOOR_PATH = os.environ["PERF_SMOKE_FLOOR"]
+TOLERANCE = 0.30    # fail on >30% regression vs the committed floor
+rebaseline = "--rebaseline" in sys.argv
+
+import ray_tpu  # noqa: E402
+
+ray_tpu.init(num_nodes=1, resources={"CPU": 8})
+
+
+@ray_tpu.remote
+def noop():
+    return None
+
+
+@ray_tpu.remote(num_returns=2)
+def duo():
+    return None, None
+
+
+results = {}
+
+# probe 1: round-trip tasks/s (~4s)
+ray_tpu.get([noop.remote() for _ in range(100)])    # warm
+t0 = time.perf_counter()
+count = 0
+while time.perf_counter() - t0 < 4.0:
+    ray_tpu.get([noop.remote() for _ in range(100)])
+    count += 100
+results["tasks_per_second"] = round(count / (time.perf_counter() - t0), 1)
+
+# probe 2: queued burst submit rate (3k tasks, ~2-4s)
+t0 = time.perf_counter()
+refs = [noop.remote() for _ in range(3000)]
+results["queued_submit_per_s"] = round(3000 / (time.perf_counter() - t0), 1)
+ray_tpu.get(refs)
+
+# probe 3: batched classic-path burst — exercises the submit coalescer
+# wire path when this script is invoked with the `daemons` mode
+# (process workers in-process otherwise)
+t0 = time.perf_counter()
+refs = [duo.remote() for _ in range(600)]
+ray_tpu.get([r for ab in refs for r in ab])
+results["burst_batched_per_s"] = round(600 / (time.perf_counter() - t0), 1)
+
+ray_tpu.shutdown()
+print(json.dumps(results, indent=2))
+
+if rebaseline:
+    with open(FLOOR_PATH, "w") as fh:
+        json.dump(results, fh, indent=2)
+        fh.write("\n")
+    print(f"wrote {FLOOR_PATH}")
+    sys.exit(0)
+
+try:
+    with open(FLOOR_PATH) as fh:
+        floors = json.load(fh)
+except FileNotFoundError:
+    print(f"no {FLOOR_PATH}; run tools/perf_smoke.sh "
+          f"[daemons] --rebaseline")
+    sys.exit(1)
+
+failed = False
+for name, floor in floors.items():
+    got = results.get(name, 0.0)
+    limit = floor * (1.0 - TOLERANCE)
+    verdict = "ok" if got >= limit else "REGRESSION"
+    print(f"{name}: {got:,.0f}/s vs floor {floor:,.0f}/s "
+          f"(min {limit:,.0f}/s) {verdict}")
+    if got < limit:
+        failed = True
+sys.exit(1 if failed else 0)
+EOF
