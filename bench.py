@@ -235,6 +235,75 @@ def _control_plane_probe(duration_s: float = 1.5) -> float:
                 pass
 
 
+def _tracing_overhead_probe() -> float:
+    """Tracing overhead on the control-plane loop: balanced-order
+    spans-on/spans-off pairs in one cluster, median of per-pair ratios
+    (the methodology tools/perf_smoke.sh probe 4 uses; docs/
+    observability.md budgets this at <=5%). Best-effort: a failure must
+    never cost the benchmark its tokens/s line."""
+    import statistics
+
+    own = False
+    prev_overrides = None
+    try:
+        import ray_tpu
+        from ray_tpu._private import config as _config
+        from ray_tpu._private.config import apply_system_config
+
+        own = not ray_tpu.is_initialized()
+        if own:
+            ray_tpu.init(num_nodes=1, resources={"CPU": 4})
+        # apply_system_config REPLACES the whole override table: capture
+        # the caller's overrides so the probe's flag flips don't clobber
+        # them (and a mid-probe failure can't leave tracing disabled)
+        cur = _config._config
+        prev_overrides = dict(cur._system) if cur is not None else {}
+
+        @ray_tpu.remote
+        def _noop():
+            return None
+
+        def burst() -> float:
+            t0 = time.perf_counter()
+            ray_tpu.get([_noop.remote() for _ in range(150)])
+            return 150 / (time.perf_counter() - t0)
+
+        ray_tpu.get([_noop.remote() for _ in range(50)])    # warm
+
+        def flip(on: bool) -> None:
+            apply_system_config({**prev_overrides, "task_trace": on})
+
+        ratios = []
+        for i in range(3):
+            if i % 2 == 0:
+                flip(True)
+                r_on = burst()
+                flip(False)
+                r_off = burst()
+            else:
+                flip(False)
+                r_off = burst()
+                flip(True)
+                r_on = burst()
+            ratios.append(r_on / r_off)
+        return round(
+            max(0.0, (1.0 - statistics.median(ratios)) * 100.0), 1)
+    except Exception:
+        return 0.0
+    finally:
+        if prev_overrides is not None:
+            try:
+                from ray_tpu._private.config import apply_system_config
+                apply_system_config(prev_overrides or None)
+            except Exception:
+                pass
+        if own:
+            try:
+                ray_tpu.shutdown()
+            except Exception:
+                pass
+
+
 def _child() -> int:
     """Run the actual benchmark and print its JSON line."""
     if os.environ.get("BENCH_FORCE_CPU") == "1":
@@ -254,7 +323,11 @@ def _child() -> int:
         result = _run_train(error)
     if os.environ.get("BENCH_CONTROL_PLANE", "1") != "0":
         result["control_plane"] = {
-            "tasks_per_second": _control_plane_probe()}
+            "tasks_per_second": _control_plane_probe(),
+            # spans-on vs spans-off delta, paired + median-of-ratios in
+            # ONE cluster (sequential unpaired probes are a noise
+            # lottery on shared hosts — see tools/perf_smoke.sh probe 4)
+            "tracing_overhead_pct": _tracing_overhead_probe()}
     print(json.dumps(result))
     return 0
 

@@ -227,8 +227,15 @@ class _SubmitCoalescer:
                 if act is _fp.DROP:
                     continue        # frame lost pre-send; retry
             try:
+                # linger end is sampled BEFORE the RPC: the phase is
+                # "enqueue -> frame leaving on the wire" — measuring
+                # after the reply would fold the round trip + daemon
+                # frame handling into linger AND double-count it
+                # against the daemon's dispatch span
+                flush_mono = time.perf_counter()
                 handle.client.call("push_task_batch", tasks=batch,
                                    fns=fns, timeout=None)
+                self._record_linger(batch, flush_mono)
             except rpc.RemoteError as e:
                 if "no such method" in str(e):
                     # old daemon without the batch handler: fall back
@@ -249,6 +256,31 @@ class _SubmitCoalescer:
         # retries exhausted (persistent injected failure): surface as a
         # daemon-level failure so task retry accounting engages
         handle.mark_dead()
+
+    def _record_linger(self, batch: List[Dict[str, Any]],
+                       now: float) -> None:
+        """linger phase: coalescer enqueue -> the batch frame leaving on
+        the wire, per sampled task (driver lane). ``now`` is the
+        pre-send perf_counter reading — one clock read per batch."""
+        try:
+            from ray_tpu._private import events as _events
+            from ray_tpu._private import worker as _worker
+            rt = _worker.global_runtime()
+            buf = getattr(rt, "task_events", None) if rt else None
+            node_hex = self.handle.node_id.hex()
+            for entry in batch:
+                t_enq = entry.get("t_enq")
+                if t_enq is None:
+                    continue
+                dur = max(now - t_enq, 0.0)
+                _events.record_phase(
+                    buf, task_id=entry["task"],
+                    name=entry.get("name", ""), phase="linger",
+                    dur_s=dur, node_id=node_hex, proc="driver",
+                    trace_id=entry.get("trace", ""),
+                    start_wall=_events.wall_at(t_enq), end_mono=now)
+        except Exception:
+            pass    # observability must never fail a flush
 
     def _flush_per_task(self, batch: List[Dict[str, Any]]) -> None:
         """Compatibility path: one submit_task RPC per entry."""
@@ -656,18 +688,26 @@ class DaemonHandle:
                 raise DaemonCrashed(
                     f"daemon {self.node_id.hex()[:8]} is dead")
             self._batch_waiters[task_hex] = slot
+        entry = {
+            "task": task_hex,
+            # retries reuse the task id: the daemon's duplicate-frame
+            # dedupe keys on (task, attempt) so a retry EXECUTES
+            # instead of replaying the previous attempt's outcome
+            "attempt": spec.attempt_number,
+            "spec": _slim_spec_blob(spec),
+            "fid": fid,
+            "args": args_blob,
+            "backpressure": spec.backpressure_num_objects,
+        }
+        if getattr(spec, "trace_sampled", False):
+            # linger-phase span inputs — attached ONLY for sampled
+            # tasks so unsampled/untraced submissions pay zero extra
+            # wire bytes and no clock read (the daemon ignores them)
+            entry["t_enq"] = time.perf_counter()
+            entry["name"] = spec.name
+            entry["trace"] = spec.trace_id
         try:
-            batch.enqueue({
-                "task": task_hex,
-                # retries reuse the task id: the daemon's duplicate-frame
-                # dedupe keys on (task, attempt) so a retry EXECUTES
-                # instead of replaying the previous attempt's outcome
-                "attempt": spec.attempt_number,
-                "spec": _slim_spec_blob(spec),
-                "fid": fid,
-                "args": args_blob,
-                "backpressure": spec.backpressure_num_objects,
-            })
+            batch.enqueue(entry)
         except DaemonCrashed:
             with self._bw_lock:
                 self._batch_waiters.pop(task_hex, None)
@@ -1247,6 +1287,15 @@ class ClusterBackend:
                 job_hex = self.runtime.job_id.hex()
                 for ev in batch:
                     ev.setdefault("job_id", job_hex)
+                if _fp.ENABLED:
+                    try:
+                        # drop/error arm = flush lost in transit; the
+                        # un-advanced cursor re-sends next interval
+                        if _fp.fire("trace.flush",
+                                    n=len(batch)) is _fp.DROP:
+                            return
+                    except Exception:
+                        return
                 try:
                     self.head.task_events_push(batch)
                 except rpc.RpcError:

@@ -103,6 +103,14 @@ FLAG_DEFS = [
     Flag("export_events", bool, False, "write structured task/actor/node/"
          "job/train/PG lifecycle events as JSONL under the session dir "
          "(export_*.proto role)"),
+    Flag("task_trace", bool, True, "stamp a trace context into every "
+         "task and record per-phase latency spans (submit/linger/queue/"
+         "dispatch/exec/result) on every process; spans feed `ray_tpu "
+         "timeline`, util.state.task_breakdown, and the "
+         "ray_tpu_task_phase_seconds histogram (docs/observability.md)"),
+    Flag("trace_sample", float, 1.0, "fraction of tasks traced when "
+         "task_trace is on; sampling is deterministic in the task id so "
+         "driver, daemon, and worker agree per task (1.0 = every task)"),
     # -- accelerator topology --
     Flag("tpu_topology", str, "", "TPU slice topology for ICI-aware gang "
          "scheduling, '<gen>:<AxBxC>' (e.g. 'v5p:4x4x4'); '' = no "

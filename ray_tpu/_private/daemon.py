@@ -544,6 +544,12 @@ class DaemonRuntime:
 
         self.process_router = ProcessRouter(self)
 
+    @property
+    def task_events(self):
+        """Span sink for this daemon's workers (trace_push lands here;
+        the heartbeat loop flushes it to the head)."""
+        return self.service.task_events
+
     def forward_core_op(self, msg: Dict[str, Any]) -> Tuple[bool, bytes]:
         owner = self.service.owner
         if owner is None:
@@ -579,6 +585,11 @@ class DaemonService:
                                    object_store_bytes)
         self.owner: Optional[Client] = None
         self.driver_conn: Optional[Connection] = None
+        # per-process span buffer (task_event_buffer.cc role): daemon
+        # dispatch spans + this daemon's worker exec spans, flushed to
+        # the head's task-event store on heartbeats (main loop)
+        from ray_tpu._private.events import TaskEventBuffer
+        self.task_events = TaskEventBuffer(capacity=50_000)
         self.runtime = DaemonRuntime(self)
         self.node_stub = _NodeStub(self.node_id)
         self._lock = threading.Lock()
@@ -922,6 +933,7 @@ class DaemonService:
         (``transport/normal_task_submitter.cc:140``)."""
         from ray_tpu._private import worker_process as wp
 
+        msg["_t0"] = time.perf_counter()    # dispatch-phase span start
         client = wp.acquire_worker()
         client.raw_outcomes = True
         client.runtime = self.runtime
@@ -958,6 +970,7 @@ class DaemonService:
             # task id but must execute — only a resent frame of the
             # SAME attempt is a duplicate
             key = (entry["task"], entry.get("attempt", 0))
+            entry["_t0"] = time.perf_counter()  # dispatch-phase span
             with self._lock:
                 if key in self._batch_running:
                     continue        # duplicate of an in-flight task
@@ -1012,6 +1025,7 @@ class DaemonService:
         """Execute on the leased worker; replies with the outcome. Big
         results go to the object table and return as a location; streams
         flow back as task_yield/task_result pushes."""
+        msg["_t0"] = time.perf_counter()    # dispatch-phase span start
         client = self._leased(msg["lease_id"])
         return self._run_pushed_task(conn, rid, msg, client,
                                      lease_id=msg["lease_id"])
@@ -1048,6 +1062,20 @@ class DaemonService:
                     # crash arm here kills the DAEMON mid-push (node
                     # death); error arm fails just this task's push
                     _fp.fire("daemon.push_task", task=task_hex)
+                t0 = msg.get("_t0")
+                if t0 is not None and getattr(spec, "trace_sampled",
+                                              False):
+                    # dispatch phase: frame arrival -> exec request to
+                    # the worker (daemon queue wait + worker acquire)
+                    from ray_tpu._private import events as _events
+                    now = time.perf_counter()
+                    _events.record_phase(
+                        self.task_events, task_id=task_hex,
+                        name=spec.name, phase="dispatch",
+                        dur_s=now - t0, node_id=self.node_id.hex(),
+                        proc=f"daemon:{self.node_id.hex()[:8]}",
+                        trace_id=getattr(spec, "trace_id", ""),
+                        start_wall=_events.wall_at(t0), end_mono=now)
                 wrid, pend = client._request({
                     "op": "execute_task", "fn_id": msg["fid"],
                     "args_blob": msg["args"],
@@ -1880,10 +1908,53 @@ def main() -> None:
         except (OSError, rpc.RpcError):
             return None     # head stayed down past the grace window
 
+    # Observability piggyback state: span-flush cursor into this
+    # daemon's TaskEventBuffer (advanced only after a delivered beat, so
+    # a lost frame retries) and the metric-snapshot cadence (absolute
+    # snapshots — a re-send replaces, never double-counts).
+    trace_cursor = 0
+    last_metrics_push = 0.0
+    last_trace_push = 0.0
+    _METRICS_PUSH_S = 1.0
+    _TRACE_PUSH_S = 0.5     # span-flush cadence: bounds head-store
+    _TRACE_BATCH_MAX = 2000  # write rate under bursty task loads
+
     while True:  # heartbeat loop; exit if the head declared us dead
         time.sleep(_hb_interval())
+        span_batch = []
+        if time.monotonic() - last_trace_push >= _TRACE_PUSH_S:
+            span_batch = service.task_events.events_after(trace_cursor)
+            span_batch = span_batch[:_TRACE_BATCH_MAX]
+        if span_batch and _fp.ENABLED:
+            try:
+                # drop/error arm = this flush is lost in transit; the
+                # un-advanced cursor re-sends the batch next beat
+                if _fp.fire("trace.flush",
+                            n=len(span_batch)) is _fp.DROP:
+                    span_batch = []
+            except Exception:
+                span_batch = []
+        snapshot = None
+        if time.monotonic() - last_metrics_push >= _METRICS_PUSH_S:
+            try:
+                from ray_tpu.util.metrics import export_snapshot
+                snapshot = export_snapshot()
+            except Exception:
+                snapshot = None
         try:
-            out = head.heartbeat(args.node_id, resources)
+            out = head.heartbeat(args.node_id, resources,
+                                 wall_ts=time.time(),
+                                 events=span_batch, metrics=snapshot)
+            # advance the cursor ONLY on an acknowledged beat: an
+            # "unknown" reply (restarted head, pre-re-register) returns
+            # BEFORE ingesting the events — advancing would lose the
+            # batch for good instead of re-sending after re-register
+            if out.get("ok"):
+                if span_batch:
+                    trace_cursor = span_batch[-1]["seq"]
+                    last_trace_push = time.monotonic()
+                if snapshot is not None:
+                    last_metrics_push = time.monotonic()
         except rpc.RpcError:
             head.close()
             new_head = reconnect()

@@ -1,8 +1,19 @@
-"""Task event buffer + chrome-trace timeline export.
+"""Task event + span buffer, chrome-trace timeline export, and the
+per-phase latency instrumentation helpers.
 
 Reference: `src/ray/core_worker/task_event_buffer.cc` (per-worker event
 buffering) → `gcs/gcs_task_manager.h:94` (cluster task events) →
 `ray timeline` chrome-trace dump (`_private/state.py:438`).
+
+Beyond plain lifecycle events (RUNNING/FINISHED/...), the buffer holds
+``SPAN`` events: per-phase latency slices recorded at every lifecycle
+seam on every process (driver submit/linger/queue/result, daemon
+dispatch, worker exec). Each process buffers its own spans; daemons and
+their workers flush to the head's task-event store by piggybacking on
+heartbeats (``daemon.py`` main loop, ``trace.flush`` failpoint), the
+driver flushes through ``ClusterBackend.start_task_event_flusher``. The
+head applies a per-node clock offset on ingestion so a merged timeline
+(:func:`merged_chrome_trace`) lines up lanes from different hosts.
 """
 
 from __future__ import annotations
@@ -11,44 +22,182 @@ import json
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterable, List, Optional
+
+# The six per-task phases surfaced by ``task_breakdown`` and the
+# ``ray_tpu_task_phase_seconds`` histogram:
+#   submit   driver: ``submit_task`` entry -> node backlog enqueue
+#   linger   driver: submit-coalescer enqueue -> batch flush on the wire
+#   queue    driver: node backlog enqueue -> dispatch-loop admission
+#   dispatch daemon: task frame arrival -> exec request sent to a worker
+#   exec     worker: user function body (start -> finish)
+#   result   driver: outcome decoded -> return futures completed
+PHASES = ("submit", "linger", "queue", "dispatch", "exec", "result")
+
+# Process-stable wall<->monotonic anchor: spans convert the monotonic
+# timestamps their callers ALREADY hold into wall time arithmetically,
+# instead of issuing extra clock reads per event — on sandboxed/traced
+# kernels a clock syscall under thread contention costs 100x its normal
+# price, and the span hot paths run on submit/dispatch/reader threads.
+_MONO0 = time.perf_counter()
+_WALL0 = time.time()
+
+
+def wall_at(mono: float) -> float:
+    """Wall-clock time of a ``time.perf_counter()`` reading (anchored at
+    import; drift over a process lifetime is negligible for tracing)."""
+    return _WALL0 + (mono - _MONO0)
 
 
 class TaskEventBuffer:
-    """Ring buffer of task lifecycle events."""
+    """Ring buffer of task lifecycle + span events.
+
+    Two lanes share one sequence counter:
+
+    - **lifecycle lane** (``record``): dict events under a lock — the
+      pre-existing RUNNING/FINISHED/... path, low rate per task.
+    - **span lane** (``record_span``): LOCK-FREE tuple appends.
+      Per-phase spans fire several times per task from the submit,
+      dispatch, worker-pump, and reader threads at once; a shared lock
+      there turns into futex convoys (catastrophic on syscall-traced
+      sandbox kernels). ``deque.append`` is GIL-atomic and
+      ``itertools.count`` hands out seqs without a lock; tuples
+      materialize into event dicts only at read time (flushes/queries,
+      ~1/s). Readers retry the rare iteration-vs-append race.
+    """
 
     def __init__(self, capacity: int = 100_000):
+        import itertools
         self._events: deque = deque(maxlen=capacity)
+        self._spans: deque = deque(maxlen=capacity)
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
-        self._seq = 0
+        self._seq_counter = itertools.count(1)
 
     def record(self, *, task_id: str, name: str, event: str,
                node_id: str = "", actor_id: str = "",
-               extra: Optional[Dict] = None) -> None:
+               extra: Optional[Dict] = None,
+               mono: Optional[float] = None) -> None:
+        """``mono`` is an optional pre-read ``perf_counter()`` timestamp:
+        callers that already hold one save the event its clock reads."""
+        if mono is None:
+            mono = time.perf_counter()
         with self._lock:
-            self._seq += 1
+            # seq INSIDE the lock: taken outside, a preempted recorder
+            # could append after a flush advanced the cursor past its
+            # seq — the event would be skipped forever. (The span lane
+            # accepts that nanosecond window as its lock-free tradeoff;
+            # lifecycle transitions must not.)
             self._events.append({
-                "seq": self._seq,
+                "seq": next(self._seq_counter),
                 "task_id": task_id, "name": name, "event": event,
                 "node_id": node_id, "actor_id": actor_id,
-                "ts_us": (time.perf_counter() - self._t0) * 1e6,
-                "wall_ts": time.time(),
+                "ts_us": (mono - self._t0) * 1e6,
+                "wall_ts": wall_at(mono),
                 **(extra or {})})
+
+    def record_span(self, *, task_id: str, name: str, phase: str,
+                    dur_s: float, node_id: str = "", proc: str = "",
+                    trace_id: str = "",
+                    start_wall: Optional[float] = None,
+                    end_mono: Optional[float] = None,
+                    end_wall: Optional[float] = None) -> None:
+        """One per-phase latency slice (event type ``SPAN``); lock-free.
+        ``end_wall`` is for spans ingested from ANOTHER process (their
+        wall clock is authoritative); local recorders pass/let default
+        ``end_mono`` and the wall time derives at materialization."""
+        if end_mono is None and end_wall is None:
+            end_mono = time.perf_counter()
+        self._spans.append((
+            next(self._seq_counter), task_id, name, phase,
+            float(dur_s), node_id, proc, trace_id, start_wall,
+            end_mono, end_wall))
+
+    def _materialize(self, t) -> Dict[str, Any]:
+        (seq, task_id, name, phase, dur_s, node_id, proc, trace_id,
+         start_wall, end_mono, end_wall) = t
+        if end_wall is None:
+            end_wall = wall_at(end_mono)
+        if start_wall is None:
+            start_wall = end_wall - dur_s
+        return {"seq": seq, "task_id": task_id, "name": name,
+                "event": "SPAN", "node_id": node_id,
+                "wall_ts": end_wall, "phase": phase, "dur_s": dur_s,
+                "proc": proc, "trace_id": trace_id,
+                "start_wall": start_wall}
+
+    def _span_snapshot(self) -> list:
+        # lock-free writers can mutate mid-iteration; list() is C-speed,
+        # so a few retries always win. Give up empty (next read catches
+        # up — the flush cursor only advances on what it actually saw).
+        for _ in range(16):
+            try:
+                return list(self._spans)
+            except RuntimeError:
+                continue
+        return []
+
+    def extend(self, events: Iterable[Dict[str, Any]]) -> None:
+        """Append foreign events (another process's flush) preserving
+        their order; sequence numbers are re-assigned locally so
+        ``events_after`` cursors stay monotonic."""
+        with self._lock:
+            for ev in events:
+                e = dict(ev)
+                e["seq"] = next(self._seq_counter)
+                self._events.append(e)
+
+    @classmethod
+    def from_events(cls, events: List[Dict[str, Any]],
+                    capacity: int = 100_000) -> "TaskEventBuffer":
+        buf = cls(capacity=max(capacity, len(events) or 1))
+        buf.extend(events)
+        return buf
 
     def events(self) -> List[Dict[str, Any]]:
         with self._lock:
-            return list(self._events)
+            out = list(self._events)
+        out.extend(self._materialize(t) for t in self._span_snapshot())
+        out.sort(key=lambda e: e["seq"])
+        return out
 
     def events_after(self, cursor: int) -> List[Dict[str, Any]]:
         """Events with seq > cursor (the head-store flusher's incremental
-        read; reference: task_event_buffer.cc periodic flush)."""
+        read; reference: task_event_buffer.cc periodic flush). Seqs are
+        assigned near-contiguously, so walk back from the TAIL and stop
+        shortly past the cursor — O(new events), not a full O(n) deque
+        scan per flush. (The small slack absorbs the lock-free span
+        lane's momentary append disorder.)"""
+        out: List[Dict[str, Any]] = []
+        slack = cursor - 64
         with self._lock:
-            return [ev for ev in self._events if ev["seq"] > cursor]
+            for ev in reversed(self._events):
+                if ev["seq"] <= slack:
+                    break
+                if ev["seq"] > cursor:
+                    out.append(ev)
+        spans = self._span_snapshot()
+        stale_run = 0
+        for t in reversed(spans):
+            if t[0] <= slack:
+                # don't break on the FIRST stale item: one late
+                # lock-free append can park a low seq at the tail, and
+                # breaking there would hide every unsent span behind it
+                # forever. A RUN of stale items is the real boundary.
+                stale_run += 1
+                if stale_run > 8:
+                    break
+                continue
+            stale_run = 0
+            if t[0] > cursor:
+                out.append(self._materialize(t))
+        out.sort(key=lambda e: e["seq"])
+        return out
 
     def clear(self) -> None:
         with self._lock:
             self._events.clear()
+            self._spans.clear()
 
     # -- chrome trace ----------------------------------------------------
     def chrome_trace(self) -> List[Dict[str, Any]]:
@@ -56,9 +205,16 @@ class TaskEventBuffer:
         started: Dict[str, Dict] = {}
         slices: List[Dict[str, Any]] = []
         for ev in self.events():
-            if ev["event"] == "RUNNING":
+            kind = ev["event"]
+            if kind == "RUNNING":
+                # A second RUNNING for the same task is a RETRY's fresh
+                # attempt: the stale start (whose attempt died without a
+                # terminal event) is dropped so the retry's FINISHED
+                # pairs with ITS OWN start, not the dead attempt's.
                 started[ev["task_id"]] = ev
-            elif ev["event"] in ("FINISHED", "FAILED"):
+            elif kind in ("RETRY", "RETRY_OOM"):
+                started.pop(ev["task_id"], None)
+            elif kind in ("FINISHED", "FAILED"):
                 beg = started.pop(ev["task_id"], None)
                 if beg is None:
                     continue
@@ -70,7 +226,7 @@ class TaskEventBuffer:
                     "dur": max(ev["ts_us"] - beg["ts_us"], 1.0),
                     "pid": ev["node_id"][:8] or "driver",
                     "tid": ev["task_id"][:8],
-                    "args": {"status": ev["event"]},
+                    "args": {"status": kind},
                 })
         return slices
 
@@ -78,3 +234,165 @@ class TaskEventBuffer:
         with open(path, "w") as f:
             json.dump(self.chrome_trace(), f)
         return path
+
+
+def merged_chrome_trace(events: List[Dict[str, Any]]
+                        ) -> List[Dict[str, Any]]:
+    """Cluster-wide chrome trace over MERGED events (driver buffer +
+    head store): one lane (chrome ``pid``) per recording process
+    (driver / daemon:<node> / worker:<pid>), wall-clock timebase with
+    the head's per-node clock offset already applied at ingestion."""
+    slices: List[Dict[str, Any]] = []
+    started: Dict[tuple, Dict] = {}
+    for ev in sorted(events, key=lambda e: e.get("wall_ts", 0.0)):
+        kind = ev.get("event")
+        proc = ev.get("proc") or "driver"
+        task = ev.get("task_id", "")
+        if kind == "SPAN":
+            dur_s = float(ev.get("dur_s", 0.0))
+            start = float(ev.get("start_wall",
+                                 ev.get("wall_ts", 0.0) - dur_s))
+            slices.append({
+                "name": f"{ev.get('phase', 'span')}:"
+                        f"{ev.get('name') or task[:8]}",
+                "cat": "phase", "ph": "X",
+                "ts": start * 1e6,
+                "dur": max(dur_s * 1e6, 1.0),
+                "pid": proc, "tid": task[:8],
+                "args": {"phase": ev.get("phase"), "task_id": task,
+                         "trace_id": ev.get("trace_id", ""),
+                         "node_id": ev.get("node_id", ""),
+                         "clock_off": ev.get("clock_off", 0.0)},
+            })
+        elif kind == "RUNNING":
+            started[(proc, task)] = ev
+        elif kind in ("RETRY", "RETRY_OOM"):
+            started.pop((proc, task), None)
+        elif kind in ("FINISHED", "FAILED"):
+            beg = started.pop((proc, task), None)
+            if beg is None:
+                continue
+            slices.append({
+                "name": ev.get("name") or task[:8],
+                "cat": "task", "ph": "X",
+                "ts": beg.get("wall_ts", 0.0) * 1e6,
+                "dur": max((ev.get("wall_ts", 0.0)
+                            - beg.get("wall_ts", 0.0)) * 1e6, 1.0),
+                "pid": proc, "tid": task[:8],
+                "args": {"status": kind,
+                         "node_id": ev.get("node_id", "")},
+            })
+    return slices
+
+
+# ---------------------------------------------------------------------------
+# trace context + phase instrumentation
+# ---------------------------------------------------------------------------
+
+def stamp_trace(spec) -> None:
+    """Stamp the trace context into a TaskSpec at submission time (the
+    context rides the spec across the wire to daemons and workers).
+    Sampling is deterministic in the task id so every process agrees."""
+    from ray_tpu._private import config as _config
+    c = _config._config        # lock-free fast path (identity-stable
+    if c is None:              # until apply_system_config/reset)
+        c = _config.cfg()
+    if not c.task_trace:
+        return
+    rate = c.trace_sample
+    if rate <= 0.0:
+        return
+    if rate < 1.0:
+        frac = int(spec.task_id.hex()[:8], 16) / 0xFFFFFFFF
+        if frac >= rate:
+            return
+    spec.trace_sampled = True
+    if not spec.trace_id:
+        spec.trace_id = spec.task_id.hex()[:16]
+    spec.submit_mono = time.perf_counter()
+    spec.submit_wall = wall_at(spec.submit_mono)
+
+
+_PHASE_HIST = None
+
+
+def phase_histogram():
+    """The per-phase latency histogram. Cached module-locally (the
+    get-or-create registry path costs a lock per call on the span hot
+    path); a cleared registry re-materializes it on the next call."""
+    global _PHASE_HIST
+    from ray_tpu.util import metrics as _metrics
+    h = _PHASE_HIST
+    if h is not None and _metrics._REGISTRY.get(h.name) is h:
+        return h
+    h = _metrics.Histogram(
+        "ray_tpu_task_phase_seconds",
+        "per-phase task latency: submit|linger|queue|dispatch|exec|result",
+        boundaries=(0.0005, 0.005, 0.05, 0.5, 5.0),
+        tag_keys=("phase", "node_id"))
+    _PHASE_HIST = h
+    return h
+
+
+def record_phase(buf: Optional[TaskEventBuffer], *, task_id: str,
+                 name: str, phase: str, dur_s: float, node_id: str,
+                 proc: str, trace_id: str = "",
+                 start_wall: Optional[float] = None,
+                 end_mono: Optional[float] = None) -> None:
+    """Append one span to ``buf`` (when given) and feed the phase
+    histogram. Never raises: observability must not fail the task."""
+    try:
+        if buf is not None:
+            buf.record_span(task_id=task_id, name=name, phase=phase,
+                            dur_s=dur_s, node_id=node_id, proc=proc,
+                            trace_id=trace_id, start_wall=start_wall,
+                            end_mono=end_mono)
+        phase_histogram().observe(dur_s, tags={"phase": phase,
+                                               "node_id": node_id})
+    except Exception:
+        pass
+
+
+def record_phase_rt(spec, phase: str, dur_s: float, node_id: str,
+                    start_wall: Optional[float] = None,
+                    end_mono: Optional[float] = None) -> None:
+    """Driver-side convenience: record into the global runtime's buffer
+    with lane ``driver``."""
+    from ray_tpu._private import worker as _worker
+    rt = _worker.global_runtime()
+    buf = getattr(rt, "task_events", None) if rt is not None else None
+    record_phase(buf, task_id=spec.task_id.hex(), name=spec.name,
+                 phase=phase, dur_s=dur_s, node_id=node_id,
+                 proc="driver", trace_id=getattr(spec, "trace_id", ""),
+                 start_wall=start_wall, end_mono=end_mono)
+
+
+def ingest_span_events(buf: Optional[TaskEventBuffer],
+                       events: List[Dict[str, Any]]) -> None:
+    """Merge span events flushed from another process (worker exec
+    spans riding result frames) into this process's buffer and
+    histogram. SPAN events take the lock-free span lane — this runs on
+    the hot reader threads — keeping their ORIGIN wall clock."""
+    if not events:
+        return
+    hist = phase_histogram()
+    for ev in events:
+        if ev.get("event") == "SPAN" and ev.get("phase"):
+            if buf is not None:
+                buf.record_span(
+                    task_id=ev.get("task_id", ""),
+                    name=ev.get("name", ""), phase=ev["phase"],
+                    dur_s=float(ev.get("dur_s", 0.0)),
+                    node_id=ev.get("node_id", ""),
+                    proc=ev.get("proc", ""),
+                    trace_id=ev.get("trace_id", ""),
+                    start_wall=ev.get("start_wall"),
+                    end_wall=ev.get("wall_ts"))
+            try:
+                hist.observe(float(ev.get("dur_s", 0.0)),
+                             tags={"phase": ev["phase"],
+                                   "node_id": ev.get("node_id", "")})
+            except Exception:
+                pass
+        elif buf is not None:
+            buf.extend([ev])

@@ -67,7 +67,13 @@ def _dead_after() -> float:
 HEARTBEAT_S = 0.2
 
 declare("register_node", "node_id", "resources", "labels", "addr")
-declare("heartbeat", "node_id", "available")
+# heartbeat piggybacks observability: ``wall_ts`` (sender clock, for the
+# head's per-node clock-offset estimate), ``events`` (daemon/worker span
+# batch for the task-event store), ``metrics`` (absolute metric snapshot
+# federated into the cluster /metrics view) — all optional/empty.
+declare("heartbeat", "node_id", "available", "wall_ts", "events",
+        "metrics")
+declare("metrics_get")
 declare("list_nodes")
 declare("drain_node", "node_id", "deadline_s", "reason")
 declare("mark_node_dead", "node_id", "reason")
@@ -239,6 +245,12 @@ class HeadService:
         self._gossip_loads: Dict[str, Dict[str, Any]] = {}
         from collections import deque as _deque
         self._task_events: Any = _deque(maxlen=self._task_events_cap)
+        # metrics federation: node_id -> latest absolute metric snapshot
+        # shipped on that daemon's heartbeat (snapshot REPLACE, so a
+        # re-sent frame never double-counts); per-node clock offset
+        # (head wall - daemon wall) estimated from the same heartbeats.
+        self._node_metrics: Dict[str, List[Dict[str, Any]]] = {}
+        self._node_clock_off: Dict[str, float] = {}
         # node_id -> (wall-clock deadline, reason): drains survive a
         # head restart (membership does not, so the record re-attaches
         # when the draining daemon re-registers after the respawn).
@@ -295,8 +307,17 @@ class HeadService:
         return {"ok": True, "draining": entry.draining}
 
     def handle_heartbeat(self, conn, rid, msg):
+        node_id = msg["node_id"]
+        # clock-offset estimate (head wall - daemon wall at receipt; the
+        # half-RTT error is negligible next to cross-host clock skew):
+        # applied to every span the daemon flushes so the merged timeline
+        # shares ONE timebase.
+        off = 0.0
+        wall = float(msg.get("wall_ts") or 0.0)
+        if wall:
+            off = time.time() - wall
         with self._lock:
-            entry = self._nodes.get(msg["node_id"])
+            entry = self._nodes.get(node_id)
             if entry is None:
                 return {"ok": False, "unknown": True}
             entry.last_beat = time.monotonic()
@@ -308,12 +329,34 @@ class HeadService:
                 entry.available = msg["available"]
             was_dead = not entry.alive
             draining = entry.draining
+            if wall:
+                self._node_clock_off[node_id] = off
+            snapshot = msg.get("metrics")
+            if snapshot is not None:
+                self._node_metrics[node_id] = snapshot
         if was_dead:
             # A heartbeat from a node we declared dead: tell it to exit
             # (reference: raylets that lost GCS contact must not rejoin
             # with stale state).
             return {"ok": False, "dead": True}
-        return {"ok": True, "draining": draining}
+        events = msg.get("events") or []
+        if events:
+            for ev in events:
+                if off:
+                    ev["wall_ts"] = ev.get("wall_ts", 0.0) + off
+                    if "start_wall" in ev:
+                        ev["start_wall"] = ev["start_wall"] + off
+                ev["clock_off"] = off
+                ev.setdefault("node_id", node_id)
+            self._ingest_task_events(events)
+        return {"ok": True, "draining": draining,
+                "head_wall": time.time()}
+
+    def handle_metrics_get(self, conn, rid, msg):
+        """Federated per-node metric snapshots (daemon heartbeats)."""
+        with self._lock:
+            return {"nodes": {nid: snap for nid, snap
+                              in self._node_metrics.items()}}
 
     def handle_list_nodes(self, conn, rid, msg):
         with self._lock:
@@ -375,6 +418,11 @@ class HeadService:
             was_draining = entry.draining
             entry.draining = False
             self._drains.pop(node_id, None)
+            # a dead node's last metric snapshot must not keep being
+            # served as live by the cluster /metrics federation (and
+            # the dicts must not grow forever under node churn)
+            self._node_metrics.pop(node_id, None)
+            self._node_clock_off.pop(node_id, None)
             if self._store is not None:
                 self._store.delete(_DRAIN_KEY + node_id.encode())
         self._publish("node", {"kind": "death", "node_id": node_id,
@@ -494,14 +542,17 @@ class HeadService:
         return {"ok": True}
 
     # -- task events (reference: gcs_task_manager.h:94) ------------------
-    def handle_task_events_push(self, conn, rid, msg):
-        events = msg["events"]
+    def _ingest_task_events(self, events: List[Dict[str, Any]]) -> None:
         with self._lock:
             if self._store is not None:
                 self._store.append_task_events(events,
                                                self._task_events_cap)
             else:
                 self._task_events.extend(events)
+
+    def handle_task_events_push(self, conn, rid, msg):
+        events = msg["events"]
+        self._ingest_task_events(events)
         return {"ok": True, "count": len(events)}
 
     def handle_task_events_get(self, conn, rid, msg):
@@ -620,9 +671,19 @@ class HeadClient:
                           resources=resources, labels=labels,
                           addr=list(addr))
 
-    def heartbeat(self, node_id: str, available: Dict[str, float]):
+    def heartbeat(self, node_id: str, available: Dict[str, float],
+                  wall_ts: float = 0.0,
+                  events: Optional[List[Dict[str, Any]]] = None,
+                  metrics: Optional[List[Dict[str, Any]]] = None):
         return self._call("heartbeat", node_id=node_id,
-                          available=available, timeout=5.0)
+                          available=available, wall_ts=wall_ts,
+                          events=events or [], metrics=metrics,
+                          timeout=5.0)
+
+    def metrics_get(self) -> Dict[str, List[Dict[str, Any]]]:
+        """node_id -> latest federated metric snapshot. Bounded: a
+        wedged head must not hang a dashboard scrape thread forever."""
+        return self._call("metrics_get", timeout=5.0)["nodes"]
 
     def list_nodes(self) -> List[Dict[str, Any]]:
         return self._call("list_nodes")["nodes"]

@@ -460,6 +460,18 @@ class Node:
                             lag_ms = (t0 - spec.enqueued_at) * 1000
                             if lag_ms > self.loop_stats["max_queue_lag_ms"]:
                                 self.loop_stats["max_queue_lag_ms"] = lag_ms
+                            if getattr(spec, "trace_sampled", False):
+                                # queue phase: backlog enqueue ->
+                                # dispatch-loop admission. t0 is reused
+                                # as the span end: zero extra clock
+                                # reads on the dispatch thread.
+                                from ray_tpu._private import events as _ev
+                                _ev.record_phase_rt(
+                                    spec, "queue", lag_ms / 1000.0,
+                                    self.node_id.hex(),
+                                    start_wall=_ev.wall_at(
+                                        spec.enqueued_at),
+                                    end_mono=t0)
                         # count BEFORE launch: the task thread may finish
                         # (and a get() observe it) before control
                         # returns here

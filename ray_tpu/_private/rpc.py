@@ -88,6 +88,57 @@ def _validate(method: str, kw: Dict[str, Any]) -> None:
         raise RpcError(f"{method}: missing fields {missing}")
 
 
+# ---------------------------------------------------------------------------
+# wire instrumentation (reference: grpc server/client interceptors feeding
+# the metrics agent). Hot-path updates are PLAIN dict/int ops — a rare lost
+# increment under a race is acceptable for byte/frame counters; the
+# per-method request counters and the inflight gauge take the small lock.
+# Surfaced through the registry exposition via wire_metric_entries()
+# (metrics.export_snapshot), so daemon wire stats federate to the head.
+# ---------------------------------------------------------------------------
+
+_WIRE_LOCK = threading.Lock()
+_WIRE = {"bytes_sent": 0, "bytes_recv": 0,
+         "frames_sent": 0, "frames_recv": 0, "inflight": 0}
+_WIRE_CLIENT_REQS: Dict[str, int] = {}
+_WIRE_SERVER_REQS: Dict[str, int] = {}
+
+
+def wire_metric_entries() -> list:
+    """This process's wire counters as metric-snapshot entries (the
+    export_snapshot wire format: label keys as [[k, v], ...])."""
+    with _WIRE_LOCK:
+        client = dict(_WIRE_CLIENT_REQS)
+        server = dict(_WIRE_SERVER_REQS)
+        inflight = _WIRE["inflight"]
+    out = [
+        {"name": "ray_tpu_rpc_inflight", "kind": "gauge",
+         "description": "RPC requests awaiting a reply in this process",
+         "samples": [[[], inflight]]},
+        {"name": "ray_tpu_wire_bytes_total", "kind": "counter",
+         "description": "bytes moved on the control-plane wire",
+         "samples": [[[["direction", "sent"]], _WIRE["bytes_sent"]],
+                     [[["direction", "recv"]], _WIRE["bytes_recv"]]]},
+        {"name": "ray_tpu_wire_frames_total", "kind": "counter",
+         "description": "frames moved on the control-plane wire",
+         "samples": [[[["direction", "sent"]], _WIRE["frames_sent"]],
+                     [[["direction", "recv"]], _WIRE["frames_recv"]]]},
+    ]
+    if client:
+        out.append({
+            "name": "ray_tpu_rpc_client_requests_total", "kind": "counter",
+            "description": "outbound RPC requests by method",
+            "samples": [[[["method", m]], v]
+                        for m, v in sorted(client.items())]})
+    if server:
+        out.append({
+            "name": "ray_tpu_rpc_server_requests_total", "kind": "counter",
+            "description": "inbound RPC requests by method",
+            "samples": [[[["method", m]], v]
+                        for m, v in sorted(server.items())]})
+    return out
+
+
 # Above this size the `len + blob` concatenation copy costs more than a
 # second syscall: send header and payload as two sendalls under the lock
 # (zero extra copy); below it, one small concat + one syscall wins.
@@ -101,6 +152,8 @@ def send_frame_bytes(sock: socket.socket, blob, lock) -> None:
     n = len(blob)
     if n > MAX_FRAME:
         raise RpcError(f"frame too large: {n}")
+    _WIRE["bytes_sent"] += n + 4    # lossy-tolerant plain add (hot path)
+    _WIRE["frames_sent"] += 1
     if n <= SEND_CONCAT_MAX:
         with lock:
             sock.sendall(_LEN.pack(n) + blob)
@@ -136,6 +189,8 @@ def recv_exact(sock: socket.socket, n: int) -> bytearray:
 
 def _recv_frame(sock: socket.socket) -> Dict[str, Any]:
     (n,) = _LEN.unpack(recv_exact(sock, 4))
+    _WIRE["bytes_recv"] += n + 4    # lossy-tolerant plain add (hot path)
+    _WIRE["frames_recv"] += 1
     return msgpack.unpackb(recv_exact(sock, n), raw=False)
 
 
@@ -207,6 +262,18 @@ class Client:
         _validate(method, kw)
         if self.dead:
             raise RpcError(f"connection to {self.addr} is dead")
+        with _WIRE_LOCK:
+            _WIRE_CLIENT_REQS[method] = \
+                _WIRE_CLIENT_REQS.get(method, 0) + 1
+            _WIRE["inflight"] += 1
+        try:
+            return self._call_counted(method, timeout, kw)
+        finally:
+            with _WIRE_LOCK:
+                _WIRE["inflight"] -= 1
+
+    def _call_counted(self, method: str, timeout: Optional[float],
+                      kw: Dict[str, Any]) -> Dict[str, Any]:
         # failpoint BEFORE the pending slot exists: an error arm must
         # not leak a slot; a DROP arm skips the send so the caller times
         # out exactly like real frame loss
@@ -390,6 +457,9 @@ class Server:
                         "rpc.server.recv", method=method) is _fp.DROP:
                     continue    # request lost before dispatch
                 rid = msg.get("i")
+                with _WIRE_LOCK:
+                    _WIRE_SERVER_REQS[method] = \
+                        _WIRE_SERVER_REQS.get(method, 0) + 1
                 handler = getattr(self.service, f"handle_{method}", None)
                 if handler is None:
                     if rid is not None:

@@ -20,6 +20,7 @@ class _TaskContext:
     resources: Dict[str, float] = field(default_factory=dict)
     placement_group_id: Any = None
     pg_capture: bool = False  # placement_group_capture_child_tasks
+    trace: Optional[Dict[str, Any]] = None  # distributed trace context
 
 
 def _set_context(**kwargs):

@@ -172,6 +172,13 @@ class TaskSpec:
     # spawned worker process. Internal option set by Train/Serve/LLM.
     in_process: bool = False
     enqueued_at: float = 0.0
+    # distributed trace context (stamped by events.stamp_trace at submit;
+    # rides the slim spec to daemons/workers so every process records
+    # spans for the same trace): see docs/observability.md
+    trace_id: str = ""
+    trace_sampled: bool = False
+    submit_wall: float = 0.0
+    submit_mono: float = 0.0
     label_selector: Optional[Dict[str, Any]] = None
     runtime_env: Optional[Dict[str, Any]] = None
 

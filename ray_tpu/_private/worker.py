@@ -23,6 +23,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from ray_tpu import exceptions as exc
+from ray_tpu._private import events as trace_events
 from ray_tpu._private import runtime_context
 from ray_tpu._private.gcs import GCS, ActorInfo, ActorState, NodeInfo
 from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID, TaskID,
@@ -418,6 +419,9 @@ class Runtime:
             daemon_key, nbytes = value
             n = spec.num_returns
             if n == 1 or not isinstance(n, int):
+                t_result = (time.perf_counter()
+                            if getattr(spec, "trace_sampled", False)
+                            else 0.0)
                 oid = spec.return_ids[0]
                 node.store.register_remote(oid, daemon_key, nbytes)
                 with self._loc_lock:
@@ -427,6 +431,13 @@ class Runtime:
                                         name=spec.name, event="FINISHED")
                 self._release_task_resources(spec, node)
                 self.futures.complete(oid)
+                if t_result:
+                    now = time.perf_counter()
+                    trace_events.record_phase_rt(
+                        spec, "result", now - t_result,
+                        node.node_id.hex(),
+                        start_wall=trace_events.wall_at(t_result),
+                        end_mono=now)
                 self._on_task_done(spec, TaskState.FINISHED)
                 return
             # multi-return tuple stored remotely: fetch once and split
@@ -942,6 +953,7 @@ class Runtime:
     def submit_task(self, spec: TaskSpec,
                     record_lineage: bool = True) -> List[ObjectRef]:
         self.stats["tasks_submitted"] += 1
+        trace_events.stamp_trace(spec)
         refs = [ObjectRef(oid, owner_hex=self.worker_id.hex(),
                           task_name=spec.name) for oid in spec.return_ids]
         for oid in spec.return_ids:
@@ -1006,6 +1018,17 @@ class Runtime:
             return
         inflight.node_id = node.node_id
         node.enqueue(spec)
+        self._record_submit_phase(spec, node)
+
+    def _record_submit_phase(self, spec: TaskSpec, node: Node) -> None:
+        """submit phase: submit_task entry -> node backlog enqueue
+        (dependency waits + scheduler placement)."""
+        if getattr(spec, "trace_sampled", False) and spec.submit_mono:
+            now = time.perf_counter()
+            trace_events.record_phase_rt(
+                spec, "submit", now - spec.submit_mono,
+                node.node_id.hex(), start_wall=spec.submit_wall,
+                end_mono=now)
 
     def _fail_unschedulable(self, spec: TaskSpec,
                             error: exc.TaskError) -> None:
@@ -1096,6 +1119,7 @@ class Runtime:
             getattr(strat, "placement_group_capture_child_tasks", False))
         inflight.node_id = node.node_id
         node.enqueue(spec)
+        self._record_submit_phase(spec, node)
 
     def _locality_node(self, spec: TaskSpec) -> Optional[Node]:
         """Prefer the node holding the largest dependency (locality-aware)."""
@@ -1162,6 +1186,8 @@ class Runtime:
             pg_capture=spec.pg_capture)
         from ray_tpu.runtime_env import apply_runtime_env
         from ray_tpu.util.rpdb import post_mortem_on_error
+        sampled = getattr(spec, "trace_sampled", False)
+        t_exec0 = time.perf_counter() if sampled else 0.0
         try:
             with apply_runtime_env(spec.runtime_env), \
                     post_mortem_on_error():
@@ -1172,6 +1198,14 @@ class Runtime:
             return
         finally:
             runtime_context._reset_context(token)
+            if sampled:
+                # exec phase, driver lane (in-process/accelerator work
+                # runs in the mesh-owning process, not a worker)
+                now = time.perf_counter()
+                trace_events.record_phase_rt(
+                    spec, "exec", now - t_exec0, node.node_id.hex(),
+                    start_wall=trace_events.wall_at(t_exec0),
+                    end_mono=now)
         if spec.num_returns in ("streaming", "dynamic") or inspect.isgenerator(
                 result):
             self._drain_generator(spec, node, result)
@@ -1302,6 +1336,8 @@ class Runtime:
                 return
             self._fail_task(spec, error)
             return
+        t_result = (time.perf_counter()
+                    if getattr(spec, "trace_sampled", False) else 0.0)
         self.task_events.record(task_id=spec.task_id.hex(),
                                 name=spec.name, event="FINISHED")
         # Release the task's resources BEFORE completing the futures: a
@@ -1326,6 +1362,13 @@ class Runtime:
         for oid, value in zip(spec.return_ids, values):
             self._store_value(oid, value, prefer_node=node)
             self.futures.complete(oid)
+        if t_result:
+            # result phase: outcome in hand -> return futures completed
+            now = time.perf_counter()
+            trace_events.record_phase_rt(
+                spec, "result", now - t_result,
+                node.node_id.hex() if node is not None else "",
+                start_wall=trace_events.wall_at(t_result), end_mono=now)
         self._on_task_done(spec, TaskState.FINISHED)
 
     def _fail_task(self, spec: TaskSpec, error: exc.TaskError) -> None:

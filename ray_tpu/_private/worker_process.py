@@ -392,6 +392,7 @@ class _WorkerState:
             return slot[2]
         raise slot[2]
 
+
     # -- main loop -------------------------------------------------------
     def serve_forever(self) -> None:
         while True:
@@ -588,6 +589,32 @@ class _WorkerState:
         rid = msg["id"]
         _current_rid.rid = rid
         ctx = msg.get("ctx") or {}
+        # exec-phase span: the user function body measured IN the worker
+        # (the only process that can see it). It PIGGYBACKS on the result
+        # frame — zero extra pipe writes/pickles on the hot path — and
+        # the host ingests it into its span sink (daemon -> head via
+        # heartbeat; driver -> its own task-event buffer).
+        trace = (ctx.get("trace")
+                 if msg["op"] in ("execute_task", "call_method") else None)
+        t_exec0 = time.perf_counter() if trace else 0.0
+
+        def exec_span():
+            if not trace:
+                return None
+            from ray_tpu._private.events import wall_at
+            nid = ctx.get("node_id")
+            tid = ctx.get("task_id")
+            end = time.perf_counter()
+            return {
+                "task_id": tid.hex() if tid is not None else "",
+                "name": ctx.get("task_name", ""), "event": "SPAN",
+                "phase": "exec",
+                "node_id": nid.hex() if nid is not None else "",
+                "proc": f"worker:{os.getpid()}",
+                "trace_id": trace.get("id", ""),
+                "wall_ts": wall_at(end), "start_wall": wall_at(t_exec0),
+                "dur_s": end - t_exec0}
+
         try:
             token = runtime_context._set_context(**ctx)
             try:
@@ -667,6 +694,7 @@ class _WorkerState:
                             self._flush_metrics()   # before release
                             self.send({"id": rid, "op": "result",
                                        "ok": True,
+                                       "span": exec_span(),  # drain incl.
                                        "blob": _safe_dumps(None)})
                         finally:
                             self._gen_sems.pop(rid, None)
@@ -678,11 +706,13 @@ class _WorkerState:
             # in flight after that is lost
             self._flush_metrics()
             self.send({"id": rid, "op": "result", "ok": True,
+                       "span": exec_span(),
                        "blob": _safe_dumps(result)})
         except BaseException as e:  # noqa: BLE001 — shipped to host
             try:
                 self._flush_metrics()
                 self.send({"id": rid, "op": "result", "ok": False,
+                           "span": exec_span(),
                            "blob": _dump_exc(e)})
             except (BrokenPipeError, OSError):
                 os._exit(1)
@@ -1131,6 +1161,17 @@ class WorkerClient:
                 return
             op = msg.get("op")
             if op in ("result", "gen_start", "yield"):
+                if op == "result" and msg.get("span") is not None:
+                    # exec-phase span piggybacked on the result frame:
+                    # ingest into this host process's sink (daemon ->
+                    # head via heartbeat; driver -> its own buffer)
+                    try:
+                        from ray_tpu._private import events as _events
+                        _events.ingest_span_events(
+                            getattr(self.runtime, "task_events", None),
+                            [msg["span"]])
+                    except Exception:
+                        pass
                 with self._pending_lock:
                     pend = self._pending.get(msg["id"])
                 if pend is not None:
@@ -1346,6 +1387,8 @@ class WorkerClient:
             "task_name": spec.name,
             "placement_group_id": spec.placement_group_id,
             "pg_capture": spec.pg_capture,
+            "trace": ({"id": spec.trace_id}
+                      if getattr(spec, "trace_sampled", False) else None),
         }
 
     def execute_task(self, spec: TaskSpec, node, fid: str,

@@ -8,9 +8,15 @@ Endpoints (reference: dashboard modules `node`, `state`, `metrics`,
   GET /api/placement_groups   placement groups
   GET /api/objects            object table
   GET /api/cluster_status     resources + runtime stats summary
-  GET /api/timeline           chrome-trace JSON of task events
+  GET /api/timeline           MERGED chrome-trace JSON: driver, daemon,
+                              and worker lanes (head-store spans with
+                              clock correction applied)
   GET /api/config             resolved flag table + provenance
-  GET /metrics                Prometheus exposition
+  GET /api/metrics            cluster-wide metric samples as JSON
+  GET /metrics                CLUSTER-WIDE Prometheus exposition: this
+                              process's registry merged with every
+                              daemon's heartbeat-federated snapshot,
+                              node_id-labeled (the metrics-agent role)
 """
 
 from __future__ import annotations
@@ -44,7 +50,8 @@ class _DashboardHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         from ray_tpu._private import worker as _worker
         from ray_tpu.util import state as state_api
-        from ray_tpu.util.metrics import prometheus_text
+        from ray_tpu.util.metrics import (cluster_metrics_json,
+                                          cluster_prometheus_text)
 
         path = self.path.split("?")[0].rstrip("/")
         query = {}
@@ -69,7 +76,9 @@ class _DashboardHandler(BaseHTTPRequestHandler):
                 from ray_tpu.util.profiling import memory_snapshot
                 self._json(memory_snapshot())
             elif path == "/metrics":
-                self._text(prometheus_text())
+                self._text(cluster_prometheus_text())
+            elif path == "/api/metrics":
+                self._json(cluster_metrics_json())
             elif path == "/api/nodes":
                 self._json(state_api.list_nodes())
             elif path == "/api/tasks":
@@ -81,7 +90,7 @@ class _DashboardHandler(BaseHTTPRequestHandler):
             elif path == "/api/objects":
                 self._json(state_api.list_objects())
             elif path == "/api/timeline":
-                self._json(state_api.timeline())
+                self._json(state_api.cluster_timeline())
             elif path == "/api/config":
                 # the resolved flag table with provenance (the
                 # ray_config_def.h surface, observable)
@@ -137,7 +146,7 @@ class _DashboardHandler(BaseHTTPRequestHandler):
                     "/api/cluster_status", "/api/timeline", "/api/config",
                     "/api/serve", "/api/train", "/api/data",
                     "/api/profile/cpu", "/api/profile/memory",
-                    "/metrics", "/"]})
+                    "/api/metrics", "/metrics", "/"]})
             else:
                 self._json({"error": f"unknown path {path}"}, 404)
         except Exception as e:

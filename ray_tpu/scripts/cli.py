@@ -65,7 +65,9 @@ def cmd_memory(args) -> int:
 def cmd_timeline(args) -> int:
     _init_runtime(args)
     from ray_tpu.util import state as st
-    path = st.timeline(args.output)
+    # merged cluster trace: one lane per process (driver / daemon /
+    # worker), clock-corrected spans from the head's task-event store
+    path = st.cluster_timeline(args.output)
     print(f"wrote chrome trace to {path}")
     return 0
 

@@ -226,45 +226,121 @@ def clear_registry() -> None:
         _FLUSH_STATE.clear()
 
 
+def _esc_label(value: Any) -> str:
+    """Escape a label VALUE per the Prometheus exposition spec: backslash,
+    double-quote, and newline must be escaped or the scrape corrupts
+    (e.g. a task name containing ``"`` used to break parsing)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _esc_help(text: str) -> str:
+    """HELP text escaping per the spec: backslash and newline only."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(key: Tuple) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_esc_label(v)}"' for k, v in key)
     return "{" + inner + "}"
 
 
-def prometheus_text() -> str:
-    """Prometheus exposition format for every registered metric, plus the
-    runtime's system stats as gauges."""
-    lines: List[str] = []
-    for name, metric in sorted(registry().items()):
-        lines.append(f"# HELP {name} {metric.description}")
-        lines.append(f"# TYPE {name} {metric.kind}")
-        if isinstance(metric, Histogram):
-            with metric._lock:
-                for key, counts in metric._counts.items():
-                    cum = 0
-                    for bound, c in zip(metric.boundaries, counts):
-                        cum += c
-                        lk = dict(key)
-                        lk["le"] = str(bound)
-                        lines.append(
-                            f"{name}_bucket{_fmt_labels(tuple(sorted(lk.items())))} {cum}")
-                    lk = dict(key)
-                    lk["le"] = "+Inf"
-                    lines.append(
-                        f"{name}_bucket{_fmt_labels(tuple(sorted(lk.items())))} "
-                        f"{metric._totals[key]}")
-                    lines.append(
-                        f"{name}_sum{_fmt_labels(key)} {metric._sums[key]}")
-                    lines.append(
-                        f"{name}_count{_fmt_labels(key)} "
-                        f"{metric._totals[key]}")
-        else:
-            for key, value in metric.samples():
-                lines.append(f"{name}{_fmt_labels(key)} {value}")
+# ---------------------------------------------------------------------------
+# cluster federation (reference: per-process OpenCensus registries merged
+# into ONE Prometheus view by the metrics agent). Each process exports a
+# wire-plain snapshot of its registry; daemons ship theirs to the head on
+# heartbeats; the driver's dashboard renders local + federated snapshots
+# with a node_id label per source.
+# ---------------------------------------------------------------------------
 
-    # system stats
+def export_snapshot() -> List[Dict]:
+    """Absolute (idempotent) snapshot of every registered metric as
+    msgpack-plain entries — keys serialized as [[k, v], ...] pair lists.
+    Re-sending a snapshot replaces the previous one at the receiver, so
+    nothing double-counts (unlike deltas)."""
+    out: List[Dict] = []
+    for name, m in registry().items():
+        if m.kind == "histogram":
+            with m._lock:
+                hist = [[[list(p) for p in key], list(counts),
+                         m._sums.get(key, 0.0), m._totals.get(key, 0)]
+                        for key, counts in m._counts.items()]
+            if hist:
+                out.append({"name": name, "kind": "histogram",
+                            "description": m.description,
+                            "boundaries": list(m.boundaries),
+                            "hist": hist})
+            continue
+        samples = [[[list(p) for p in key], v] for key, v in m.samples()]
+        if samples:
+            out.append({"name": name, "kind": m.kind,
+                        "description": m.description,
+                        "samples": samples})
+    try:    # wire/RPC counters live outside the registry (hot path)
+        from ray_tpu._private import rpc as _rpc
+        out.extend(_rpc.wire_metric_entries())
+    except Exception:
+        pass
+    return out
+
+
+def _inject(key, extra: Dict[str, str]) -> Tuple:
+    """Label key (pair list or tuple) + per-source labels (a source's own
+    label of the same name wins)."""
+    pairs = {str(k): v for k, v in key}
+    for k, v in (extra or {}).items():
+        pairs.setdefault(k, v)
+    return tuple(sorted(pairs.items()))
+
+
+def render_prometheus(parts: List[Tuple[Dict[str, str], List[Dict]]]
+                      ) -> str:
+    """Render one exposition from many process snapshots: one HELP/TYPE
+    block per metric name, every sample labeled with its source's extra
+    labels (``node_id`` for federated daemons)."""
+    merged: Dict[str, Dict[str, Any]] = {}
+    for extra, entries in parts:
+        for e in entries or []:
+            slot = merged.setdefault(e["name"], {
+                "kind": e["kind"], "description": e.get("description", ""),
+                "boundaries": tuple(e.get("boundaries", ())),
+                "scalars": [], "hists": []})
+            if e["kind"] != slot["kind"]:
+                continue        # conflicting registration: first wins
+            if e["kind"] == "histogram":
+                if tuple(e.get("boundaries", ())) != slot["boundaries"]:
+                    continue    # a truncated merge would corrupt buckets
+                for key, counts, hsum, total in e.get("hist", []):
+                    slot["hists"].append(
+                        (_inject(key, extra), counts, hsum, total))
+            else:
+                for key, value in e.get("samples", []):
+                    slot["scalars"].append((_inject(key, extra), value))
+    lines: List[str] = []
+    for name in sorted(merged):
+        slot = merged[name]
+        lines.append(f"# HELP {name} {_esc_help(slot['description'])}")
+        lines.append(f"# TYPE {name} {slot['kind']}")
+        if slot["kind"] == "histogram":
+            for key, counts, hsum, total in slot["hists"]:
+                cum = 0
+                for bound, c in zip(slot["boundaries"], counts):
+                    cum += c
+                    lk = _inject(key, {"le": str(bound)})
+                    lines.append(f"{name}_bucket{_fmt_labels(lk)} {cum}")
+                lk = _inject(key, {"le": "+Inf"})
+                lines.append(f"{name}_bucket{_fmt_labels(lk)} {total}")
+                lines.append(f"{name}_sum{_fmt_labels(key)} {hsum}")
+                lines.append(f"{name}_count{_fmt_labels(key)} {total}")
+        else:
+            for key, value in slot["scalars"]:
+                lines.append(f"{name}{_fmt_labels(key)} {value}")
+    return "\n".join(lines)
+
+
+def _system_stats_lines() -> List[str]:
+    lines: List[str] = []
     try:
         from ray_tpu._private import worker as _worker
         rt = _worker.global_runtime()
@@ -278,4 +354,66 @@ def prometheus_text() -> str:
                 f"{sum(1 for n in rt.nodes() if n.alive)}")
     except Exception:
         pass
-    return "\n".join(lines) + "\n"
+    return lines
+
+
+def _federated_parts() -> List[Tuple[Dict[str, str], List[Dict]]]:
+    """Per-node metric snapshots the daemons shipped to the head with
+    their heartbeats (empty outside the daemon topology)."""
+    parts: List[Tuple[Dict[str, str], List[Dict]]] = []
+    try:
+        from ray_tpu._private import worker as _worker
+        rt = _worker.global_runtime()
+        backend = getattr(rt, "cluster_backend", None)
+        head = getattr(backend, "head", None)
+        if head is not None:
+            for node_id, snap in head.metrics_get().items():
+                parts.append(({"node_id": node_id}, snap))
+    except Exception:
+        pass
+    return parts
+
+
+def prometheus_text() -> str:
+    """Prometheus exposition for THIS process's registry, plus the
+    runtime's system stats as gauges."""
+    lines = [render_prometheus([({}, export_snapshot())])]
+    lines.extend(_system_stats_lines())
+    return "\n".join(line for line in lines if line) + "\n"
+
+
+def cluster_prometheus_text() -> str:
+    """CLUSTER-WIDE exposition: this process's registry merged with every
+    daemon's federated snapshot (``node_id``-labeled). Served by the
+    dashboard's ``/metrics``; identical to :func:`prometheus_text` in the
+    in-process topology."""
+    parts = [({}, export_snapshot())] + _federated_parts()
+    lines = [render_prometheus(parts)]
+    lines.extend(_system_stats_lines())
+    return "\n".join(line for line in lines if line) + "\n"
+
+
+def cluster_metrics_json() -> Dict[str, Any]:
+    """Structured (JSON) view of the cluster-wide metric samples — the
+    dashboard's ``/api/metrics``."""
+    rows: List[Dict[str, Any]] = []
+    for extra, entries in [({}, export_snapshot())] + _federated_parts():
+        for e in entries or []:
+            if e["kind"] == "histogram":
+                for key, counts, hsum, total in e.get("hist", []):
+                    rows.append({
+                        "name": e["name"], "kind": "histogram",
+                        "labels": dict(_inject(key, extra)),
+                        "sum": hsum, "count": total,
+                        # one label per count INCLUDING the overflow
+                        # bucket (counts has len(boundaries)+1 cells)
+                        "buckets": dict(zip(
+                            [str(b) for b in e.get("boundaries", ())]
+                            + ["+Inf"],
+                            counts))})
+            else:
+                for key, value in e.get("samples", []):
+                    rows.append({"name": e["name"], "kind": e["kind"],
+                                 "labels": dict(_inject(key, extra)),
+                                 "value": value})
+    return {"metrics": rows}

@@ -1,9 +1,14 @@
 """State API (reference: `python/ray/util/state/api.py` + `state_cli.py`
 — programmatic cluster introspection over GCS/dashboard)."""
 
-from ray_tpu.util.state.api import (list_actors, list_nodes, list_objects,
+from ray_tpu.util.state.api import (cluster_timeline, list_actors,
+                                    list_nodes, list_objects,
                                     list_placement_groups, list_tasks,
-                                    summarize_tasks, timeline)
+                                    list_tasks_from_head, summarize_tasks,
+                                    task_breakdown, timeline,
+                                    timeline_from_head)
 
 __all__ = ["list_tasks", "list_actors", "list_objects", "list_nodes",
-           "list_placement_groups", "summarize_tasks", "timeline"]
+           "list_placement_groups", "summarize_tasks", "timeline",
+           "cluster_timeline", "task_breakdown", "list_tasks_from_head",
+           "timeline_from_head"]

@@ -27,6 +27,8 @@ def list_tasks(*, filters: Optional[List] = None,
     rt = _rt()
     rows: Dict[str, Dict[str, Any]] = {}
     for ev in rt.task_events.events():
+        if ev["event"] == "SPAN":
+            continue    # latency spans are not state transitions
         row = rows.setdefault(ev["task_id"], {
             "task_id": ev["task_id"], "name": ev["name"],
             "state": ev["event"], "node_id": ev["node_id"] or None,
@@ -126,6 +128,8 @@ def list_tasks_from_head(address: str, *, job_id: str = "",
         head.close()
     rows: Dict[str, Dict[str, Any]] = {}
     for ev in events:
+        if ev.get("event") == "SPAN":
+            continue    # latency spans are not state transitions
         row = rows.setdefault(ev["task_id"], {
             "task_id": ev["task_id"], "name": ev["name"],
             "state": ev["event"], "node_id": ev.get("node_id") or None,
@@ -142,10 +146,11 @@ def list_tasks_from_head(address: str, *, job_id: str = "",
 def timeline_from_head(address: str, path: Optional[str] = None,
                        *, job_id: str = "") -> Any:
     """Chrome-trace timeline rebuilt from the head's task-event store —
-    post-mortem counterpart of :func:`timeline`."""
+    post-mortem counterpart of :func:`timeline`. Includes per-phase span
+    lanes from every process that flushed to the head."""
     import json as _json
 
-    from ray_tpu._private.events import TaskEventBuffer
+    from ray_tpu._private.events import merged_chrome_trace
     from ray_tpu._private.head import HeadClient
     host, port = address.rsplit(":", 1)
     head = HeadClient((host, int(port)))
@@ -153,10 +158,7 @@ def timeline_from_head(address: str, path: Optional[str] = None,
         events = head.task_events_get(job_id=job_id)
     finally:
         head.close()
-    buf = TaskEventBuffer()
-    with buf._lock:
-        buf._events.extend(events)
-    trace = buf.chrome_trace()
+    trace = merged_chrome_trace(events)
     if path:
         with open(path, "w") as f:
             _json.dump(trace, f)
@@ -170,6 +172,73 @@ def timeline(path: Optional[str] = None) -> Any:
     if path is not None:
         return rt.task_events.dump_chrome_trace(path)
     return rt.task_events.chrome_trace()
+
+
+def _gather_cluster_events() -> list:
+    """Driver-local events merged with the head's store (daemon/worker
+    spans land there via heartbeats), deduplicated — the driver's own
+    events are also flushed to the head."""
+    rt = _rt()
+    events = list(rt.task_events.events())
+    backend = getattr(rt, "cluster_backend", None)
+    head = getattr(backend, "head", None)
+    if head is not None:
+        try:
+            events += head.task_events_get()
+        except Exception:
+            pass
+    seen = set()
+    out = []
+    for ev in events:
+        key = (ev.get("proc", ""), ev.get("task_id"), ev.get("event"),
+               ev.get("phase", ""), round(ev.get("wall_ts", 0.0), 6))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(ev)
+    return out
+
+
+def cluster_timeline(path: Optional[str] = None) -> Any:
+    """MERGED chrome trace across every process: one lane per recorder
+    (driver / daemon:<node> / worker:<pid>), wall-clock timebase with
+    the head's per-node clock correction applied at ingestion. The
+    `ray-tpu timeline` CLI emits this view."""
+    import json as _json
+
+    from ray_tpu._private.events import merged_chrome_trace
+    trace = merged_chrome_trace(_gather_cluster_events())
+    if path is not None:
+        with open(path, "w") as f:
+            _json.dump(trace, f)
+        return path
+    return trace
+
+
+def task_breakdown(task_id: str, *, address: Optional[str] = None
+                   ) -> Dict[str, float]:
+    """Per-phase latency vector for one task:
+    ``{submit, linger, queue, dispatch, exec, result}`` seconds (0.0 for
+    phases not recorded — e.g. no linger outside the batched wire path).
+    With ``address`` the spans come from that head's store alone (post-
+    mortem); otherwise from the live runtime + its head."""
+    from ray_tpu._private.events import PHASES
+    if address is not None:
+        from ray_tpu._private.head import HeadClient
+        host, port = address.rsplit(":", 1)
+        head = HeadClient((host, int(port)))
+        try:
+            events = head.task_events_get()
+        finally:
+            head.close()
+    else:
+        events = _gather_cluster_events()
+    out = {p: 0.0 for p in PHASES}
+    for ev in events:
+        if (ev.get("event") == "SPAN" and ev.get("task_id") == task_id
+                and ev.get("phase") in out):
+            out[ev["phase"]] = float(ev.get("dur_s", 0.0))
+    return out
 
 
 def _apply_filters(rows: List[Dict], filters: Optional[List]

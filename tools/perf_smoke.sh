@@ -75,18 +75,68 @@ ray_tpu.get(refs)
 
 # probe 3: batched classic-path burst — exercises the submit coalescer
 # wire path when this script is invoked with the `daemons` mode
-# (process workers in-process otherwise)
-t0 = time.perf_counter()
-refs = [duo.remote() for _ in range(600)]
-ray_tpu.get([r for ab in refs for r in ab])
-results["burst_batched_per_s"] = round(600 / (time.perf_counter() - t0), 1)
+# (process workers in-process otherwise). Tracing is ON by default, so
+# this row measures the traced rate.
+
+
+def burst_batched(n=600) -> float:
+    t0 = time.perf_counter()
+    refs = [duo.remote() for _ in range(n)]
+    ray_tpu.get([r for ab in refs for r in ab])
+    return n / (time.perf_counter() - t0)
+
+
+burst_batched()     # warm the classic path
+results["burst_batched_per_s"] = round(burst_batched(), 1)
+
+# probe 4: tracing overhead — the same burst with spans ON vs OFF.
+# Methodology: PAIRED bursts in one cluster with BALANCED ordering
+# (on-first on even rounds, off-first on odd) and the MEDIAN of the
+# per-pair ratios. Anything weaker is a noise lottery on shared
+# hardware: single-burst scatter here is +-25%, the real overhead ~1%
+# (docs/observability.md). Budget: <= 5% on burst_submit_batched.
+import statistics  # noqa: E402
+
+from ray_tpu._private.config import apply_system_config  # noqa: E402
+
+
+def traced_burst(on: bool) -> float:
+    apply_system_config({"task_trace": on})
+    return burst_batched(200)
+
+
+ratios = []
+for i in range(7):
+    if i % 2 == 0:
+        r_on = traced_burst(True)
+        r_off = traced_burst(False)
+    else:
+        r_off = traced_burst(False)
+        r_on = traced_burst(True)
+    ratios.append(r_on / r_off)
+apply_system_config(None)   # restore env/default flag resolution
+overhead = max(0.0, (1.0 - statistics.median(ratios)) * 100.0)
+# Single-burst scatter on shared hardware is +-30-70%, far above the 5%
+# budget, so the gate demands a CONSISTENT regression: a real overhead
+# shows tracing slower in (nearly) every pair; noise flips signs. A
+# median above budget with mixed signs reports but does not fail.
+slower = sum(1 for r in ratios if r < 1.0)
+consistent = slower >= len(ratios) - 1
+results["tracing_overhead_pct"] = round(overhead, 1)
+results["tracing_overhead_consistent"] = bool(consistent)
 
 ray_tpu.shutdown()
 print(json.dumps(results, indent=2))
 
+# tracing_overhead_pct is a BUDGET row (lower is better), checked
+# against its fixed 5% ceiling below — never against the rate floors.
+TRACING_OVERHEAD_MAX = 5.0
+
 if rebaseline:
+    floors = {k: v for k, v in results.items()
+              if not k.startswith("tracing_overhead")}
     with open(FLOOR_PATH, "w") as fh:
-        json.dump(results, fh, indent=2)
+        json.dump(floors, fh, indent=2)
         fh.write("\n")
     print(f"wrote {FLOOR_PATH}")
     sys.exit(0)
@@ -101,6 +151,8 @@ except FileNotFoundError:
 
 failed = False
 for name, floor in floors.items():
+    if name.startswith("tracing_overhead"):
+        continue    # legacy floor entry: budget-checked below instead
     got = results.get(name, 0.0)
     limit = floor * (1.0 - TOLERANCE)
     verdict = "ok" if got >= limit else "REGRESSION"
@@ -108,5 +160,14 @@ for name, floor in floors.items():
           f"(min {limit:,.0f}/s) {verdict}")
     if got < limit:
         failed = True
+trip = overhead > TRACING_OVERHEAD_MAX and consistent
+verdict = ("REGRESSION" if trip else
+           "ok" if overhead <= TRACING_OVERHEAD_MAX else
+           "ok (noise: mixed-sign pairs)")
+print(f"tracing_overhead_pct: {overhead:.1f}% vs budget "
+      f"{TRACING_OVERHEAD_MAX:.0f}% "
+      f"({slower}/{len(ratios)} pairs slower) {verdict}")
+if trip:
+    failed = True
 sys.exit(1 if failed else 0)
 EOF

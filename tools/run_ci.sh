@@ -12,6 +12,12 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "=== stage 0: observability (dashboard endpoints + task tracing) ==="
+# cheap fail-fast pass over the dashboard/trace/federation tests (they
+# also run inside stages 1-2; this surfaces observability breakage in
+# seconds instead of after the full sweep)
+python -m pytest tests/test_observability.py -x -q
+
 echo "=== stage 1: full suite (in-process topology) ==="
 python -m pytest tests/ -x -q
 
