@@ -39,6 +39,7 @@ from ray_tpu._private import rpc
 from ray_tpu._private import daemon as _daemon_schemas  # noqa: F401 — declares the daemon RPC schemas
 from ray_tpu._private.head import HeadClient
 from ray_tpu._private.ids import NodeID
+from ray_tpu._private.lock_sanitizer import tracked_lock
 from ray_tpu._private.rpc import HOLD, Client, Server, declare
 
 declare("core_op", "call", "payload", "task")
@@ -85,8 +86,8 @@ class ArenaCache:
     """Same-host attach to daemon shm arenas by name (zero-copy reads)."""
 
     def __init__(self):
-        self._arenas: Dict[str, Any] = {}
-        self._lock = threading.Lock()
+        self._arenas: Dict[str, Any] = {}  #: guarded by self._lock
+        self._lock = tracked_lock("cluster.arena_cache", reentrant=False)
 
     def read(self, arena: str, capacity: int, off: int,
              size: int) -> Optional[memoryview]:
@@ -158,8 +159,8 @@ class _SubmitCoalescer:
         self.batch_max = max(1, int(cfg().submit_batch_max))
         self.linger_s = max(0.0, float(cfg().submit_linger_us) / 1e6)
         self._cv = threading.Condition()
-        self._q: deque = deque()
-        self._stopped = False
+        self._q: deque = deque()           #: guarded by self._cv
+        self._stopped = False              #: guarded by self._cv
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name=f"submit-batch-{handle.node_id.hex()[:8]}")
@@ -312,9 +313,9 @@ class _FreeCoalescer:
         self.batch_max = max(1, int(cfg().free_batch_max))
         self.flush_s = max(0.0, float(cfg().free_flush_ms) / 1e3)
         self._cv = threading.Condition()
-        self._oids: List[bytes] = []
-        self._stopped = False
-        self._thread: Optional[threading.Thread] = None
+        self._oids: List[bytes] = []       #: guarded by self._cv
+        self._stopped = False              #: guarded by self._cv
+        self._thread: Optional[threading.Thread] = None  #: guarded by self._cv
 
     def queue(self, oid: bytes) -> None:
         with self._cv:
@@ -396,29 +397,34 @@ class DaemonHandle:
         self.addr = addr
         self.proc = proc
         self.arenas = arenas
-        self._streams: Dict[str, _Stream] = {}
-        self._slock = threading.Lock()
+        self._streams: Dict[str, _Stream] = {}  #: guarded by self._slock
+        self._slock = tracked_lock("cluster.handle.streams",
+                                   reentrant=False)
         self.on_actor_worker_died = None  # set by the backend
         self.client = Client(addr, timeout=None, on_push=self._on_push)
         self.dead = False
         # fast lane: direct submit to the daemon's native (C++) core
         self.fast_port: Optional[int] = None
         self._fast = None
-        self._fast_lock = threading.Lock()
+        self._fast_lock = tracked_lock("cluster.handle.fast_rids",
+                                       reentrant=False)
         # reconnects (with their backoff sleeps) serialize on their OWN
         # lock: holding _fast_lock through a retry window would stall
         # every concurrent submit's _fast_rids bookkeeping and cancels
-        self._fast_dial_lock = threading.Lock()
+        self._fast_dial_lock = tracked_lock("cluster.handle.fast_dial",
+                                            reentrant=False)
         # task hex -> (lane client, rid): the CLIENT pins the rid to its
         # generation — a reconnected lane restarts rid numbering, so a
         # bare rid could cancel an unrelated task on the new client
-        self._fast_rids: Dict[str, Tuple[Any, int]] = {}
+        self._fast_rids: Dict[str, Tuple[Any, int]] = {}  #: guarded by self._fast_lock
         # control-plane batching (submit coalescer + free buffer)
         self._batch_supported = False       # daemon advertises in hello
         self._batch: Optional[_SubmitCoalescer] = None
-        self._batch_lock = threading.Lock()
-        self._batch_waiters: Dict[str, list] = {}   # task hex -> slot
-        self._bw_lock = threading.Lock()
+        self._batch_lock = tracked_lock("cluster.handle.batch_init",
+                                        reentrant=False)
+        self._batch_waiters: Dict[str, list] = {}  #: guarded by self._bw_lock
+        self._bw_lock = tracked_lock("cluster.handle.batch_waiters",
+                                     reentrant=False)
         self._fns_shipped: set = set()      # fids this daemon holds
         self._free = _FreeCoalescer(self)
         self.runtime = None                    # bound by the backend
@@ -1003,8 +1009,9 @@ class RemoteStore:
 
     def __init__(self, daemon: DaemonHandle):
         self.daemon = daemon
+        #: guarded by self._lock
         self._meta: Dict[Any, Tuple[bytes, int]] = {}  # ObjectID -> (key, n)
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("cluster.remote_store", reentrant=False)
 
     def register_remote(self, object_id, daemon_key: bytes,
                         nbytes: int) -> None:
@@ -1082,8 +1089,8 @@ class _OwnerHolder:
     long-lived daemon must not pin dead tasks' objects."""
 
     def __init__(self):
-        self._held: Dict[Any, List[Any]] = {}
-        self._lock = threading.Lock()
+        self._held: Dict[Any, List[Any]] = {}  #: guarded by self._lock
+        self._lock = tracked_lock("cluster.owner_holder", reentrant=False)
 
     def _hold(self, task_rid, obj) -> None:
         with self._lock:
@@ -1170,8 +1177,9 @@ class ClusterBackend:
         self._supervisor.start()
         self.owner_service = OwnerService(runtime)
         self.owner_server = Server(self.owner_service).start()
-        self.daemons: Dict[NodeID, DaemonHandle] = {}
-        self._lock = threading.Lock()
+        self.daemons: Dict[NodeID, DaemonHandle] = {}  #: guarded by self._lock
+        self._lock = tracked_lock("cluster.backend.daemons",
+                                  reentrant=False)
         import json
 
         head_port = self._head_port
@@ -1218,13 +1226,16 @@ class ClusterBackend:
         self._shutting_down = False
         self.owner_service = OwnerService(runtime)
         self.owner_server = Server(self.owner_service).start()
-        self.daemons: Dict[NodeID, DaemonHandle] = {}
-        self._lock = threading.Lock()
+        # single-threaded construction: attach() is a constructor, the
+        # reporter/subscriber threads that contend start below
+        self.daemons = {}       # raylint: disable=guarded-by
+        self._lock = tracked_lock("cluster.backend.daemons",
+                                  reentrant=False)
         for info in self.head.list_nodes():
             if not info["alive"]:
                 continue
             self._join_node(info, add_runtime_node=False)
-        if not self.daemons:
+        if not self.daemons:    # raylint: disable=guarded-by
             raise RuntimeError(
                 f"cluster at {address} has no alive nodes to join")
         self.head.subscribe("node", self._on_node_event)

@@ -45,6 +45,7 @@ from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
 from ray_tpu._private.head import HeadClient, _hb_interval
 from ray_tpu._private.ids import ActorID, NodeID, TaskID
+from ray_tpu._private.lock_sanitizer import tracked_lock
 from ray_tpu._private.task_spec import TaskKind, TaskSpec
 from ray_tpu._private.rpc import Client, Connection, Server, declare
 
@@ -187,8 +188,8 @@ class PreemptionWatcher:
 
 class ObjectTable:
     def __init__(self, arena_name: str, capacity: int):
-        self._small: Dict[bytes, bytes] = {}
-        self._lock = threading.Lock()
+        self._small: Dict[bytes, bytes] = {}  #: guarded by self._lock
+        self._lock = tracked_lock("daemon.object_table", reentrant=False)
         self.arena_name = arena_name
         self.capacity = capacity
         self._shm = None
@@ -490,7 +491,7 @@ class _BatchReplyPump:
 
     def __init__(self):
         self._cv = threading.Condition()
-        self._buf: Dict[Connection, list] = {}
+        self._buf: Dict[Connection, list] = {}  #: guarded by self._cv
         threading.Thread(target=self._loop, daemon=True,
                          name="batch-reply-pump").start()
 
@@ -592,29 +593,31 @@ class DaemonService:
         self.task_events = TaskEventBuffer(capacity=50_000)
         self.runtime = DaemonRuntime(self)
         self.node_stub = _NodeStub(self.node_id)
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("daemon.ledger", reentrant=False)
+        #: guarded by self._lock
         self._leases: Dict[str, Any] = {}          # lease_id -> WorkerClient
-        self._lease_seq = 0
+        self._lease_seq = 0                        #: guarded by self._lock
         # task_id hex -> (client, worker rid) for cancel/gen_ack
-        self._task_rids: Dict[str, Tuple[Any, str]] = {}
+        self._task_rids: Dict[str, Tuple[Any, str]] = {}  #: guarded by self._lock
         # batched-submit dedupe, keyed (task hex, attempt): a retried
         # push_task_batch frame must not double-execute — running tasks
         # are skipped, finished ones get their recorded outcome resent;
         # a task RETRY bumps the attempt and executes normally
-        self._batch_running: set = set()
+        self._batch_running: set = set()           #: guarded by self._lock
+        #: guarded by self._lock
         self._batch_done: "OrderedDict[tuple, Dict[str, Any]]" = OrderedDict()
         self._batch_pump = _BatchReplyPump()
-        self._bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}
-        self._peers: Dict[Tuple[str, int], Client] = {}
+        self._bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}  #: guarded by self._lock
+        self._peers: Dict[Tuple[str, int], Client] = {}  #: guarded by self._lock
         # cross-language actors: name -> [actor_id, seqno]
-        self._xlang_actors: Dict[str, list] = {}
+        self._xlang_actors: Dict[str, list] = {}   #: guarded by self._lock
         self.head_addr = None            # set by main() in daemon mode
         self._xlang_head_client = None
         # peer resource gossip (reference: ray_syncer.h:83): versioned
         # per-node load entries, merged peer-to-peer; loop starts in
         # main() once the head address is known
-        self._syncer_view: Dict[str, Dict[str, Any]] = {}
-        self._syncer_lock = threading.Lock()
+        self._syncer_view: Dict[str, Dict[str, Any]] = {}  #: guarded by self._syncer_lock
+        self._syncer_lock = tracked_lock("daemon.syncer", reentrant=False)
         self._syncer_peers_cache: Dict[str, Any] = {}
         self._syncer_peers_ts = 0.0
         self._syncer_interval_s = float(
@@ -1431,11 +1434,12 @@ class DaemonService:
             from ray_tpu._private import worker_process as wp
             try:
                 with self._lock:
-                    if msg["name"] in self._xlang_actors:
-                        conn.reply(rid, outcome="err",
-                                   error=f"xlang actor name "
-                                         f"{msg['name']!r} already taken")
-                        return
+                    taken = msg["name"] in self._xlang_actors
+                if taken:
+                    conn.reply(rid, outcome="err",
+                               error=f"xlang actor name "
+                                     f"{msg['name']!r} already taken")
+                    return
                 blob = self._xlang_kv_blob("actor", msg["cls"])
                 if blob is None:
                     conn.reply(rid, outcome="err",
@@ -1454,19 +1458,23 @@ class DaemonService:
                 router.create_actor(spec, self.node_stub,
                                     (fid, args_blob))
                 with self._lock:
-                    if msg["name"] in self._xlang_actors:
-                        # lost a concurrent create race: kill ours
-                        with router._lock:
-                            dup = router._actor_workers.pop(
-                                spec.actor_id, None)
-                        if dup is not None:
-                            dup.kill(expected=True)
-                        conn.reply(rid, outcome="err",
-                                   error=f"xlang actor name "
-                                         f"{msg['name']!r} already taken")
-                        return
-                    self._xlang_actors[msg["name"]] = [
-                        spec.actor_id, 0, threading.Lock()]
+                    lost_race = msg["name"] in self._xlang_actors
+                    if not lost_race:
+                        self._xlang_actors[msg["name"]] = [
+                            spec.actor_id, 0, threading.Lock()]
+                if lost_race:
+                    # lost a concurrent create race: kill ours. The
+                    # worker kill (process teardown) and the reply
+                    # (wire send) both happen OUTSIDE the ledger lock.
+                    with router._lock:
+                        dup = router._actor_workers.pop(
+                            spec.actor_id, None)
+                    if dup is not None:
+                        dup.kill(expected=True)
+                    conn.reply(rid, outcome="err",
+                               error=f"xlang actor name "
+                                     f"{msg['name']!r} already taken")
+                    return
                 conn.reply(rid, outcome="ok",
                            actor_id=spec.actor_id.hex())
             except BaseException as e:  # noqa: BLE001 — shipped back
@@ -1554,11 +1562,16 @@ class DaemonService:
         import random as _random
 
         me = self.node_id.hex()
+        # Build the self entry BEFORE taking the syncer lock: it reads
+        # the daemon ledger (self._lock) and the object-store accounting
+        # — nesting those under _syncer_lock stalls every concurrent
+        # syncer_exchange/syncer_view handler behind store bookkeeping.
+        load = self._syncer_self_entry()
         with self._syncer_lock:
             mine = self._syncer_view.get(me)
             version = (mine["v"] + 1) if mine else 1
             self._syncer_view[me] = {"v": version,
-                                     "load": self._syncer_self_entry(),
+                                     "load": load,
                                      "ts": time.time()}
             view = {k: dict(v) for k, v in self._syncer_view.items()}
         peers = [(hex_id, tuple(addr))

@@ -68,9 +68,13 @@ class TaskEventBuffer:
 
     def __init__(self, capacity: int = 100_000):
         import itertools
-        self._events: deque = deque(maxlen=capacity)
+        from ray_tpu._private.lock_sanitizer import tracked_lock
+        self._events: deque = deque(maxlen=capacity)  #: guarded by self._lock
+        # _spans is DELIBERATELY lock-free (GIL-atomic appends): a lock
+        # on the multi-thread span hot path cost ~600us/span in futex
+        # convoys (PR 4) — do not annotate it as guarded
         self._spans: deque = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("events.buffer", reentrant=False)
         self._t0 = time.perf_counter()
         self._seq_counter = itertools.count(1)
 

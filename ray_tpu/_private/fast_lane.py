@@ -33,6 +33,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import msgpack
 
+from ray_tpu._private.lock_sanitizer import tracked_lock
+
 from ray_tpu._private import failpoints as _fp
 
 # ops (mirror daemon_core.cc)
@@ -175,11 +177,11 @@ class FastLaneClient:
         self._sock = socket.create_connection(addr, timeout=10.0)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock.settimeout(None)
-        self._wlock = threading.Lock()
+        self._wlock = tracked_lock("fast_lane.wire", reentrant=False)
         self._rids = itertools.count(1)
         # rid -> [Event, kind, payload]
-        self._pending: Dict[int, list] = {}
-        self._plock = threading.Lock()
+        self._pending: Dict[int, list] = {}  #: guarded by self._plock
+        self._plock = tracked_lock("fast_lane.pending", reentrant=False)
         self.dead = False
         self._reader = threading.Thread(target=self._read_loop,
                                         daemon=True, name="fastlane-read")

@@ -51,6 +51,7 @@ import msgpack
 
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
+from ray_tpu._private.lock_sanitizer import tracked_lock
 from ray_tpu._private.rpc import HOLD, Client, Connection, Server, declare
 
 def _hb_interval() -> float:
@@ -229,12 +230,14 @@ _DRAIN_KEY = b"\x00drain\x00"
 
 class HeadService:
     def __init__(self, state_path: Optional[str] = None):
-        self._lock = threading.Lock()
-        self._nodes: Dict[str, _NodeEntry] = {}
-        self._kv: Dict[bytes, bytes] = {}
+        self._lock = tracked_lock("head.state", reentrant=False)
+        self._nodes: Dict[str, _NodeEntry] = {}  #: guarded by self._lock
+        self._kv: Dict[bytes, bytes] = {}        #: guarded by self._lock
         # pubsub: channel -> (event log, parked subscriber conns)
-        self._events: Dict[str, List[Any]] = {}
+        self._events: Dict[str, List[Any]] = {}  #: guarded by self._lock
+        #: guarded by self._lock
         self._bases: Dict[str, int] = {}   # trimmed-channel log offsets
+        #: guarded by self._lock
         self._parked: Dict[str, List[Tuple[Connection, int, int]]] = {}
         self._store: Optional[_HeadStore] = None
         # task-event store: sqlite when persistent, bounded ring in
@@ -242,19 +245,20 @@ class HeadService:
         self._task_events_cap = 100_000
         # per-node load entries converged via daemon peer gossip
         # (report_loads_gossip); versioned like the daemons' own views
-        self._gossip_loads: Dict[str, Dict[str, Any]] = {}
+        self._gossip_loads: Dict[str, Dict[str, Any]] = {}  #: guarded by self._lock
         from collections import deque as _deque
         self._task_events: Any = _deque(maxlen=self._task_events_cap)
         # metrics federation: node_id -> latest absolute metric snapshot
         # shipped on that daemon's heartbeat (snapshot REPLACE, so a
         # re-sent frame never double-counts); per-node clock offset
         # (head wall - daemon wall) estimated from the same heartbeats.
+        #: guarded by self._lock
         self._node_metrics: Dict[str, List[Dict[str, Any]]] = {}
-        self._node_clock_off: Dict[str, float] = {}
+        self._node_clock_off: Dict[str, float] = {}  #: guarded by self._lock
         # node_id -> (wall-clock deadline, reason): drains survive a
         # head restart (membership does not, so the record re-attaches
         # when the draining daemon re-registers after the respawn).
-        self._drains: Dict[str, Tuple[float, str]] = {}
+        self._drains: Dict[str, Tuple[float, str]] = {}  #: guarded by self._lock
         if state_path:
             self._store = _HeadStore(state_path)
             self._kv, self._events = self._store.load()
@@ -621,14 +625,16 @@ class HeadClient:
         self._client = Client(addr)
         self.addr = addr
         self._reconnect_window = reconnect_window
-        self._dial_lock = threading.Lock()
+        self._dial_lock = tracked_lock("head_client.dial",
+                                       reentrant=False)
         self._sub_stop = threading.Event()
         self._sub_threads: List[threading.Thread] = []
         # live per-channel subscriber connections, tracked so close()
         # can actually close them (a parked long-poll otherwise holds
         # its socket open forever)
-        self._sub_clients: List[Client] = []
-        self._sub_lock = threading.Lock()
+        self._sub_clients: List[Client] = []  #: guarded by self._sub_lock
+        self._sub_lock = tracked_lock("head_client.subs",
+                                      reentrant=False)
         self._retry_policy = None   # built lazily; immutable once made
 
     def _redial(self) -> None:

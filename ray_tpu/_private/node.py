@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ray_tpu._private.gcs import NodeInfo
 from ray_tpu._private.ids import ActorID, NodeID
+from ray_tpu._private.lock_sanitizer import tracked_lock
 from ray_tpu._private.object_store import LocalObjectStore
 from ray_tpu._private.task_spec import TaskKind, TaskSpec
 
@@ -337,8 +338,8 @@ class Node:
         # no new placements; the dispatch loop hands queued-but-
         # unstarted tasks back to the runtime for resubmission elsewhere.
         self.draining = False
-        self.actors: Dict[ActorID, ActorExecutor] = {}
-        self._actors_lock = threading.Lock()
+        self.actors: Dict[ActorID, ActorExecutor] = {}  #: guarded by self._actors_lock
+        self._actors_lock = tracked_lock("node.actors", reentrant=False)
         self._queue: "queue.Queue[Optional[TaskSpec]]" = queue.Queue()
         # Backlog bucketed by exact resource shape: one dispatch pass
         # is O(#shapes), not O(#queued tasks) — with a deep uniform
@@ -352,10 +353,11 @@ class Node:
         # Demand of enqueued-but-not-yet-admitted tasks; lets the cluster
         # scheduler see load before the dispatch loop acquires resources
         # (reference: ReportWorkerBacklog, node_manager.proto:421).
-        self._pending_demand: Dict[str, float] = {}
-        self._pending_lock = threading.Lock()
-        self._running: set = set()
-        self._running_lock = threading.Lock()
+        self._pending_demand: Dict[str, float] = {}  #: guarded by self._pending_lock
+        self._pending_lock = tracked_lock("node.pending_demand",
+                                          reentrant=False)
+        self._running: set = set()      #: guarded by self._running_lock
+        self._running_lock = tracked_lock("node.running", reentrant=False)
         self._sema = threading.Semaphore(max_worker_threads)
         from ray_tpu._private.thread_pool import DaemonThreadPool
         self._task_pool = DaemonThreadPool(

@@ -120,8 +120,9 @@ class LocalObjectStore:
         from ray_tpu._private.lock_sanitizer import tracked_lock
         self._lock = tracked_lock("object_store")
         # insertion-ordered for LRU-ish spilling
+        #: guarded by self._lock
         self._entries: "OrderedDict[ObjectID, ObjectEntry]" = OrderedDict()
-        self._used = 0
+        self._used = 0                  #: guarded by self._lock
         self.stats = {"puts": 0, "gets": 0, "spills": 0, "restores": 0,
                       "evictions": 0, "native_puts": 0}
         # Outstanding zero-copy views into the native arena, per object.
@@ -295,45 +296,58 @@ class LocalObjectStore:
 
     # -- pressure handling -------------------------------------------------
     def _ensure_space(self, size: int) -> None:
-        """Spill (pinned) or drop (unpinned) host-tier entries until fits."""
-        if self._used + size <= self.capacity_bytes:
-            return
-        # Pass 1: spill least-recently-used spillable entries to disk.
-        # Native-tier entries don't count toward _used (the C++ arena
-        # accounts for them) and pinned entries are in active use — both
-        # are skipped.
-        for oid, entry in list(self._entries.items()):
+        """Spill (pinned) or drop (unpinned) host-tier entries until
+        fits. Callers hold self._lock (re-entrant) and so does this:
+        the spill scan must see a stable entry table."""
+        with self._lock:
             if self._used + size <= self.capacity_bytes:
-                break
-            if (entry.device_tier or entry.spilled_path is not None
-                    or entry.native_meta is not None or entry.pinned > 0):
-                continue
-            if self._spill_dir is not None:
-                self._spill(oid, entry)
-        if self._used + size > self.capacity_bytes:
-            raise OutOfMemoryError(
-                f"object store on node {self.node_id.hex()[:8]} full: "
-                f"need {size}, used {self._used}/{self.capacity_bytes} "
-                f"and nothing left to spill")
+                return
+            # Pass 1: spill least-recently-used spillable entries to
+            # disk. Native-tier entries don't count toward _used (the
+            # C++ arena accounts for them) and pinned entries are in
+            # active use — both are skipped.
+            for oid, entry in list(self._entries.items()):
+                if self._used + size <= self.capacity_bytes:
+                    break
+                if (entry.device_tier or entry.spilled_path is not None
+                        or entry.native_meta is not None
+                        or entry.pinned > 0):
+                    continue
+                if self._spill_dir is not None:
+                    self._spill(oid, entry)
+            if self._used + size > self.capacity_bytes:
+                raise OutOfMemoryError(
+                    f"object store on node {self.node_id.hex()[:8]} "
+                    f"full: need {size}, used "
+                    f"{self._used}/{self.capacity_bytes} "
+                    f"and nothing left to spill")
 
     def _spill(self, object_id: ObjectID, entry: ObjectEntry) -> None:
-        os.makedirs(self._spill_dir, exist_ok=True)
-        path = os.path.join(self._spill_dir, object_id.hex())
-        with open(path, "wb") as f:
-            pickle.dump(entry.value, f, protocol=5)
-        entry.spilled_path = path
-        entry.value = None
-        self._used -= entry.nbytes
-        self.stats["spills"] += 1
+        with self._lock:    # re-entrant: callers already hold it
+            os.makedirs(self._spill_dir, exist_ok=True)
+            path = os.path.join(self._spill_dir, object_id.hex())
+            with open(path, "wb") as f:
+                pickle.dump(entry.value, f, protocol=5)
+            entry.spilled_path = path
+            entry.value = None
+            self._used -= entry.nbytes
+            self.stats["spills"] += 1
 
     def _restore(self, object_id: ObjectID, entry: ObjectEntry) -> None:
-        with open(entry.spilled_path, "rb") as f:
-            entry.value = pickle.load(f)
-        try:
-            os.unlink(entry.spilled_path)
-        except OSError:
-            pass
-        entry.spilled_path = None
-        self._ensure_space(entry.nbytes)
-        self._used += entry.nbytes
-        self.stats["restores"] += 1
+        with self._lock:    # re-entrant: callers already hold it
+            # Make room FIRST, while the entry is still in spilled
+            # state: the scan skips spilled entries, so it can never
+            # pick the one being restored (re-spilling it handed the
+            # caller value=None), and a failure here leaves the store
+            # untouched — spill file intact, _used consistent, a later
+            # retry can succeed once pressure drops.
+            self._ensure_space(entry.nbytes)
+            with open(entry.spilled_path, "rb") as f:
+                entry.value = pickle.load(f)
+            try:
+                os.unlink(entry.spilled_path)
+            except OSError:
+                pass
+            entry.spilled_path = None
+            self._used += entry.nbytes
+            self.stats["restores"] += 1

@@ -38,7 +38,7 @@ class ReferenceCounter:
     def __init__(self, on_zero: Optional[Callable[[ObjectID], None]] = None):
         from ray_tpu._private.lock_sanitizer import tracked_lock
         self._lock = tracked_lock("refcount")
-        self._refs: Dict[ObjectID, Reference] = {}
+        self._refs: Dict[ObjectID, Reference] = {}  #: guarded by self._lock
         self._on_zero = on_zero
         # Per-thread deferral queue: freeing an object can drop values whose
         # ObjectRef.__del__ re-enters this counter from inside on_zero (and
@@ -52,10 +52,13 @@ class ReferenceCounter:
         self._on_zero = cb
 
     def _get(self, oid: ObjectID) -> Reference:
-        ref = self._refs.get(oid)
-        if ref is None:
-            ref = self._refs[oid] = Reference()
-        return ref
+        # callers hold self._lock; the re-entrant acquire makes this
+        # helper independently safe (and visibly lock-correct)
+        with self._lock:
+            ref = self._refs.get(oid)
+            if ref is None:
+                ref = self._refs[oid] = Reference()
+            return ref
 
     # -- local handles -----------------------------------------------------
     def add_local_ref(self, oid: ObjectID) -> None:
@@ -161,7 +164,9 @@ class LineageTable:
     """object → producing-task map used for reconstruction after loss."""
 
     def __init__(self, max_entries: int = 1_000_000):
-        self._lock = threading.Lock()
+        from ray_tpu._private.lock_sanitizer import tracked_lock
+        self._lock = tracked_lock("lineage", reentrant=False)
+        #: guarded by self._lock
         self._producers: Dict[ObjectID, Any] = {}  # oid -> TaskSpec
         self._max_entries = max_entries
 

@@ -145,20 +145,23 @@ def wire_metric_entries() -> list:
 SEND_CONCAT_MAX = 64 * 1024
 
 
-def send_frame_bytes(sock: socket.socket, blob, lock) -> None:
+def send_frame_bytes(sock: socket.socket, blob, wlock) -> None:
     """Length-prefixed frame write, shared by rpc and the fast lane.
     ``blob`` is any bytes-like; large payloads are never copied into a
-    `len + blob` concatenation."""
+    `len + blob` concatenation. ``wlock`` is the connection's
+    write-serialization lock — holding it across the sendall is the
+    contract (frames must not interleave), which is why it must never
+    double as a ledger lock."""
     n = len(blob)
     if n > MAX_FRAME:
         raise RpcError(f"frame too large: {n}")
     _WIRE["bytes_sent"] += n + 4    # lossy-tolerant plain add (hot path)
     _WIRE["frames_sent"] += 1
     if n <= SEND_CONCAT_MAX:
-        with lock:
+        with wlock:
             sock.sendall(_LEN.pack(n) + blob)
         return
-    with lock:
+    with wlock:
         # two-phase write under the SAME lock hold: the header and its
         # payload must stay adjacent on the stream
         sock.sendall(_LEN.pack(n))
@@ -166,8 +169,8 @@ def send_frame_bytes(sock: socket.socket, blob, lock) -> None:
 
 
 def _send_frame(sock: socket.socket, obj: Dict[str, Any],
-                lock: threading.Lock) -> None:
-    send_frame_bytes(sock, msgpack.packb(obj, use_bin_type=True), lock)
+                wlock: threading.Lock) -> None:
+    send_frame_bytes(sock, msgpack.packb(obj, use_bin_type=True), wlock)
 
 
 def recv_exact(sock: socket.socket, n: int) -> bytearray:

@@ -64,10 +64,10 @@ class ClusterScheduler:
     def __init__(self):
         from ray_tpu._private.lock_sanitizer import tracked_lock
         self._lock = tracked_lock("scheduler", reentrant=False)
-        self._spread_rr = 0  # round-robin cursor for SPREAD
+        self._spread_rr = 0  #: guarded by self._lock
         # (resource-shape, cluster-epoch) -> feasible candidate nodes
-        self._feas_cache: Dict[tuple, Any] = {}
-        self._feas_epoch = -1
+        self._feas_cache: Dict[tuple, Any] = {}  #: guarded by self._lock
+        self._feas_epoch = -1                    #: guarded by self._lock
 
     def pick_node(self, spec: TaskSpec, nodes: List[Node],
                   preferred: Optional[Node] = None) -> Optional[Node]:

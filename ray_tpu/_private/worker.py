@@ -26,6 +26,7 @@ from ray_tpu import exceptions as exc
 from ray_tpu._private import events as trace_events
 from ray_tpu._private import runtime_context
 from ray_tpu._private.gcs import GCS, ActorInfo, ActorState, NodeInfo
+from ray_tpu._private.lock_sanitizer import tracked_lock
 from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID, TaskID,
                                   WorkerID, next_seqno)
 from ray_tpu._private.node import ActorExecutor, Node
@@ -42,7 +43,8 @@ from ray_tpu._private.task_spec import TaskKind, TaskSpec
 INLINE_OBJECT_SIZE = 100 * 1024
 
 _global_runtime: Optional["Runtime"] = None
-_global_lock = threading.Lock()
+# tracked when the sanitizer env is set BEFORE import (module scope)
+_global_lock = tracked_lock("worker.global_init", reentrant=False)
 
 
 def global_runtime() -> Optional["Runtime"]:
@@ -192,19 +194,21 @@ class Runtime:
         self.memory_store = LocalObjectStore(
             NodeID.nil(), capacity_bytes=1 << 62)
 
-        self._nodes: Dict[NodeID, Node] = {}
-        self._nodes_lock = threading.RLock()
+        self._nodes: Dict[NodeID, Node] = {}  #: guarded by self._nodes_lock
+        self._nodes_lock = tracked_lock("worker.nodes")    # reentrant
+        #: guarded by self._loc_lock
         self._locations: Dict[ObjectID, Set[NodeID]] = {}
-        self._loc_lock = threading.Lock()
+        self._loc_lock = tracked_lock("worker.locations", reentrant=False)
         # Objects whose every copy died with a node; reconstruction is
         # triggered lazily on the next get/wait/dependency touch.
         self._lost: Set[ObjectID] = set()
 
-        self._tasks: Dict[TaskID, _InFlightTask] = {}
-        self._tasks_lock = threading.Lock()
+        self._tasks: Dict[TaskID, _InFlightTask] = {}  #: guarded by self._tasks_lock
+        self._tasks_lock = tracked_lock("worker.tasks", reentrant=False)
 
+        #: guarded by self._actor_lock
         self._actor_pending_tasks: Dict[ActorID, List[TaskSpec]] = {}
-        self._actor_lock = threading.RLock()
+        self._actor_lock = tracked_lock("worker.actors")   # reentrant
         self._actor_executors: Dict[ActorID, ActorExecutor] = {}
         # actor_id -> DaemonHandle for actors hosted on node daemons
         self._remote_actors: Dict[ActorID, Any] = {}
@@ -1123,23 +1127,31 @@ class Runtime:
 
     def _locality_node(self, spec: TaskSpec) -> Optional[Node]:
         """Prefer the node holding the largest dependency (locality-aware)."""
-        best, best_size = None, 0
+        # snapshot both tables under their own locks, then do the store
+        # size accounting lock-free: _nodes was read here without
+        # _nodes_lock (raylint guarded-by), and the per-dep nbytes
+        # lookups have no business running under _loc_lock
+        with self._nodes_lock:
+            nodes = dict(self._nodes)
         with self._loc_lock:
-            for dep in spec.dependencies():
-                for node_id in self._locations.get(dep, ()):
-                    node = self._nodes.get(node_id)
-                    if node is None or not node.alive:
-                        continue
-                    try:
-                        store = node.store
-                        if hasattr(store, "nbytes_of"):
-                            size = store.nbytes_of(dep)
-                        else:
-                            size = store._entries[dep].nbytes  # noqa: SLF001
-                    except KeyError:
-                        continue
-                    if size > best_size:
-                        best, best_size = node, size
+            dep_locs = [(dep, list(self._locations.get(dep, ())))
+                        for dep in spec.dependencies()]
+        best, best_size = None, 0
+        for dep, node_ids in dep_locs:
+            for node_id in node_ids:
+                node = nodes.get(node_id)
+                if node is None or not node.alive:
+                    continue
+                try:
+                    store = node.store
+                    if hasattr(store, "nbytes_of"):
+                        size = store.nbytes_of(dep)
+                    else:
+                        size = store._entries[dep].nbytes  # noqa: SLF001
+                except KeyError:
+                    continue
+                if size > best_size:
+                    best, best_size = node, size
         return best
 
     # ------------------------------------------------------------------

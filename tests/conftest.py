@@ -37,3 +37,33 @@ def _cleanup_runtime():
     import ray_tpu
     if ray_tpu.is_initialized():
         ray_tpu.shutdown()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Lock-sanitizer gate (tools/run_chaos.sh sanitized stage): the
+    -W error escalation only fails tests whose inversion fires on the
+    MAIN thread; most runtime locks are acquired on daemon threads,
+    where a raised LockOrderViolation dies with the thread. The graph
+    records every violation regardless of thread — fail the session on
+    any of them when the sanitizer is armed."""
+    import os
+    if os.environ.get("RAY_TPU_LOCK_SANITIZER") != "1":
+        return
+    try:
+        from ray_tpu._private.lock_sanitizer import GRAPH
+    except Exception:
+        return
+    if GRAPH.violations and exitstatus == 0:
+        reporter = session.config.pluginmanager.get_plugin(
+            "terminalreporter")
+        if reporter is not None:
+            reporter.write_line(
+                f"lock sanitizer: {len(GRAPH.violations)} lock-order "
+                f"violation(s) recorded on runtime threads:", red=True)
+            for v in GRAPH.violations:
+                reporter.write_line(v, red=True)
+        # pytest.exit from sessionfinish is the sanctioned way to force
+        # the process exit code (wrap_session catches exit.Exception
+        # and adopts its returncode; plain session.exitstatus
+        # assignment does not stick here)
+        pytest.exit("lock-order violations recorded", returncode=1)

@@ -407,3 +407,156 @@ def test_config_flag_activation(monkeypatch):
         assert fp.hit_count("test.flag_seam") == 1
     finally:
         ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# seam registry coverage (raylint failpoint-registry contract: every
+# wired seam name is unique, documented, and exercised here or in a
+# deeper suite)
+# ---------------------------------------------------------------------------
+
+# The canonical seam catalogue. raylint cross-checks every fire() call
+# site in ray_tpu/ against docs/fault_tolerance.md AND tests/ — adding
+# a seam without updating this list (or another test) fails CI.
+WIRED_SEAMS = [
+    "rpc.client.send",
+    "rpc.client.recv",
+    "rpc.server.recv",
+    "fast_lane.submit",
+    "fast_lane.ping",
+    "fast_lane.reconnect",
+    "cluster.lane_reconnect",
+    "cluster.cancel",
+    "daemon.lease",
+    "daemon.push_task",
+    "daemon.pull_transfer",
+    "daemon.oom_check",
+    "head.kv_put",
+    "head.pubsub_publish",
+    "head.respawn",
+    "worker.retry",
+    "worker.generator_stream",
+    "drain.announce",
+    "drain.migrate_object",
+    "drain.deadline",
+    "batch.submit_flush",
+    "batch.free_flush",
+    "trace.flush",
+]
+
+
+def test_every_wired_seam_activates_and_fires():
+    """One spec string arming EVERY wired seam parses, arms each name
+    independently, and fires deterministically — a renamed seam that
+    drifts from the catalogue shows up here (and in raylint) instead of
+    silently never firing in a chaos schedule."""
+    fp.activate(";".join(f"{name}=delay(0)" for name in WIRED_SEAMS))
+    desc = fp.describe()
+    assert sorted(desc) == sorted(WIRED_SEAMS)
+    for name in WIRED_SEAMS:
+        assert desc[name]["action"] == "delay"
+        assert fp.fire(name) is None        # delay(0): benign arm
+        assert fp.hit_count(name) == 1, name
+        assert fp.fire_count(name) == 1, name
+
+
+def test_rpc_client_recv_drop_loses_reply_then_recovers():
+    """rpc.client.recv seam: a dropped incoming reply frame leaves the
+    caller waiting (timeout), and the connection recovers afterwards."""
+
+    class Svc:
+        def handle_echo2(self, conn, rid, msg):
+            return {"v": msg["v"]}
+
+    rpc.declare("echo2", "v")
+    server = rpc.Server(Svc()).start()
+    client = rpc.Client(server.addr, timeout=0.3)
+    try:
+        assert client.call("echo2", v=1)["v"] == 1
+        fp.activate("rpc.client.recv=drop:max=1")
+        with pytest.raises(rpc.RpcError):
+            client.call("echo2", v=2)
+        assert client.call("echo2", v=3)["v"] == 3
+        assert fp.fire_count("rpc.client.recv") == 1
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_fast_lane_ping_drop_marks_lane_dead():
+    """fast_lane.ping seam: the drop arm surfaces as the typed
+    FastLaneError and marks the lane dead (health probes must never
+    leak raw OSErrors into daemon stats paths)."""
+    import socket
+
+    from ray_tpu._private import fast_lane as fle
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    client = fle.FastLaneClient(srv.getsockname())
+    try:
+        fp.activate("fast_lane.ping=drop")
+        with pytest.raises(fle.FastLaneError):
+            client.ping(timeout=0.5)
+        assert client.dead
+        assert fp.fire_count("fast_lane.ping") == 1
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_head_pubsub_publish_drop_starves_the_log():
+    """head.pubsub_publish seam: a dropped publish never reaches the
+    channel log (subscribers starve); the next publish lands."""
+    from ray_tpu._private.head import HeadService
+
+    svc = HeadService()
+    try:
+        fp.activate("head.pubsub_publish=drop:max=1")
+        svc._publish("t", {"kind": "lost"})
+        svc._publish("t", {"kind": "kept"})
+        with svc._lock:
+            kinds = [e["kind"] for e in svc._events.get("t", [])]
+        assert kinds == ["kept"]
+        # both publishes HIT the seam; only the first FIRED (max=1)
+        assert fp.hit_count("head.pubsub_publish") == 2
+        assert fp.fire_count("head.pubsub_publish") == 1
+    finally:
+        svc._stop.set()
+
+
+def test_drain_announce_drop_loses_the_notice():
+    """drain.announce seam: the drop arm means the self-announced drain
+    never reaches the head (the crash path is the backstop); without
+    the arm the same announce lands as a DRAINING membership state."""
+    from ray_tpu._private.daemon import PreemptionWatcher
+    from ray_tpu._private.head import HeadService
+
+    import types
+
+    svc = HeadService()
+    server = rpc.Server(svc).start()
+    try:
+        # register through the handler directly: an rpc.Client would
+        # mark the node dead on disconnect (conn.meta fencing)
+        svc.handle_register_node(
+            types.SimpleNamespace(meta={}), 1,
+            {"node_id": "n1", "resources": {}, "labels": {},
+             "addr": ["127.0.0.1", 1]})
+
+        fp.activate("drain.announce=drop")
+        w = PreemptionWatcher("n1", server.addr, deadline_s=30.0)
+        w.notify("preempted")
+        w._announce()
+        with svc._lock:
+            assert not svc._nodes["n1"].draining   # notice lost
+        assert fp.fire_count("drain.announce") == 1
+
+        fp.reset()
+        w2 = PreemptionWatcher("n1", server.addr, deadline_s=30.0)
+        w2.notify("preempted")
+        w2._announce()
+        with svc._lock:
+            assert svc._nodes["n1"].draining       # notice landed
+    finally:
+        svc._stop.set()
+        server.stop()

@@ -93,3 +93,20 @@ def test_runtime_locks_tracked_when_enabled(monkeypatch):
         assert GRAPH.violations == []
     finally:
         ray_tpu.shutdown()
+
+
+def test_disabled_path_is_zero_overhead(monkeypatch):
+    """Regression guard for tracked_lock adoption across the runtime's
+    hot paths (cluster/daemon/head/node/worker/fast_lane): with the
+    sanitizer off, tracked_lock must return the PLAIN threading
+    primitive — the exact C-level type, no Python wrapper whose
+    acquire/release would tax every ledger operation."""
+    monkeypatch.delenv("RAY_TPU_LOCK_SANITIZER", raising=False)
+    plain = tracked_lock("zero.overhead", reentrant=False)
+    assert type(plain) is type(threading.Lock())
+    reent = tracked_lock("zero.overhead.r")     # reentrant default
+    assert type(reent) is type(threading.RLock())
+    # and the enabled path really does wrap (the inverse guard, so a
+    # future refactor can't silently disable tracking)
+    monkeypatch.setenv("RAY_TPU_LOCK_SANITIZER", "1")
+    assert isinstance(tracked_lock("zero.overhead.on"), TrackedLock)

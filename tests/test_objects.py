@@ -96,3 +96,44 @@ def test_shared_value_across_consumers(ray_start_regular):
 
     outs = ray_tpu.get([reader.remote(ref) for _ in range(4)])
     assert all(abs(o - big.sum()) < 1e-6 for o in outs)
+
+
+def test_store_restore_does_not_respill_itself(tmp_path):
+    """Regression: _restore cleared spilled_path BEFORE the pressure
+    scan, so _ensure_space could pick the very entry being restored,
+    re-spill it, and hand the caller value=None while _used
+    double-counted it. The entry is now pinned across the scan: with a
+    spillable neighbor the restore succeeds; with everything pinned the
+    infeasible restore raises OutOfMemoryError LOUDLY instead of
+    silently corrupting the read."""
+    from ray_tpu.exceptions import OutOfMemoryError
+
+    store = LocalObjectStore(NodeID.from_random(), capacity_bytes=100_000,
+                             spill_dir=str(tmp_path))
+    a, b = ObjectID.from_random(), ObjectID.from_random()
+    store.put(a, np.full(60_000, 1, dtype=np.uint8))
+    store.put(b, np.full(60_000, 2, dtype=np.uint8))     # spills a
+    assert store.stats["spills"] >= 1
+    # feasible: the scan spills b (unpinned), never the restoring a
+    val = store.get(a)
+    assert val is not None and val[0] == 1
+    # infeasible: b pinned, a shielded -> loud OOM, not value=None
+    store2 = LocalObjectStore(NodeID.from_random(),
+                              capacity_bytes=100_000,
+                              spill_dir=str(tmp_path / "s2"))
+    store2.put(a, np.full(60_000, 1, dtype=np.uint8))
+    store2.put(b, np.full(60_000, 2, dtype=np.uint8))
+    with store2._lock:
+        store2._entries[b].pinned += 1
+    with pytest.raises(OutOfMemoryError):
+        store2.get(a)
+    # the failed restore consumed NOTHING: accounting intact, spill
+    # file intact, and the restore succeeds once pressure drops
+    with store2._lock:
+        assert store2._used == 60_000          # b only; a uncounted
+        assert store2._entries[a].spilled_path is not None
+        store2._entries[b].pinned -= 1
+    val = store2.get(a)
+    assert val is not None and val[0] == 1
+    with store2._lock:
+        assert store2._used == 60_000          # b spilled, a resident

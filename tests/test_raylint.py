@@ -1,0 +1,685 @@
+"""tools/raylint unit tier: each pass catches its bug class on small
+synthetic modules, and stays quiet on the known-tricky non-bugs
+(re-entrant same-instance acquisition, try/finally manual
+acquire/release, conditional locking, intra-class ``notify`` calls).
+
+These are tier-1: pure AST analysis, no cluster, no sockets.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from tools.raylint import REGISTRY, run_passes
+from tools.raylint.core import Baseline, Context, Finding, Module
+
+
+def _module(source: str, name: str = "mod.py") -> Module:
+    return Module(name, name, textwrap.dedent(source))
+
+
+def _ctx(*sources, docs: str = "", tests: dict = None) -> Context:
+    modules = [_module(src, f"m{i}.py") for i, src in enumerate(sources)]
+    return Context(modules=modules, repo_root=os.getcwd(),
+                   docs_fault_tolerance=docs,
+                   tests_sources=tests if tests is not None else {})
+
+
+def _run(pass_id: str, ctx: Context):
+    return REGISTRY[pass_id](ctx)
+
+
+# ---------------------------------------------------------------------------
+# guarded-by
+# ---------------------------------------------------------------------------
+
+GUARDED_BAD = """
+    import threading
+
+    class Ledger:
+        def __init__(self):
+            self._items = {}   #: guarded by self._lock
+            self._lock = threading.Lock()
+
+        def ok(self):
+            with self._lock:
+                return len(self._items)
+
+        def racy(self):
+            return self._items.get("k")      # <-- unguarded access
+"""
+
+
+def test_guarded_by_true_positive():
+    findings = _run("guarded-by", _ctx(GUARDED_BAD))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.key == "Ledger.racy:_items"
+    assert "without holding" in f.message
+
+
+def test_guarded_by_ok_inside_with():
+    ok = GUARDED_BAD.replace(
+        'return self._items.get("k")      # <-- unguarded access',
+        'with self._lock:\n                return self._items.get("k")')
+    assert _run("guarded-by", _ctx(ok)) == []
+
+
+def test_guarded_by_manual_acquire_release_no_false_positive():
+    src = """
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._items = {}   #: guarded by self._lock
+                self._lock = threading.Lock()
+
+            def manual(self):
+                self._lock.acquire()
+                try:
+                    return len(self._items)   # held: acquired above
+                finally:
+                    self._lock.release()
+
+            def after_release(self):
+                self._lock.acquire()
+                self._lock.release()
+                return len(self._items)       # NOT held anymore
+    """
+    findings = _run("guarded-by", _ctx(src))
+    assert [f.key for f in findings] == ["Ledger.after_release:_items"]
+
+
+def test_guarded_by_conditional_locking_scoped_to_arm():
+    src = """
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._items = {}   #: guarded by self._lock
+                self._lock = threading.Lock()
+
+            def cond(self, fast):
+                if fast:
+                    with self._lock:
+                        return len(self._items)    # guarded arm: fine
+                return len(self._items)            # unguarded arm: flagged
+    """
+    findings = _run("guarded-by", _ctx(src))
+    assert [f.key for f in findings] == ["Ledger.cond:_items"]
+
+
+def test_guarded_by_init_exempt_and_suppression():
+    src = """
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._items = {}   #: guarded by self._lock
+                self._lock = threading.Lock()
+                self._items["warm"] = 1            # __init__: exempt
+
+            def deliberate(self):
+                return bool(self._items)  # raylint: disable=guarded-by
+    """
+    assert _run("guarded-by", _ctx(src)) == []
+
+
+def test_guarded_by_nested_thread_closure_is_unheld():
+    src = """
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._items = {}   #: guarded by self._lock
+                self._lock = threading.Lock()
+
+            def spawn(self):
+                with self._lock:
+                    def worker():
+                        return len(self._items)    # runs LATER, unlocked
+                    threading.Thread(target=worker).start()
+    """
+    findings = _run("guarded-by", _ctx(src))
+    assert [f.key for f in findings] == ["Ledger.spawn:_items"]
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+def test_lock_order_cycle_detected():
+    src = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._alock = threading.Lock()
+                self._block = threading.Lock()
+
+            def fwd(self):
+                with self._alock:
+                    with self._block:
+                        pass
+
+            def rev(self):
+                with self._block:
+                    with self._alock:
+                        pass
+    """
+    findings = _run("lock-order", _ctx(src))
+    keys = sorted(f.key for f in findings)
+    assert keys == ["m0.A._alock->m0.A._block",
+                    "m0.A._block->m0.A._alock"]
+
+
+def test_lock_order_reentrant_same_instance_skipped():
+    src = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._alock = threading.RLock()
+
+            def reenter(self):
+                with self._alock:
+                    with self._alock:      # same class: no edge
+                        pass
+    """
+    assert _run("lock-order", _ctx(src)) == []
+
+
+def test_lock_order_consistent_nesting_is_clean():
+    src = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._alock = threading.Lock()
+                self._block = threading.Lock()
+
+            def one(self):
+                with self._alock:
+                    with self._block:
+                        pass
+
+            def two(self):
+                with self._alock:
+                    with self._block:
+                        pass
+    """
+    assert _run("lock-order", _ctx(src)) == []
+
+
+def test_lock_order_manual_acquire_builds_edges():
+    src = """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._alock = threading.Lock()
+                self._block = threading.Lock()
+
+            def fwd(self):
+                self._alock.acquire()
+                try:
+                    self._block.acquire()
+                    self._block.release()
+                finally:
+                    self._alock.release()
+
+            def rev(self):
+                with self._block:
+                    with self._alock:
+                        pass
+    """
+    findings = _run("lock-order", _ctx(src))
+    assert len(findings) == 2       # both directions of the cycle
+
+
+def test_lock_order_tracked_lock_names_match_runtime():
+    src = """
+        from ray_tpu._private.lock_sanitizer import tracked_lock
+
+        class A:
+            def __init__(self):
+                self._alock = tracked_lock("alpha")
+                self._block = tracked_lock("beta")
+
+            def fwd(self):
+                with self._alock:
+                    with self._block:
+                        pass
+
+            def rev(self):
+                with self._block:
+                    with self._alock:
+                        pass
+    """
+    keys = sorted(f.key for f in _run("lock-order", _ctx(src)))
+    assert keys == ["alpha->beta", "beta->alpha"]
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+def test_blocking_flags_sleep_socket_rpc_subprocess():
+    src = """
+        import subprocess
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad_sleep(self):
+                with self._lock:
+                    time.sleep(1)
+
+            def bad_wire(self, sock):
+                with self._lock:
+                    sock.sendall(b"x")
+
+            def bad_rpc(self, client):
+                with self._lock:
+                    client.call("ping")
+
+            def bad_proc(self):
+                with self._lock:
+                    subprocess.run(["true"])
+    """
+    kinds = sorted(f.key for f in _run("blocking-under-lock", _ctx(src)))
+    assert kinds == [
+        "S.bad_proc:subprocess.run()",
+        "S.bad_rpc:RPC call() on client",
+        "S.bad_sleep:time.sleep()",
+        "S.bad_wire:socket sendall() on sock",
+    ]
+
+
+def test_blocking_cv_wait_and_wire_lock_exempt():
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._wlock = threading.Lock()
+
+            def fine_wait(self):
+                with self._cv:
+                    self._cv.wait(0.1)      # releases the lock: exempt
+
+            def fine_wire(self, sock):
+                with self._wlock:           # wire-write lock: exempt
+                    sock.sendall(b"frame")
+
+            def fine_outside(self, sock):
+                with self._cv:
+                    pass
+                sock.sendall(b"after")      # not under the lock
+    """
+    assert _run("blocking-under-lock", _ctx(src)) == []
+
+
+def test_blocking_manual_release_ends_the_region():
+    src = """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def ok(self):
+                self._lock.acquire()
+                self._lock.release()
+                time.sleep(0.01)            # after release: fine
+    """
+    assert _run("blocking-under-lock", _ctx(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# rpc-drift
+# ---------------------------------------------------------------------------
+
+RPC_OK = """
+    from ray_tpu._private import rpc
+    from ray_tpu._private.rpc import declare
+
+    declare("echo", "v")
+
+    class Svc:
+        def handle_echo(self, conn, rid, msg):
+            return {"v": msg["v"]}
+
+    class Caller:
+        def ask(self, client):
+            return client.call("echo", v=1)
+"""
+
+
+def test_rpc_drift_clean_roundtrip():
+    assert _run("rpc-drift", _ctx(RPC_OK)) == []
+
+
+def test_rpc_drift_renamed_handler_detected():
+    src = RPC_OK.replace("def handle_echo", "def handle_echo2")
+    keys = {f.key for f in _run("rpc-drift", _ctx(src))}
+    assert keys == {"unhandled:echo", "dead-declare:echo"}
+
+
+def test_rpc_drift_undeclared_call_site():
+    src = RPC_OK.replace('client.call("echo", v=1)',
+                         'client.call("ecoh", v=1)')
+    keys = {f.key for f in _run("rpc-drift", _ctx(src))}
+    assert "undeclared:ecoh" in keys
+
+
+def test_rpc_drift_intra_class_notify_is_not_rpc():
+    src = """
+        from ray_tpu._private import rpc
+
+        class Watcher:
+            def notify(self, reason):
+                self.reason = reason
+
+            def on_sigterm(self):
+                self.notify("sigterm")      # method call, not a frame
+    """
+    assert _run("rpc-drift", _ctx(src)) == []
+
+
+def test_rpc_drift_module_without_rpc_import_skipped():
+    src = """
+        class OtherProtocol:
+            def go(self, client):
+                client.call("own_wire_thing")
+    """
+    assert _run("rpc-drift", _ctx(src)) == []
+
+
+def test_rpc_drift_push_needs_consumer():
+    src = """
+        from ray_tpu._private import rpc
+
+        class Svc:
+            def done(self, conn):
+                conn.push("task_done", ok=True)
+    """
+    keys = {f.key for f in _run("rpc-drift", _ctx(src))}
+    assert keys == {"unconsumed:task_done"}
+    consumer = """
+        from ray_tpu._private import rpc
+
+        class Handle:
+            def _on_push(self, method, msg):
+                if method == "task_done":
+                    pass
+    """
+    assert _run("rpc-drift", _ctx(src, consumer)) == []
+
+
+# ---------------------------------------------------------------------------
+# failpoint-registry
+# ---------------------------------------------------------------------------
+
+FP_SRC = """
+    from ray_tpu._private import failpoints as _fp
+
+    def seam_a():
+        if _fp.ENABLED:
+            _fp.fire("mod.seam_a")
+
+    def seam_b():
+        if _fp.ENABLED:
+            _fp.fire("mod.seam_b")
+"""
+
+
+def test_failpoint_registry_all_green():
+    ctx = _ctx(FP_SRC, docs="| `mod.seam_a` | x |\n| `mod.seam_b` | y |",
+               tests={"test_x.py": 'fire("mod.seam_a"); "mod.seam_b"'})
+    assert _run("failpoint-registry", ctx) == []
+
+
+def test_failpoint_registry_undocumented_and_untested():
+    ctx = _ctx(FP_SRC, docs="| `mod.seam_a` | x |",
+               tests={"test_x.py": '"mod.seam_a"'})
+    keys = sorted(f.key for f in _run("failpoint-registry", ctx))
+    assert keys == ["undocumented:mod.seam_b", "untested:mod.seam_b"]
+
+
+def test_failpoint_registry_duplicate_seam():
+    dup = FP_SRC.replace('_fp.fire("mod.seam_b")',
+                         '_fp.fire("mod.seam_a")')
+    ctx = _ctx(dup, docs="| `mod.seam_a` | x |",
+               tests={"test_x.py": '"mod.seam_a"'})
+    keys = [f.key for f in _run("failpoint-registry", ctx)]
+    # the site count rides in the key: baselining a 2-site seam must
+    # not grandfather a future third site
+    assert keys == ["dup:mod.seam_a:2"]
+
+
+# ---------------------------------------------------------------------------
+# baseline + runner plumbing
+# ---------------------------------------------------------------------------
+
+def test_baseline_covers_and_reports_stale(tmp_path):
+    bl_path = tmp_path / "baseline.txt"
+    bl_path.write_text(
+        "guarded-by|m0.py|Ledger.racy:_items  # known racy read\n"
+        "guarded-by|m0.py|Gone.method:_x  # stale entry\n"
+        "# comment line\n\n")
+    baseline = Baseline.load(str(bl_path))
+    findings = _run("guarded-by", _ctx(GUARDED_BAD))
+    assert len(findings) == 1 and baseline.covers(findings[0])
+    assert baseline.unused(findings) == ["guarded-by|m0.py|Gone.method:_x"]
+
+
+def test_finding_keys_are_line_stable():
+    shifted = "\n\n\n" + textwrap.dedent(GUARDED_BAD)
+    a = _run("guarded-by", _ctx(GUARDED_BAD))
+    b = REGISTRY["guarded-by"](Context(
+        modules=[Module("m0.py", "m0.py", shifted)],
+        repo_root=os.getcwd(), docs_fault_tolerance="",
+        tests_sources={}))
+    assert [f.baseline_key() for f in a] == [f.baseline_key() for f in b]
+    assert a[0].line != b[0].line
+
+
+def test_run_passes_sorts_and_filters():
+    ctx = _ctx(GUARDED_BAD, docs="", tests={})
+    all_findings = run_passes(ctx)
+    only = run_passes(ctx, only={"guarded-by"})
+    assert [f.key for f in only] == ["Ledger.racy:_items"]
+    assert {f.pass_id for f in all_findings} >= {"guarded-by"}
+
+
+def test_cli_on_real_package_is_clean():
+    """The CI contract itself: the shipped package + shipped baseline
+    lint clean (exit 0), and the baseline stays within budget."""
+    from tools.raylint.__main__ import main
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rc = main([os.path.join(repo, "ray_tpu")])
+    assert rc == 0
+    with open(os.path.join(repo, "tools", "raylint",
+                           "baseline.txt")) as f:
+        entries = [ln for ln in f
+                   if ln.strip() and not ln.startswith("#")]
+    assert len(entries) <= 15
+
+
+def test_cli_exit_codes(tmp_path):
+    from tools.raylint.__main__ import main
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(GUARDED_BAD))
+    empty_baseline = tmp_path / "empty.txt"
+    empty_baseline.write_text("")
+    # exit 1: a NEW finding against an empty baseline
+    assert main([str(bad), "--baseline", str(empty_baseline)]) == 1
+    # exit 0: the same finding, baseline-covered (CI contract: the exit
+    # code distinguishes new findings from grandfathered ones)
+    covering = tmp_path / "covering.txt"
+    rel = os.path.relpath(str(bad), repo)   # the CLI keys on repo-rel
+    covering.write_text(
+        f"guarded-by|{rel}|Ledger.racy:_items  # known racy read\n")
+    assert main([str(bad), "--baseline", str(covering)]) == 0
+
+
+def test_guarded_by_release_in_finally_ends_region():
+    """Regression: a release() inside try/finally lives in a NESTED
+    block — it must still end the held region for code after the try,
+    both for guarded-by (access after = flagged) and for
+    blocking-under-lock (no false positive on a sleep after)."""
+    src = """
+        import threading
+        import time
+
+        class Ledger:
+            def __init__(self):
+                self._items = {}   #: guarded by self._lock
+                self._lock = threading.Lock()
+
+            def acquire_try_finally(self):
+                self._lock.acquire()
+                try:
+                    n = len(self._items)       # held
+                finally:
+                    self._lock.release()
+                return self._items.get("k")    # NOT held: flagged
+
+            def sleep_after_finally(self):
+                self._lock.acquire()
+                try:
+                    pass
+                finally:
+                    self._lock.release()
+                time.sleep(0.01)               # NOT held: no finding
+    """
+    guarded = _run("guarded-by", _ctx(src))
+    assert [f.key for f in guarded] == \
+        ["Ledger.acquire_try_finally:_items"]
+    assert _run("blocking-under-lock", _ctx(src)) == []
+
+
+def test_guarded_by_manual_region_spans_nested_blocks():
+    """A manual acquire held ACROSS nested control flow (the region is
+    function-flow, not lexical) keeps covering accesses inside it."""
+    src = """
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._items = {}   #: guarded by self._lock
+                self._lock = threading.Lock()
+
+            def held_through_if(self, flag):
+                self._lock.acquire()
+                try:
+                    if flag:
+                        return len(self._items)    # held
+                    return bool(self._items)       # held
+                finally:
+                    self._lock.release()
+    """
+    assert _run("guarded-by", _ctx(src)) == []
+
+
+def test_async_with_holds_the_lock():
+    """Regression: `async with self._lock:` must count as a held
+    region for all three lock passes (the async control-plane core is
+    exactly where this tool needs to see)."""
+    src = """
+        import asyncio
+        import time
+
+        class A:
+            def __init__(self):
+                self._items = {}   #: guarded by self._lock
+                self._lock = asyncio.Lock()
+                self._block = asyncio.Lock()
+
+            async def ok(self):
+                async with self._lock:
+                    return self._items.get("k")
+
+            async def blocking(self):
+                async with self._lock:
+                    time.sleep(1)          # held: flagged
+
+            async def fwd(self):
+                async with self._lock:
+                    async with self._block:
+                        pass
+
+            async def rev(self):
+                async with self._block:
+                    async with self._lock:
+                        pass
+    """
+    assert _run("guarded-by", _ctx(src)) == []
+    blocked = _run("blocking-under-lock", _ctx(src))
+    assert [f.key for f in blocked] == ["A.blocking:time.sleep()"]
+    assert len(_run("lock-order", _ctx(src))) == 2
+
+
+def test_lambda_bodies_are_not_held_regions():
+    """Regression: a lambda defers execution — a blocking call inside
+    one under a lock is NOT blocking-under-lock (it runs later,
+    unlocked). The symmetric guarded-by blind spot (deferred unguarded
+    access inside a lambda) is a documented non-goal; thread targets
+    written as nested defs ARE caught (see
+    test_guarded_by_nested_thread_closure_is_unheld)."""
+    src = """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def deferred(self):
+                with self._lock:
+                    cb = lambda: time.sleep(1)     # runs later
+                return cb
+    """
+    assert _run("blocking-under-lock", _ctx(src)) == []
+
+
+def test_blocking_rpc_notify_not_exempt():
+    """Regression: `.notify()` is cv-protocol ONLY on a lock-like
+    receiver; rpc.Client.notify sends a wire frame and must be flagged
+    under a held lock."""
+    src = """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self._lock = threading.Lock()
+
+            def fine(self):
+                with self._cv:
+                    self._cv.notify()              # lock protocol
+
+            def convoy(self, client):
+                with self._lock:
+                    client.notify("report", x=1)   # wire frame: flagged
+    """
+    keys = [f.key for f in _run("blocking-under-lock", _ctx(src))]
+    assert keys == ["S.convoy:RPC notify() on client"]
+
+
+def test_baseline_single_space_comment_parses(tmp_path):
+    """Regression: a justification typed with ONE space before the #
+    must still parse (key side never contains whitespace)."""
+    bl = tmp_path / "b.txt"
+    bl.write_text("guarded-by|m0.py|Ledger.racy:_items # known racy\n")
+    baseline = Baseline.load(str(bl))
+    findings = _run("guarded-by", _ctx(GUARDED_BAD))
+    assert len(findings) == 1 and baseline.covers(findings[0])

@@ -1,0 +1,39 @@
+"""raylint: AST-based concurrency & wire-protocol static analysis.
+
+The reactive half of this repo's correctness tooling is the runtime
+``lock_sanitizer`` (it observes the locks tests happen to exercise) and
+the chaos tier (it injects the faults a schedule happens to contain).
+raylint is the proactive half: whole-package static passes over the
+recurring bug classes this codebase has actually shipped —
+
+- ``guarded-by``        annotated shared state touched outside its lock
+- ``lock-order``        cycles in the static acquired-before graph
+- ``blocking-under-lock``  wire I/O / sleeps / RPC inside a held lock
+- ``rpc-drift``         client method literals vs server dispatch tables
+- ``failpoint-registry``  fire() names unique + documented + tested
+
+Run: ``python -m tools.raylint ray_tpu/`` (CI stage 0.5, fail-fast).
+Docs: ``docs/static_analysis.md``. No ``--fix`` by design: every fix is
+a semantic change a human (or a baseline justification) must own.
+"""
+
+from tools.raylint import (blocking, failpoints_pass,  # noqa: F401
+                           guarded_by, lock_order, rpc_drift)
+from tools.raylint.core import (Baseline, Context, Finding,  # noqa: F401
+                                Module, REGISTRY, collect_py_files,
+                                load_modules)
+
+__all__ = ["Baseline", "Context", "Finding", "Module", "REGISTRY",
+           "collect_py_files", "load_modules", "run_passes"]
+
+
+def run_passes(ctx: Context, only=None):
+    """Run registered passes (all, or the ids in ``only``) and return
+    the combined findings sorted by location."""
+    findings = []
+    for pass_id, fn in sorted(REGISTRY.items()):
+        if only and pass_id not in only:
+            continue
+        findings.extend(fn(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_id))
+    return findings

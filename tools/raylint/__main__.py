@@ -1,0 +1,119 @@
+"""raylint CLI.
+
+Usage::
+
+    python -m tools.raylint ray_tpu/ [more paths...]
+        [--baseline tools/raylint/baseline.txt] [--changed]
+        [--passes guarded-by,rpc-drift] [--list-passes]
+
+Exit codes (CI contract):
+
+- 0  clean, or every finding is baseline-covered (count printed)
+- 1  NEW findings (not in the baseline)
+- 2  usage / internal error
+
+``--changed`` restricts *reporting* to files in ``git diff
+--name-only HEAD`` (plus staged) — whole-package analysis still runs,
+so cross-file passes (lock-order, rpc-drift, failpoint-registry) see
+the full graph; the pre-commit path stays under ~2s because parsing is
+the only cost.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+from tools.raylint import (Baseline, Context, REGISTRY, collect_py_files,
+                           load_modules, run_passes)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.txt")
+
+
+def _changed_files(repo_root: str) -> set:
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=repo_root, capture_output=True, text=True, timeout=10)
+        names = set(out.stdout.split())
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "--cached"],
+            cwd=repo_root, capture_output=True, text=True, timeout=10)
+        names |= set(out.stdout.split())
+        return names
+    except (OSError, subprocess.SubprocessError):
+        return set()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="raylint")
+    parser.add_argument("paths", nargs="*", default=[],
+                        help="files or directories to lint")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE)
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report baseline-covered findings too")
+    parser.add_argument("--changed", action="store_true",
+                        help="report only on git-changed files")
+    parser.add_argument("--passes", default="",
+                        help="comma-separated pass ids to run")
+    parser.add_argument("--list-passes", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_passes:
+        for pass_id, fn in sorted(REGISTRY.items()):
+            doc = (sys.modules[fn.__module__].__doc__ or "").strip()
+            first = doc.splitlines()[0] if doc else ""
+            print(f"{pass_id:22s} {first}")
+        return 0
+    if not args.paths:
+        parser.error("no paths given (try: python -m tools.raylint "
+                     "ray_tpu/)")
+
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    files = collect_py_files(args.paths)
+    if not files:
+        print("raylint: no python files found", file=sys.stderr)
+        return 2
+    modules = load_modules(files, repo_root)
+    ctx = Context(modules=modules, repo_root=repo_root)
+    only = ({p.strip() for p in args.passes.split(",") if p.strip()}
+            or None)
+    if only and not only <= set(REGISTRY):
+        print(f"raylint: unknown passes {sorted(only - set(REGISTRY))}"
+              f" (known: {sorted(REGISTRY)})", file=sys.stderr)
+        return 2
+    findings = run_passes(ctx, only=only)
+
+    if args.changed:
+        changed = _changed_files(repo_root)
+        findings = [f for f in findings if f.path in changed]
+
+    baseline = (Baseline() if args.no_baseline
+                else Baseline.load(args.baseline))
+    new = [f for f in findings if not baseline.covers(f)]
+    covered = len(findings) - len(new)
+    for f in new:
+        print(f.render())
+    # stale detection is only meaningful on a FULL run: a --changed or
+    # --passes subset (or partial path args) simply didn't execute the
+    # checks behind most baseline entries
+    stale = (baseline.unused(findings)
+             if not args.changed and only is None else [])
+    for key in stale:
+        print(f"raylint: stale baseline entry (no longer fires): {key}",
+              file=sys.stderr)
+    n_files = len(modules)
+    if new:
+        print(f"raylint: {len(new)} new finding(s), {covered} "
+              f"baseline-covered, {n_files} files", file=sys.stderr)
+        return 1
+    print(f"raylint: clean ({covered} baseline-covered, {n_files} "
+          f"files)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

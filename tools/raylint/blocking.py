@@ -1,0 +1,106 @@
+"""blocking-under-lock pass: no wire I/O / sleeps / RPC inside a lock.
+
+The exact shape of the PR-4 futex-convoy bug: a shared lock held across
+a syscall (or a whole RPC round trip) turns every contending thread
+into a convoy. Flags, inside any lexically held lock region:
+
+- ``time.sleep(...)``
+- socket sends/receives (``send``/``sendall``/``recv``/``recv_into``/
+  ``recv_exact``/``connect``/``accept`` attribute calls)
+- RPC round trips: ``.call(...)`` / ``._call(...)`` /
+  ``.notify_driver(...)`` attribute calls
+- ``subprocess.*`` invocations (``Popen``/``run``/``check_call``/
+  ``check_output``/``call``)
+
+Deliberate exemptions:
+
+- condition-variable methods on the held lock itself (``cv.wait()``
+  releases it; ``notify``/``notify_all`` are cheap)
+- *wire-write locks* (attribute name matching ``wlock``/``send_lock``/
+  ``wire``): their entire purpose is serializing a socket write, so a
+  send under one is the design, not a bug
+- anything marked ``# raylint: disable=blocking-under-lock`` or
+  baselined with a justification
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.raylint.core import (Context, Finding, FuncScanner,
+                                expr_name, is_locky, is_wire_lock,
+                                iter_functions, register)
+
+PASS_ID = "blocking-under-lock"
+
+SOCKET_ATTRS = {"send", "sendall", "sendmsg", "recv", "recv_into",
+                "recvmsg", "recv_exact", "connect", "accept",
+                # Connection reply/push wrappers: one frame send each
+                "reply", "reply_error", "push"}
+RPC_ATTRS = {"call", "_call", "notify", "notify_driver"}
+SUBPROCESS_ATTRS = {"Popen", "run", "check_call", "check_output", "call"}
+# lock-protocol methods that are NOT blocking work — exempt only when
+# the RECEIVER itself looks like a lock/cv: `self._cv.notify()` is
+# protocol, `client.notify(...)` is a wire frame (rpc.Client.notify)
+LOCK_PROTOCOL = {"acquire", "release", "wait", "wait_for", "notify",
+                 "notify_all", "locked"}
+
+
+def _blocking_call(node: ast.Call) -> Optional[str]:
+    """Describe why this call is blocking, or None."""
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    recv = expr_name(func.value)
+    attr = func.attr
+    if recv == "time" and attr == "sleep":
+        return "time.sleep()"
+    if recv == "subprocess" and attr in SUBPROCESS_ATTRS:
+        return f"subprocess.{attr}()"
+    if recv is not None and attr in LOCK_PROTOCOL and (
+            is_locky(recv) or attr not in RPC_ATTRS):
+        return None     # cv.wait()/lock.acquire(): lock protocol
+    if attr in SOCKET_ATTRS:
+        return f"socket {attr}() on {recv or '<expr>'}"
+    if attr in RPC_ATTRS:
+        # skip subprocess-style receivers handled above, and method
+        # calls on self when the enclosing class defines them is the
+        # rpc-drift pass's concern; here any .call() round trip counts
+        return f"RPC {attr}() on {recv or '<expr>'}"
+    return None
+
+
+@register(PASS_ID)
+def run(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in ctx.modules:
+        for cls, fn in iter_functions(module.tree):
+            reported = set()
+
+            def on_node(node: ast.AST, held: List[str],
+                        _cls=cls, _fn=fn, _reported=reported) -> None:
+                if not held or not isinstance(node, ast.Call):
+                    return
+                # ignore wire-write locks: everything held is exempt
+                # only if ALL held locks are wire locks
+                effective = [h for h in held if not is_wire_lock(h)]
+                if not effective:
+                    return
+                why = _blocking_call(node)
+                if why is None:
+                    return
+                if module.suppressed(PASS_ID, node.lineno):
+                    return
+                where = f"{_cls}.{_fn.name}" if _cls else _fn.name
+                key = f"{where}:{why}"
+                if key in _reported:
+                    return
+                _reported.add(key)
+                findings.append(Finding(
+                    PASS_ID, module.relpath, node.lineno, key,
+                    f"{why} while holding {', '.join(effective)} "
+                    f"in {where}()"))
+
+            FuncScanner(on_node, visit_unheld=False).scan(fn)
+    return findings

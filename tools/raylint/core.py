@@ -1,0 +1,441 @@
+"""raylint core: module loading, pass registry, findings, baseline.
+
+The framework is deliberately small: a *module* is one parsed Python
+file plus the line-level metadata every pass needs (``#: guarded by``
+annotations, ``# raylint: disable=...`` suppressions); a *pass* is a
+callable over the whole module set returning :class:`Finding`\\ s. All
+passes see all modules — the interesting checks here (lock ordering,
+RPC drift, failpoint registry) are whole-program properties, so there
+is no per-file pass API to outgrow.
+
+Findings carry a *stable key* (no line numbers) so the checked-in
+baseline survives unrelated edits. The baseline is append-only by
+convention: new code must come up clean, grandfathered findings carry a
+one-line justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+# annotation comment marking an attribute as lock-guarded, e.g.
+#   self._streams = {}   #: guarded by self._slock
+GUARDED_RE = re.compile(r"#:\s*guarded by\s+(?P<lock>[A-Za-z_][\w.]*)")
+# inline suppression:  # raylint: disable=guarded-by,blocking-under-lock
+DISABLE_RE = re.compile(r"#\s*raylint:\s*disable=(?P<ids>[\w,-]+)")
+# with <expr> acquiring a lock whose attribute/name looks lock-like
+LOCKY_NAME_RE = re.compile(
+    r"(^|_)(lock|locks|cv|cond|mutex)($|_)|_lock$|lock$", re.IGNORECASE)
+# write-serialization locks: their entire purpose is holding a lock
+# across a wire write, so blocking-under-lock exempts them by name
+WIRE_LOCK_RE = re.compile(r"(^|_)(wlock|wire|send_lock)($|_)|wlock$",
+                          re.IGNORECASE)
+
+
+@dataclass(frozen=True)
+class Finding:
+    pass_id: str
+    path: str          # repo-relative path
+    line: int
+    key: str           # stable identity — never includes line numbers
+    message: str
+
+    def baseline_key(self) -> str:
+        return f"{self.pass_id}|{self.path}|{self.key}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+
+
+class Module:
+    """One parsed source file + per-line lint metadata."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.name = os.path.splitext(os.path.basename(path))[0]
+        # line -> lock expression text from "#: guarded by <lock>"
+        self.guarded_lines: Dict[int, str] = {}
+        # line -> set of disabled pass ids
+        self.suppressions: Dict[int, Set[str]] = {}
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        # tokenizing is the dominant per-file cost; most files carry
+        # neither annotation — the substring gate keeps the pre-commit
+        # --changed path under the ~2s budget
+        if "#:" not in self.source and "raylint:" not in self.source:
+            return
+        try:
+            tokens = tokenize.generate_tokens(StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = GUARDED_RE.search(tok.string)
+                if m:
+                    self.guarded_lines[tok.start[0]] = m.group("lock")
+                m = DISABLE_RE.search(tok.string)
+                if m:
+                    ids = {s.strip() for s in m.group("ids").split(",")}
+                    self.suppressions.setdefault(
+                        tok.start[0], set()).update(ids)
+        except tokenize.TokenError:
+            pass    # unterminated string etc.: annotations best-effort
+
+    def suppressed(self, pass_id: str, line: int) -> bool:
+        return pass_id in self.suppressions.get(line, ())
+
+
+@dataclass
+class Context:
+    """Whole-run inputs shared by every pass."""
+    modules: List[Module]
+    repo_root: str
+    # docs/tests content for cross-artifact passes; None -> read from
+    # repo_root lazily (tests inject synthetic content here)
+    docs_fault_tolerance: Optional[str] = None
+    tests_sources: Optional[Dict[str, str]] = None
+
+    def fault_tolerance_doc(self) -> str:
+        if self.docs_fault_tolerance is None:
+            p = os.path.join(self.repo_root, "docs", "fault_tolerance.md")
+            try:
+                with open(p, "r", encoding="utf-8") as f:
+                    self.docs_fault_tolerance = f.read()
+            except OSError:
+                self.docs_fault_tolerance = ""
+        return self.docs_fault_tolerance
+
+    def test_sources(self) -> Dict[str, str]:
+        if self.tests_sources is None:
+            self.tests_sources = {}
+            tdir = os.path.join(self.repo_root, "tests")
+            if os.path.isdir(tdir):
+                for name in sorted(os.listdir(tdir)):
+                    if not name.endswith(".py"):
+                        continue
+                    try:
+                        with open(os.path.join(tdir, name), "r",
+                                  encoding="utf-8") as f:
+                            self.tests_sources[name] = f.read()
+                    except OSError:
+                        pass
+        return self.tests_sources
+
+
+PassFn = Callable[[Context], List[Finding]]
+
+REGISTRY: Dict[str, PassFn] = {}
+
+
+def register(pass_id: str) -> Callable[[PassFn], PassFn]:
+    def deco(fn: PassFn) -> PassFn:
+        REGISTRY[pass_id] = fn
+        return fn
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers (held-lock tracking used by three passes)
+# ---------------------------------------------------------------------------
+
+def expr_name(node: ast.AST) -> Optional[str]:
+    """Dotted name of a simple expression: ``self._lock`` ->
+    "self._lock", ``wp._POOL_LOCK`` -> "wp._POOL_LOCK"."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = expr_name(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def is_locky(name: str) -> bool:
+    """Does a dotted expression look like a lock (by last component)?"""
+    return bool(LOCKY_NAME_RE.search(name.rsplit(".", 1)[-1]))
+
+
+def is_wire_lock(name: str) -> bool:
+    return bool(WIRE_LOCK_RE.search(name.rsplit(".", 1)[-1]))
+
+
+class FuncScanner:
+    """Statement-ordered walk of ONE function body tracking the set of
+    lexically held locks. Handles:
+
+    - ``with <lock>:`` (multiple items, nested)
+    - manual ``<lock>.acquire()`` ... ``try/finally: <lock>.release()``
+      (held region = acquire statement to release statement, any
+      control flow between them — conservative, per function)
+    - conditional acquisition: a lock acquired inside an ``if`` arm is
+      held only within that arm's lexical extent
+
+    ``on_node(node, held)`` fires for EVERY visited node with the
+    currently-held dotted lock names (a multiset via list).
+    ``visit_unheld=False`` skips descending into statements while no
+    lock is held — passes that only care about held regions (blocking,
+    lock-order) avoid walking ~95% of the package."""
+
+    def __init__(self, on_node, visit_unheld: bool = True):
+        self.on_node = on_node
+        self.visit_unheld = visit_unheld
+        # manually-acquired locks, FUNCTION-wide: acquire/release are
+        # control-flow (not lexically scoped like `with`), so a
+        # release() inside a nested block — the try/finally idiom —
+        # must end the held region for everything after it
+        self._manual: List[str] = []
+
+    def scan(self, func: ast.AST) -> None:
+        body = getattr(func, "body", [])
+        self._manual = []
+        self._scan_block(body, [])
+
+    def _eff(self, held: List[str]) -> List[str]:
+        """Effective held set at a visit point: lexical with-held plus
+        the current manually-acquired multiset."""
+        return held + self._manual if self._manual else held
+
+    def _scan_block(self, stmts: List[ast.stmt], held: List[str]) -> None:
+        for stmt in stmts:
+            self._scan_stmt(stmt, held)
+            name = self._manual_acquire(stmt)
+            if name is not None:
+                self._manual.append(name)
+            name = self._manual_release(stmt)
+            if name is not None and name in self._manual:
+                self._manual.remove(name)
+
+    def _manual_acquire(self, stmt: ast.stmt) -> Optional[str]:
+        call = self._lock_method_call(stmt, "acquire")
+        return call
+
+    def _manual_release(self, stmt: ast.stmt) -> Optional[str]:
+        return self._lock_method_call(stmt, "release")
+
+    @staticmethod
+    def _lock_method_call(stmt: ast.stmt, method: str) -> Optional[str]:
+        if not isinstance(stmt, ast.Expr):
+            return None
+        call = stmt.value
+        if not (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == method):
+            return None
+        name = expr_name(call.func.value)
+        if name and is_locky(name):
+            return name
+        return None
+
+    def _scan_stmt(self, stmt: ast.stmt, held: List[str]) -> None:
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in stmt.items:
+                self._visit_expr(item.context_expr, held + acquired)
+                name = expr_name(item.context_expr)
+                if name and is_locky(name):
+                    acquired.append(name)
+                if item.optional_vars is not None:
+                    self._visit_expr(item.optional_vars, held + acquired)
+            self._scan_block(stmt.body, held + acquired)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested function: runs later (often on another thread) —
+            # its body starts with NO held locks, lexical OR manual
+            self.on_node(stmt, self._eff(held))
+            for deco in stmt.decorator_list:
+                self._visit_expr(deco, held)
+            outer_manual, self._manual = self._manual, []
+            try:
+                self._scan_block(stmt.body, [])
+            finally:
+                self._manual = outer_manual
+            return
+        if isinstance(stmt, ast.ClassDef):
+            self.on_node(stmt, self._eff(held))
+            outer_manual, self._manual = self._manual, []
+            try:
+                for sub in stmt.body:
+                    self._scan_stmt(sub, [])
+            finally:
+                self._manual = outer_manual
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._visit_expr(stmt.test, held)
+            self._scan_block(stmt.body, held)
+            self._scan_block(stmt.orelse, held)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._visit_expr(stmt.target, held)
+            self._visit_expr(stmt.iter, held)
+            self._scan_block(stmt.body, held)
+            self._scan_block(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.Try):
+            self._scan_block(stmt.body, held)
+            for handler in stmt.handlers:
+                self._scan_block(handler.body, held)
+            self._scan_block(stmt.orelse, held)
+            self._scan_block(stmt.finalbody, held)
+            return
+        # leaf statement: visit all expressions inside it
+        eff = self._eff(held)
+        if not eff and not self.visit_unheld:
+            return
+        self.on_node(stmt, eff)
+        for node in _walk_skip_lambda(stmt):
+            if node is not stmt:
+                self.on_node(node, eff)
+
+    def _visit_expr(self, expr: ast.AST, held: List[str]) -> None:
+        eff = self._eff(held)
+        if not eff and not self.visit_unheld:
+            return
+        for node in _walk_skip_lambda(expr):
+            self.on_node(node, eff)
+
+
+def _walk_skip_lambda(root: ast.AST):
+    """ast.walk, but PRUNE lambda subtrees entirely: a lambda body runs
+    later (often on another thread or never), so neither a held-lock
+    claim nor a blocking-call finding inside it is sound. The deferred
+    body is deliberately not re-scanned unheld either — a conservative
+    blind spot the nested-``def`` path does cover (prefer a def for
+    thread targets)."""
+    from collections import deque
+    todo = deque([root])
+    while todo:
+        node = todo.popleft()
+        if isinstance(node, ast.Lambda):
+            continue
+        todo.extend(ast.iter_child_nodes(node))
+        yield node
+
+
+def iter_functions(tree: ast.Module
+                   ) -> Iterable[Tuple[Optional[str], ast.AST]]:
+    """Yield (enclosing class name or None, function node) for every
+    top-level and method-level function in a module (nested functions
+    are reached by FuncScanner itself)."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield None, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    yield node.name, sub
+
+
+def tracked_lock_name(value: ast.AST) -> Optional[str]:
+    """If ``value`` is a ``tracked_lock("name", ...)`` call, return the
+    stable runtime lock-class name — the static passes share the lock
+    sanitizer's naming so the two views agree."""
+    if (isinstance(value, ast.Call)
+            and ((isinstance(value.func, ast.Name)
+                  and value.func.id == "tracked_lock")
+                 or (isinstance(value.func, ast.Attribute)
+                     and value.func.attr == "tracked_lock"))
+            and value.args
+            and isinstance(value.args[0], ast.Constant)
+            and isinstance(value.args[0].value, str)):
+        return value.args[0].value
+    return None
+
+
+def class_lock_names(module: Module) -> Dict[Tuple[str, str], str]:
+    """(ClassName, attr) -> stable lock-class name for every lock-like
+    attribute assigned in a class body. tracked_lock("x") names win;
+    plain locks fall back to ``module.Class.attr``."""
+    out: Dict[Tuple[str, str], str] = {}
+    for node in module.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for fn in node.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                for target in stmt.targets:
+                    name = expr_name(target)
+                    if (not name or not name.startswith("self.")
+                            or not is_locky(name)):
+                        continue
+                    attr = name[len("self."):]
+                    tname = tracked_lock_name(stmt.value)
+                    out[(node.name, attr)] = (
+                        tname if tname
+                        else f"{module.name}.{node.name}.{attr}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Baseline:
+    entries: Dict[str, str] = field(default_factory=dict)  # key -> note
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        bl = cls()
+        if not os.path.exists(path):
+            return bl
+        with open(path, "r", encoding="utf-8") as f:
+            for raw in f:
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                # keys never contain whitespace, so split on the first
+                # space-then-# — tolerant of one OR two spaces before
+                # the justification comment
+                key, _, note = line.partition(" #")
+                bl.entries[key.strip()] = note.strip()
+        return bl
+
+    def covers(self, finding: Finding) -> bool:
+        return finding.baseline_key() in self.entries
+
+    def unused(self, findings: List[Finding]) -> List[str]:
+        seen = {f.baseline_key() for f in findings}
+        return [k for k in self.entries if k not in seen]
+
+
+def load_modules(paths: Iterable[str], repo_root: str) -> List[Module]:
+    modules: List[Module] = []
+    for path in sorted(set(paths)):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            continue
+        rel = os.path.relpath(path, repo_root)
+        try:
+            modules.append(Module(path, rel, source))
+        except SyntaxError as e:
+            raise SystemExit(f"raylint: cannot parse {rel}: {e}")
+    return modules
+
+
+def collect_py_files(args: Iterable[str]) -> List[str]:
+    files: List[str] = []
+    for arg in args:
+        if os.path.isdir(arg):
+            for dirpath, dirnames, filenames in os.walk(arg):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        files.append(os.path.join(dirpath, fn))
+        elif arg.endswith(".py"):
+            files.append(arg)
+    return files

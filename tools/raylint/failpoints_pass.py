@@ -1,0 +1,82 @@
+"""failpoint-registry pass: fire() names are unique, documented, tested.
+
+Every ``_fp.fire("name")`` seam in the runtime is part of the fault-
+injection contract (docs/fault_tolerance.md's failpoint table, the
+chaos tier's schedules). This pass keeps the three views in sync:
+
+- **unique**: one failpoint name = one seam. The same name fired from
+  two call sites makes hit counts and chaos schedules ambiguous (a
+  deliberately shared seam — e.g. ``trace.flush`` on both the daemon
+  and driver flushers — goes in the baseline with its justification).
+- **documented**: the name appears in ``docs/fault_tolerance.md``.
+- **tested**: the name appears in at least one file under ``tests/``
+  (a failpoint no test can trigger is dead chaos surface).
+
+The definition module (``failpoints.py`` itself) and test files are
+not fire *seams* and are excluded from collection.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from tools.raylint.core import Context, Finding, register
+
+PASS_ID = "failpoint-registry"
+
+
+def _fire_sites(ctx: Context) -> Dict[str, List[Tuple[str, int]]]:
+    sites: Dict[str, List[Tuple[str, int]]] = {}
+    for module in ctx.modules:
+        if module.name == "failpoints":
+            continue        # the registry itself, not a seam
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = None
+            if isinstance(node.func, ast.Attribute):
+                fname = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                fname = node.func.id
+            if fname != "fire":
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            if module.suppressed(PASS_ID, node.lineno):
+                continue
+            sites.setdefault(node.args[0].value, []).append(
+                (module.relpath, node.lineno))
+    return sites
+
+
+@register(PASS_ID)
+def run(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    sites = _fire_sites(ctx)
+    doc = ctx.fault_tolerance_doc()
+    tests = ctx.test_sources()
+    for name in sorted(sites):
+        locs = sites[name]
+        if len(locs) > 1:
+            path, line = locs[1]
+            others = ", ".join(f"{p}:{ln}" for p, ln in locs[:1])
+            # the site COUNT is part of the key: a baselined 2-site
+            # seam must not silently grandfather a third site
+            findings.append(Finding(
+                PASS_ID, path, line, f"dup:{name}:{len(locs)}",
+                f"failpoint {name!r} fired from {len(locs)} call sites "
+                f"(also at {others}); one name = one seam"))
+        path, line = locs[0]
+        if f"`{name}`" not in doc and name not in doc:
+            findings.append(Finding(
+                PASS_ID, path, line, f"undocumented:{name}",
+                f"failpoint {name!r} missing from "
+                f"docs/fault_tolerance.md's failpoint table"))
+        if not any(name in src for src in tests.values()):
+            findings.append(Finding(
+                PASS_ID, path, line, f"untested:{name}",
+                f"failpoint {name!r} is not exercised by any test "
+                f"under tests/"))
+    return findings

@@ -1,0 +1,120 @@
+"""guarded-by pass: annotated shared state is only touched under its lock.
+
+Annotation syntax — a comment on the attribute's initialization line::
+
+    self._streams = {}   #: guarded by self._slock
+
+Every other access to ``self._streams`` anywhere in the class must then
+sit lexically inside a ``with self._slock:`` block (or between a manual
+``self._slock.acquire()`` / ``.release()`` pair). ``__init__`` and
+``__del__`` are exempt (single-threaded construction/teardown), as is
+the annotated line itself. Re-entrant acquisition of the same lock and
+conditional locking are handled by the shared held-lock scanner;
+deliberate lock-free reads get a ``# raylint: disable=guarded-by``
+or a baseline entry with justification.
+
+Limitation (by design): the check is lexical and per-function. A helper
+that requires the lock held by its caller needs its own ``with`` (use
+an RLock) or a suppression comment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from tools.raylint.core import (Context, Finding, FuncScanner, Module,
+                                expr_name, register)
+
+PASS_ID = "guarded-by"
+
+
+def _annotations(module: Module) -> Dict[str, Dict[str, Tuple[str, int]]]:
+    """ClassName -> {attr: (lock_expr, line)} from annotated assigns."""
+    out: Dict[str, Dict[str, Tuple[str, int]]] = {}
+    for node in module.tree.body:
+        if not isinstance(node, ast.ClassDef):
+            continue
+        attrs: Dict[str, Tuple[str, int]] = {}
+        assigns = [stmt for stmt in ast.walk(node)
+                   if isinstance(stmt, (ast.Assign, ast.AnnAssign))]
+        # same-line annotations bind first; an annotation on its OWN
+        # line (long assignment lines) then binds to the next
+        # assignment below it — never to one whose line already holds
+        # an annotation of its own
+        same_line = {stmt.lineno for stmt in assigns
+                     if stmt.lineno in module.guarded_lines}
+        for stmt in assigns:
+            lock = module.guarded_lines.get(stmt.lineno)
+            if lock is None and (stmt.lineno - 1) not in same_line:
+                lock = module.guarded_lines.get(stmt.lineno - 1)
+            if lock is None:
+                continue
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for target in targets:
+                name = expr_name(target)
+                if name and name.startswith("self."):
+                    attrs[name[len("self."):]] = (lock, stmt.lineno)
+        if attrs:
+            out[node.name] = attrs
+    return out
+
+
+@register(PASS_ID)
+def run(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in ctx.modules:
+        per_class = _annotations(module)
+        if not per_class:
+            continue
+        for node in module.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            attrs = per_class.get(node.name)
+            if not attrs:
+                continue
+            for fn in node.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                if fn.name in ("__init__", "__del__"):
+                    continue
+                findings.extend(
+                    _check_method(module, node.name, fn, attrs))
+    return findings
+
+
+def _check_method(module: Module, cls: str, fn: ast.AST,
+                  attrs: Dict[str, Tuple[str, int]]) -> List[Finding]:
+    findings: List[Finding] = []
+    reported = set()
+
+    def on_node(node: ast.AST, held: List[str]) -> None:
+        if not isinstance(node, ast.Attribute):
+            return
+        name = expr_name(node)
+        if not name or not name.startswith("self."):
+            return
+        attr = name[len("self."):]
+        entry = attrs.get(attr)
+        if entry is None:
+            return
+        lock, decl_line = entry
+        if node.lineno == decl_line:
+            return
+        if lock in held:
+            return
+        if module.suppressed(PASS_ID, node.lineno):
+            return
+        key = f"{cls}.{getattr(fn, 'name', '?')}:{attr}"
+        if key in reported:
+            return      # one finding per (method, attr)
+        reported.add(key)
+        findings.append(Finding(
+            PASS_ID, module.relpath, node.lineno, key,
+            f"{cls}.{attr} is annotated 'guarded by {lock}' but "
+            f"accessed in {fn.name}() without holding it"))
+
+    FuncScanner(on_node).scan(fn)
+    return findings

@@ -1,0 +1,143 @@
+"""static lock-order pass: no cycles in the acquired-before graph.
+
+Extracts every lexically nested lock acquisition (``with a: ... with
+b:`` and manual acquire/release pairs) across the whole package and
+builds the class-level acquired-before graph — the same model the
+runtime ``lock_sanitizer._Graph`` builds from live executions (Linux
+lockdep's class-level discipline), but over ALL code paths instead of
+only the ones tests happen to drive.
+
+Lock class naming matches the runtime sanitizer: a lock created via
+``tracked_lock("name")`` is class ``name``; plain locks are
+``module.Class.attr`` (or ``module.NAME`` at module scope). Same-class
+nested acquisition is skipped, exactly like the runtime graph — it
+would need lockdep-style nesting annotations to express.
+
+A cycle is reported once per participating edge, keyed by the edge pair
+so the baseline survives line churn.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.raylint.core import (Context, Finding, FuncScanner, Module,
+                                class_lock_names, expr_name, is_locky,
+                                iter_functions, register,
+                                tracked_lock_name)
+
+PASS_ID = "lock-order"
+
+
+def _module_lock_names(module: Module) -> Dict[str, str]:
+    """Module-level lock assignments: NAME -> stable class name."""
+    out: Dict[str, str] = {}
+    for stmt in module.tree.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        for target in stmt.targets:
+            if isinstance(target, ast.Name) and is_locky(target.id):
+                tname = tracked_lock_name(stmt.value)
+                out[target.id] = tname or f"{module.name}.{target.id}"
+    return out
+
+
+def _canon(dotted: str, cls: Optional[str],
+           class_names: Dict[Tuple[str, str], str],
+           mod_names: Dict[str, str], module: Module) -> str:
+    """Map a dotted lock expression at a use site to its lock class."""
+    if dotted.startswith("self.") and cls is not None:
+        attr = dotted[len("self."):]
+        return class_names.get((cls, attr),
+                               f"{module.name}.{cls}.{attr}")
+    if "." not in dotted:
+        return mod_names.get(dotted, f"{module.name}.{dotted}")
+    # foreign attribute (e.g. wp._POOL_LOCK, router._lock): name by the
+    # final component — cross-module canonical names need the runtime
+    # tracked_lock() registry, which class_lock_names of the defining
+    # module provides when linted together
+    return dotted.rsplit(".", 1)[-1]
+
+
+@register(PASS_ID)
+def run(ctx: Context) -> List[Finding]:
+    # edge -> first-seen (path, line)
+    edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    all_class_names: Dict[Tuple[str, str], str] = {}
+    for module in ctx.modules:
+        all_class_names.update(class_lock_names(module))
+
+    for module in ctx.modules:
+        mod_names = _module_lock_names(module)
+        for cls, fn in iter_functions(module.tree):
+            _record_edges(module, cls, fn, all_class_names, mod_names,
+                          edges)
+
+    findings: List[Finding] = []
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    for (a, b), (path, line) in sorted(edges.items(),
+                                       key=lambda kv: kv[1]):
+        if _reaches(graph, b, a):
+            findings.append(Finding(
+                PASS_ID, path, line, f"{a}->{b}",
+                f"lock-order cycle: acquiring {b!r} while holding "
+                f"{a!r}, but a {b!r} -> ... -> {a!r} path exists "
+                f"elsewhere in the package"))
+    return findings
+
+
+def _record_edges(module: Module, cls: Optional[str], fn: ast.AST,
+                  class_names: Dict[Tuple[str, str], str],
+                  mod_names: Dict[str, str],
+                  edges: Dict[Tuple[str, str], Tuple[str, int]]) -> None:
+    """Collect held->acquiring edges from one function."""
+
+    def canon(dotted: str) -> str:
+        return _canon(dotted, cls, class_names, mod_names, module)
+
+    class Recorder(FuncScanner):
+        def _scan_stmt(self, stmt, held):
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired: List[str] = []
+                for item in stmt.items:
+                    name = expr_name(item.context_expr)
+                    if name and is_locky(name):
+                        self._edge(self._eff(held) + acquired, name,
+                                   stmt.lineno)
+                        acquired.append(name)
+                self._scan_block(stmt.body, held + acquired)
+                return
+            name = self._manual_acquire(stmt)
+            if name is not None:
+                self._edge(self._eff(held), name, stmt.lineno)
+            super()._scan_stmt(stmt, held)
+
+        def _edge(self, held: List[str], acquiring: str,
+                  line: int) -> None:
+            acq = canon(acquiring)
+            for h in held:
+                hc = canon(h)
+                if hc == acq:
+                    continue    # re-entrant same class: runtime model
+                if module.suppressed(PASS_ID, line):
+                    continue
+                edges.setdefault((hc, acq), (module.relpath, line))
+
+    Recorder(lambda node, held: None, visit_unheld=False).scan(fn)
+
+
+def _reaches(graph: Dict[str, Set[str]], src: str, dst: str) -> bool:
+    seen: Set[str] = set()
+    stack = [src]
+    while stack:
+        cur = stack.pop()
+        if cur == dst:
+            return True
+        if cur in seen:
+            continue
+        seen.add(cur)
+        stack.extend(graph.get(cur, ()))
+    return False

@@ -1,0 +1,163 @@
+"""rpc-drift pass: client method-name literals match server dispatch.
+
+The wire protocol is stringly typed: ``client.call("push_task", ...)``
+dispatches to ``handle_push_task`` on whichever service the server
+wraps, with the message schema declared via ``declare("push_task",
+...)``. Rename a handler (or typo a call site) and nothing fails until
+the call 404s at runtime — on the wire, under load.
+
+Checks, across the whole linted package:
+
+- every string-literal method name at a ``.call("x")`` /
+  ``.notify("x")`` / ``._call("x")`` client site is ``declare()``\\ d
+  AND has a ``handle_x`` method on some service class;
+- every server-initiated push (``conn.push("x")`` /
+  ``notify_driver("x")``) has a consumer: the literal ``"x"`` appears
+  inside some ``_on_push`` demux function;
+- every ``declare()``\\ d method has a ``handle_x`` somewhere (a dead
+  declare is protocol drift in the other direction).
+
+False-positive guards: a ``self.method("x")`` call where the enclosing
+class itself defines ``method`` is an ordinary method call, not an RPC
+(e.g. ``PreemptionWatcher.notify("sigterm")``); modules that never
+import the rpc layer are skipped entirely (``util/client`` speaks its
+own protocol).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.raylint.core import Context, Finding, Module, register
+
+PASS_ID = "rpc-drift"
+
+CALL_ATTRS = {"call", "notify", "_call"}
+PUSH_ATTRS = {"push", "notify_driver"}
+
+
+def _imports_rpc(module: Module) -> bool:
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.endswith("rpc") or any(
+                    a.name == "rpc" for a in node.names):
+                return True
+        elif isinstance(node, ast.Import):
+            if any(a.name.endswith(".rpc") or a.name == "rpc"
+                   for a in node.names):
+                return True
+    return False
+
+
+def _first_str_arg(call: ast.Call) -> Optional[str]:
+    if (call.args and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, str)):
+        return call.args[0].value
+    return None
+
+
+def _class_methods(module: Module) -> Dict[str, Set[str]]:
+    out: Dict[str, Set[str]] = {}
+    for node in module.tree.body:
+        if isinstance(node, ast.ClassDef):
+            out[node.name] = {
+                sub.name for sub in node.body
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))}
+    return out
+
+
+@register(PASS_ID)
+def run(ctx: Context) -> List[Finding]:
+    declared: Dict[str, Tuple[str, int]] = {}
+    handlers: Set[str] = set()
+    push_consumers: Set[str] = set()
+    # (module, cls, method, name, line, kind) call/push sites
+    sites: List[Tuple[Module, Optional[str], str, str, int, str]] = []
+
+    for module in ctx.modules:
+        if not _imports_rpc(module):
+            continue
+        methods = _class_methods(module)
+        # handler tables + push-demux literals
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name.startswith("handle_"):
+                    handlers.add(node.name[len("handle_"):])
+                if node.name in ("_on_push", "on_push"):
+                    for sub in ast.walk(node):
+                        if (isinstance(sub, ast.Constant)
+                                and isinstance(sub.value, str)):
+                            push_consumers.add(sub.value)
+        # declare() schema table + client/push sites, with class context
+        for cls, scope in _walk_with_class(module.tree):
+            if not isinstance(scope, ast.Call):
+                continue
+            fname = None
+            if isinstance(scope.func, ast.Name):
+                fname = scope.func.id
+            elif isinstance(scope.func, ast.Attribute):
+                fname = scope.func.attr
+            name = _first_str_arg(scope)
+            if name is None:
+                continue
+            if fname == "declare":
+                declared.setdefault(name, (module.relpath, scope.lineno))
+                continue
+            if fname in CALL_ATTRS | PUSH_ATTRS:
+                if (isinstance(scope.func, ast.Attribute)
+                        and isinstance(scope.func.value, ast.Name)
+                        and scope.func.value.id == "self"
+                        and cls is not None
+                        and fname in methods.get(cls, ())):
+                    continue    # intra-class method call, not an RPC
+                if isinstance(scope.func, ast.Name):
+                    continue    # bare function named call/push: not rpc
+                kind = "push" if fname in PUSH_ATTRS else "call"
+                sites.append((module, cls, fname, name,
+                              scope.lineno, kind))
+
+    findings: List[Finding] = []
+    for module, cls, fname, name, line, kind in sites:
+        if module.suppressed(PASS_ID, line):
+            continue
+        where = f"{cls}." if cls else ""
+        if kind == "call":
+            if name not in declared:
+                findings.append(Finding(
+                    PASS_ID, module.relpath, line, f"undeclared:{name}",
+                    f"{where}{fname}({name!r}) has no declare() schema "
+                    f"— undeclared rpc method"))
+            elif name not in handlers:
+                findings.append(Finding(
+                    PASS_ID, module.relpath, line, f"unhandled:{name}",
+                    f"{where}{fname}({name!r}) has no handle_{name} on "
+                    f"any linted service class"))
+        else:
+            if name not in push_consumers:
+                findings.append(Finding(
+                    PASS_ID, module.relpath, line, f"unconsumed:{name}",
+                    f"{where}{fname}({name!r}) push has no _on_push "
+                    f"consumer for {name!r}"))
+    # dead declares (drift in the other direction)
+    for name, (path, line) in sorted(declared.items()):
+        if name not in handlers:
+            findings.append(Finding(
+                PASS_ID, path, line, f"dead-declare:{name}",
+                f"declare({name!r}) has no handle_{name} on any linted "
+                f"service class"))
+    return findings
+
+
+def _walk_with_class(tree: ast.Module):
+    """Yield (enclosing ClassDef name or None, node) for every node."""
+    def walk(node: ast.AST, cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            yield cls, child
+            child_cls = (child.name if isinstance(child, ast.ClassDef)
+                         else cls)
+            yield from walk(child, child_cls)
+
+    yield from walk(tree, None)

@@ -27,4 +27,18 @@ echo "=== chaos tier: daemons topology ==="
 RAY_TPU_CLUSTER=daemons python -m pytest tests/test_chaos.py -q -m chaos \
     -p no:cacheprovider -p no:randomly "$@"
 
-echo "chaos tier: OK (both topologies)"
+echo "=== chaos tier: lock-sanitizer seed (in-process topology) ==="
+# One seeded replay with the runtime lock-order sanitizer armed: the
+# tracked_lock classes (cluster/daemon/head/node/worker/fast_lane —
+# the same names tools/raylint's static lock-order pass reports on)
+# build the live acquired-before graph while faults fire. -W error
+# escalates main-thread inversions eagerly; inversions recorded on
+# runtime (daemon) threads fail the session via the conftest
+# pytest_sessionfinish gate on GRAPH.violations — a warning raised on
+# a background thread would otherwise die with that thread.
+RAY_TPU_LOCK_SANITIZER=1 RAY_TPU_CLUSTER= python -m pytest \
+    tests/test_chaos.py -q -m chaos -k "101" \
+    -W "error::ray_tpu._private.lock_sanitizer.LockOrderViolation" \
+    -p no:cacheprovider -p no:randomly "$@"
+
+echo "chaos tier: OK (both topologies + sanitized seed)"

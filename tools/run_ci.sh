@@ -18,6 +18,13 @@ echo "=== stage 0: observability (dashboard endpoints + task tracing) ==="
 # seconds instead of after the full sweep)
 python -m pytest tests/test_observability.py -x -q
 
+echo "=== stage 0.5: raylint (static concurrency/protocol analysis) ==="
+# fail-fast AST passes: guarded-by, lock-order, blocking-under-lock,
+# rpc-drift, failpoint-registry (docs/static_analysis.md). Exit 1 =
+# NEW findings (baseline-covered ones pass); runs in ~2s so protocol
+# or lock-discipline drift surfaces before any suite boots a cluster.
+python -m tools.raylint ray_tpu/
+
 echo "=== stage 1: full suite (in-process topology) ==="
 python -m pytest tests/ -x -q
 
