@@ -16,7 +16,10 @@ child emits heartbeat lines on stderr ("HB <stage>") so a timed-out run
 leaves a diagnosable tail instead of silence.
 
 Modes:
-  BENCH_SERVE=1          — serving benchmark (p50 TTFT + output tok/s)
+  BENCH_SERVE=1          — serving benchmark: OPEN-LOOP load through
+                           ray_tpu.loadgen against a Serve app
+                           (serving.requests_per_second +
+                           serving.ttft_p50_s/p99_s in the json)
                            instead of the training benchmark.
   BENCH_SERVE_HTTP=1     — proxy-level serving benchmark: the same
                            metrics measured at an HTTP client through
@@ -186,6 +189,8 @@ def _run_train(error: str | None) -> dict:
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu, 4),
+        "platform": dev.platform,
+        "tpu_fallback": not on_tpu,
         "detail": {
             "model_params": n_params,
             "config": "llama_400m" if on_tpu else "debug",
@@ -327,8 +332,35 @@ def _child() -> int:
             # spans-on vs spans-off delta, paired + median-of-ratios in
             # ONE cluster (sequential unpaired probes are a noise
             # lottery on shared hosts — see tools/perf_smoke.sh probe 4)
-            "tracing_overhead_pct": _tracing_overhead_probe()}
+            "tracing_overhead_pct": _tracing_overhead_probe(),
+            # every section carries the platform stamp so a partial
+            # json consumer can't mistake a CPU-fallback row for TPU
+            "platform": result.get("platform", "unknown"),
+            "tpu_fallback": result.get("tpu_fallback", True)}
     print(json.dumps(result))
+    return 0
+
+
+def _emit(line: str) -> int:
+    """Print the final BENCH json line — and degrade LOUDLY, not
+    silently, when it was produced on the CPU fallback (standing
+    ROADMAP issue: rounds 1-5 shipped CPU numbers that read like TPU
+    numbers)."""
+    print(line)
+    try:
+        obj = json.loads(line)
+    except ValueError:
+        return 0
+    if obj.get("tpu_fallback"):
+        bar = "!" * 72
+        print(
+            f"{bar}\n"
+            f"! BENCH RAN ON CPU FALLBACK "
+            f"(platform={obj.get('platform', '?')}).\n"
+            f"! These are NOT accelerator numbers — do not compare "
+            f"against TPU rounds.\n"
+            f"! error: {str(obj.get('error', 'none'))[:200]}\n"
+            f"{bar}", file=sys.stderr, flush=True)
     return 0
 
 
@@ -425,8 +457,7 @@ def main() -> int:
             break
         line, err, retryable = try_once(os.environ.copy(), budget)
         if line is not None:
-            print(line)
-            return 0
+            return _emit(line)
         if not retryable:
             break
         if attempt + 1 < attempts and remaining() > _CPU_RESERVE + 45:
@@ -438,17 +469,17 @@ def main() -> int:
     env["BENCH_ERROR"] = f"tpu backend unavailable: {err}"[:500]
     line, cpu_err, _ = try_once(env, max(60, remaining() - 10))
     if line is not None:
-        print(line)
-        return 0
-    print(json.dumps({
-        "metric": ("llm_serve_output_tokens_per_sec" if serve_mode
+        return _emit(line)
+    return _emit(json.dumps({
+        "metric": ("llm_serve_requests_per_second" if serve_mode
                    else "llama_train_tokens_per_sec_per_chip"),
         "value": 0.0,
-        "unit": "tokens/s" if serve_mode else "tokens/s/chip",
+        "unit": "req/s" if serve_mode else "tokens/s/chip",
         "vs_baseline": 0.0,
+        "platform": "none",
+        "tpu_fallback": True,
         "error": f"tpu: {err} | cpu fallback: {cpu_err}"[:700],
     }))
-    return 0
 
 
 if __name__ == "__main__":

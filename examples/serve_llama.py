@@ -7,8 +7,11 @@ HBM KV cache, bucketed prefill, batched decode. Also demonstrates the
 HTTP proxy plane.
 
 Run: python examples/serve_llama.py
+     python examples/serve_llama.py --load        # open-loop burst +
+                                                  # SLO report (loadgen)
 """
 
+import argparse
 import json
 import urllib.request
 
@@ -17,18 +20,12 @@ from ray_tpu import serve
 from ray_tpu.llm import LLMConfig, build_llm_app
 
 
-def main():
-    ray_tpu.init(num_nodes=1, ignore_reinit_error=True)
-    app = build_llm_app(LLMConfig(model_id="llama-demo", max_slots=4,
-                                  max_seq=256))
-    handle = serve.run(app)
-
-    # direct handle path
+def demo(handle):
+    """Single-request demo: handle path + HTTP path."""
     out = handle.remote({"prompt": "hello tpu", "max_tokens": 8}).result()
     print("handle:", {k: out[k] for k in ("text", "finish_reason",
                                           "ttft_s")})
 
-    # HTTP path
     port = serve.start_http_proxy(port=0)
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}/",
@@ -36,10 +33,53 @@ def main():
         headers={"Content-Type": "application/json"})
     with urllib.request.urlopen(req, timeout=120) as resp:
         print("http:", json.loads(resp.read())["finish_reason"])
+    return out
+
+
+def load(handle, rate: float, duration: float, clients: int):
+    """Open-loop burst through the loadgen subsystem: offered-rate
+    requests/s, TTFT/E2E percentiles, and goodput under an SLO
+    (docs/serving.md)."""
+    from ray_tpu.loadgen import (SLO, HandleTarget, LoadSpec,
+                                 format_report, run_load)
+
+    # warm the engine so the first TTFTs measure serving, not XLA
+    handle.remote({"prompt": [1] * 8, "max_tokens": 2}).result()
+    spec = LoadSpec(rate=rate, duration_s=duration, clients=clients,
+                    prompt_len="uniform:8:24", output_len=8, seed=0,
+                    slo=SLO(ttft_s=1.0, e2e_s=5.0))
+    report = run_load(HandleTarget(handle, stream=True), spec)
+    print(format_report(report))
+    return report
+
+
+def main(argv=()):
+    # default (): callable from tests without swallowing pytest's argv
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--load", action="store_true",
+                        help="run a short open-loop burst and print "
+                             "the SLO report (keeps the single-request "
+                             "demo as default)")
+    parser.add_argument("--rate", type=float, default=10.0)
+    parser.add_argument("--duration", type=float, default=3.0)
+    parser.add_argument("--clients", type=int, default=8)
+    args = parser.parse_args(list(argv))
+
+    ray_tpu.init(num_nodes=1, ignore_reinit_error=True)
+    app = build_llm_app(LLMConfig(model_id="llama-demo", max_slots=4,
+                                  max_seq=256))
+    handle = serve.run(app)
+
+    if args.load:
+        out = load(handle, args.rate, args.duration, args.clients)
+    else:
+        out = demo(handle)
 
     serve.shutdown()
     return out
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    main(sys.argv[1:])

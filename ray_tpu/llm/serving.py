@@ -113,6 +113,13 @@ class LLMServer:
     def stats(self) -> Dict[str, Any]:
         return dict(self.engine.stats)
 
+    def queue_depth(self) -> int:
+        """Engine backlog beyond the decode slots: requests submitted
+        but still waiting for admission. The serve replica reports this
+        with its metrics push (serve/replica.py), so routers and the
+        autoscaler see engine pressure, not just request counts."""
+        return len(self.engine.waiting)
+
     def __del__(self):
         try:
             self._stop.set()

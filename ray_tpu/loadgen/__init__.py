@@ -1,0 +1,33 @@
+"""ray_tpu.loadgen — open-loop load generation + SLO benchmarking.
+
+The "millions of users" scenario made measurable (ROADMAP item 2):
+seeded Poisson/constant arrival schedules with configurable
+prompt/output-length distributions, N concurrent client workers over
+DeploymentHandles or the HTTP proxy (streaming-aware), per-request
+TTFT/TPOT/E2E/queue-time percentiles, and goodput under an SLO.
+
+Quick use::
+
+    from ray_tpu.loadgen import LoadSpec, SLO, HandleTarget, run_load
+    report = run_load(HandleTarget(handle),
+                      LoadSpec(rate=50, duration_s=10, clients=64,
+                               slo=SLO(ttft_s=0.5, e2e_s=5.0)))
+
+CLI: ``python -m ray_tpu.loadgen --clients 64 --rate 50 --duration 10``
+(or ``ray-tpu loadgen ...``). See docs/serving.md.
+"""
+
+from ray_tpu.loadgen.arrival import (ARRIVAL_KINDS, LengthSampler,
+                                     arrival_times)
+from ray_tpu.loadgen.recorder import (SLO, LatencyRecorder,
+                                      RequestRecord, percentile)
+from ray_tpu.loadgen.runner import (HTTPTarget, HandleTarget, LoadSpec,
+                                    build_payloads, format_report,
+                                    run_load)
+
+__all__ = [
+    "ARRIVAL_KINDS", "arrival_times", "LengthSampler",
+    "SLO", "LatencyRecorder", "RequestRecord", "percentile",
+    "LoadSpec", "HandleTarget", "HTTPTarget", "build_payloads",
+    "run_load", "format_report",
+]

@@ -53,6 +53,12 @@ class ObjectRefGenerator:
         return self
 
     def __next__(self) -> ObjectRef:
+        return self.next()
+
+    def next(self, timeout: Optional[float] = None) -> ObjectRef:
+        """``next(gen)`` with a deadline: raises ``GetTimeoutError``
+        after ``timeout`` seconds; the claimed index returns to the
+        hole set so a retry (or another consumer) re-claims it."""
         rt = worker.global_worker()
         state = rt.generator_state(self._task_id)
         with self._lock:
@@ -63,7 +69,7 @@ class ObjectRefGenerator:
                 index = self._index
                 self._index += 1
         try:
-            return state.next_ref(index)
+            return state.next_ref(index, timeout=timeout)
         except BaseException:
             with self._lock:
                 self._holes.add(index)

@@ -285,6 +285,14 @@ def cmd_job_submit(args) -> int:
     return 0 if status == "SUCCEEDED" else 1
 
 
+def cmd_loadgen(args) -> int:
+    # reached only when a global flag precedes the subcommand
+    # (`ray-tpu --num-nodes 2 loadgen ...`); the bare form short-circuits
+    # before argparse in main()
+    from ray_tpu.loadgen.__main__ import main as loadgen_main
+    return loadgen_main(args.rest)
+
+
 def cmd_attach(args) -> int:
     """Open a shell (or run a command) wired to the running cluster
     (reference: `ray attach` opens a shell on the head; the local
@@ -384,6 +392,12 @@ def _try_cluster_address() -> str:
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "loadgen":
+        # pass-through BEFORE argparse: the loadgen CLI owns its whole
+        # flag surface (argparse.REMAINDER drops a leading `--help`)
+        from ray_tpu.loadgen.__main__ import main as loadgen_main
+        return loadgen_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="ray-tpu", description="ray_tpu cluster CLI")
     parser.add_argument("--num-nodes", type=int, default=1)
@@ -426,6 +440,11 @@ def main(argv=None) -> int:
     p = sub.add_parser("job-submit")
     p.add_argument("entrypoint")
     p.add_argument("--timeout", type=float, default=300.0)
+    p = sub.add_parser(
+        "loadgen", add_help=False,
+        help="open-loop serving load generator "
+             "(see `ray-tpu loadgen --help`)")
+    p.add_argument("rest", nargs=argparse.REMAINDER)
     p = sub.add_parser("up")
     p.add_argument("config_file", help="cluster YAML (see "
                                        "ray_tpu/cluster_launcher.py)")
@@ -445,7 +464,16 @@ def main(argv=None) -> int:
                    help="session token for externally-bound sessions "
                         "(default: resolved from the cluster KV)")
 
-    args = parser.parse_args(argv)
+    args, extra = parser.parse_known_args(argv)
+    if args.command == "loadgen":
+        # global-flag-prefixed form (`ray-tpu --num-nodes 2 loadgen …`):
+        # REMAINDER cannot capture leading option-like tokens
+        # (bpo-17050), so hand loadgen everything after its own name.
+        # Safe slice: the only global flag takes an int value, so the
+        # first "loadgen" token IS the subcommand.
+        args.rest = argv[argv.index("loadgen") + 1:]
+    elif extra:
+        parser.error("unrecognized arguments: " + " ".join(extra))
     handler = {
         "start": cmd_start, "stop": cmd_stop,
         "cluster-status": cmd_cluster_status, "drain": cmd_drain,
@@ -454,7 +482,7 @@ def main(argv=None) -> int:
         "microbenchmark": cmd_microbenchmark, "dashboard": cmd_dashboard,
         "serve-deploy": cmd_serve_deploy, "job-submit": cmd_job_submit,
         "up": cmd_up, "down": cmd_down, "attach": cmd_attach,
-        "debug": cmd_debug,
+        "debug": cmd_debug, "loadgen": cmd_loadgen,
     }[args.command]
     return handler(args)
 

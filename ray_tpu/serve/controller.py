@@ -43,6 +43,8 @@ class _DeploymentState:
         # block on a per-replica RPC (reference: autoscaling_state.py).
         self.metrics_cache: Dict[int, Any] = {}
         self.started_at: Dict[int, float] = {}   # slot -> start time
+        # last autoscale decision inputs (status()/tests introspection)
+        self.autoscale_info: Dict[str, Any] = {}
         # slot -> actor id hex of the replica the CONTROLLER placed
         # there: reports from any other incarnation (e.g. a killed
         # in-process replica whose reporter thread is still running) are
@@ -74,6 +76,14 @@ class ServeController:
         self._long_poll = LongPollHost()
         self._scheduler = DeploymentScheduler()
         self._compact_counter = 0
+        # name -> (membership version, slot list, depth list) last
+        # pushed on the depths:: long-poll key (skip republishing
+        # unchanged views; gone slots get their gauge series removed)
+        self._depths_published: Dict[str, Any] = {}  #: guarded by self._lock
+        # federated queue-pressure signal: previous (sum, count) totals
+        # and the last computed per-tick mean — loop-thread only
+        self._phase_totals_prev = None
+        self._queue_pressure_last = 0.0
         self._recover_from_checkpoint()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -220,6 +230,7 @@ class ServeController:
                 st.metrics_cache[slot] = (m, time.monotonic())
 
     def _reconcile_one(self, name: str) -> None:
+        victims: List[Any] = []
         with self._lock:
             st = self._state.get(name)
             if st is None:
@@ -237,17 +248,48 @@ class ServeController:
                 changed = True
             while len(st.replicas) > target:
                 victim = st.replicas.pop()
-                st.replica_slots.pop()
+                slot = st.replica_slots.pop()
+                # drop the slot's bookkeeping NOW: a report from the
+                # still-draining victim must not resurrect the slot
+                st.metrics_cache.pop(slot, None)
+                st.replica_ids.pop(slot, None)
+                st.started_at.pop(slot, None)
+                victims.append(victim)
                 changed = True
-                self._scheduler.forget(name, victim)
-                try:
-                    ray_tpu.kill(victim)
-                except Exception:
-                    pass
             if changed:
                 st.version += 1
+            drain_timeout_s = st.deployment.graceful_shutdown_timeout_s
         if changed:
+            # publish FIRST so routers stop picking the victims, then
+            # drain: their in-flight requests finish instead of burning
             self._publish_replicas(name)
+        for victim in victims:
+            self._scheduler.forget(name, victim)
+            self._drain_replica(victim, drain_timeout_s)
+
+    def _drain_replica(self, victim, timeout_s: float) -> None:
+        """Deferred kill for a downscaled replica: a background thread
+        polls its reported load and kills only once ongoing+queue hit
+        zero (or the graceful window expires). Routers already dropped
+        it at the membership publish, so the load only drains."""
+        def waiter():
+            deadline = time.monotonic() + max(0.0, timeout_s)
+            while time.monotonic() < deadline:
+                try:
+                    m = ray_tpu.get(victim.metrics.remote(), timeout=2)
+                except Exception:
+                    break           # already dead / unreachable
+                if (m.get("ongoing", 0) <= 0
+                        and m.get("queue_depth", 0) <= 0):
+                    break
+                time.sleep(0.1)
+            try:
+                ray_tpu.kill(victim)
+            except Exception:
+                pass
+
+        threading.Thread(target=waiter, daemon=True,
+                         name="serve-replica-drain").start()
 
     def _check_health(self, name: str) -> None:
         with self._lock:
@@ -295,33 +337,146 @@ class ServeController:
             self._publish_replicas(name)
             self._reconcile_one(name)
 
+    # -- replica depth snapshots (routers + autoscaler) ----------------
+    def _replica_depths_locked(self, st: _DeploymentState) -> List[float]:
+        """Positional depth per replica — reported ongoing + engine
+        queue backlog from the pushed metrics cache. A stale/unseen
+        slot scores 0 (a freshly started replica must attract traffic,
+        not repel it). Call under ``self._lock``."""
+        now = time.monotonic()
+        depths: List[float] = []
+        for slot in st.replica_slots:
+            entry = st.metrics_cache.get(slot)
+            if entry is not None and now - entry[1] <= self._stale_after_s:
+                m = entry[0]
+                depths.append(float(m.get("ongoing", 0.0))
+                              + float(m.get("queue_depth", 0.0)))
+            else:
+                depths.append(0.0)
+        return depths
+
+    def _publish_depths(self, name: str) -> None:
+        """Fan the reported depths out to every handle's router on the
+        ``depths::<name>`` long-poll key (once per tick, only when the
+        view changed) — P2C then scores replicas by cluster-wide load,
+        not just handle-local in-flight."""
+        with self._lock:
+            st = self._state.get(name)
+            if st is None:
+                return
+            depths = self._replica_depths_locked(st)
+            version = st.version
+            slots = list(st.replica_slots)
+            prev = self._depths_published.get(name)
+            if prev == (version, slots, depths):
+                return
+            self._depths_published[name] = (version, slots, depths)
+        self._long_poll.publish(f"depths::{name}",
+                                {"depths": depths, "version": version})
+        try:
+            from ray_tpu.util.metrics import Gauge
+            gauge = Gauge("ray_tpu_serve_replica_depth",
+                          "reported replica depth (ongoing + engine "
+                          "queue) per deployment slot")
+            for slot, depth in zip(slots, depths):
+                gauge.set(depth, tags={"deployment": name,
+                                       "slot": str(slot)})
+            # a downscaled slot's series must not report its last
+            # depth forever
+            for slot in set(prev[1] if prev else ()) - set(slots):
+                gauge.remove(tags={"deployment": name,
+                                   "slot": str(slot)})
+        except Exception:
+            pass
+
+    def get_depths(self, name: str) -> Dict[str, Any]:
+        """Introspection: the current depth view (tests, dashboards)."""
+        with self._lock:
+            st = self._state.get(name)
+            if st is None:
+                raise KeyError(f"no deployment named {name!r}")
+            return {"version": st.version,
+                    "slots": list(st.replica_slots),
+                    "depths": self._replica_depths_locked(st)}
+
     # -- autoscaling ---------------------------------------------------
+    def _cluster_queue_totals(self):
+        """(sum_seconds, count) of the QUEUE phase of the federated
+        ``ray_tpu_task_phase_seconds`` histogram: this process's
+        registry merged with every node snapshot the head holds
+        (``metrics_get`` — PR 4's federation path)."""
+        from ray_tpu.util import metrics as _metrics
+        total_sum, total_cnt = 0.0, 0.0
+        parts = [({}, _metrics.export_snapshot())]
+        parts += _metrics._federated_parts()
+        for _extra, entries in parts:
+            for e in entries or []:
+                if (e.get("name") != "ray_tpu_task_phase_seconds"
+                        or e.get("kind") != "histogram"):
+                    continue
+                for key, _counts, hsum, count in e.get("hist", []):
+                    if dict((str(k), v) for k, v in key).get(
+                            "phase") != "queue":
+                        continue
+                    total_sum += hsum
+                    total_cnt += count
+        return total_sum, total_cnt
+
+    def _queue_pressure_s(self) -> float:
+        """Cluster-wide mean task queue-phase seconds since the last
+        tick. Best-effort: any failure (no federation, no histogram
+        yet, counter reset) reads as zero pressure."""
+        try:
+            cur = self._cluster_queue_totals()
+        except Exception:
+            return 0.0
+        prev, self._phase_totals_prev = self._phase_totals_prev, cur
+        if prev is None:
+            return 0.0
+        d_sum, d_cnt = cur[0] - prev[0], cur[1] - prev[1]
+        if d_cnt <= 0 or d_sum < 0:
+            return 0.0
+        return d_sum / d_cnt
+
     def _autoscale_one(self, name: str) -> None:
         with self._lock:
             st = self._state.get(name)
         if st is None or st.deployment.autoscaling_config is None:
             return
         cfg = st.deployment.autoscaling_config
-        # Read ONLY the pushed cache: the reconcile loop never issues a
+        # Scale signal 1: pushed per-replica DEPTH — ongoing requests
+        # plus engine queue backlog; the reconcile loop never issues a
         # per-replica RPC (reference: autoscaling_state.py keeps the
         # controller-side aggregate the same way).
-        total_ongoing = 0.0
-        now = time.monotonic()
         with self._lock:
-            for slot in st.replica_slots:
-                entry = st.metrics_cache.get(slot)
-                if entry is not None and \
-                        now - entry[1] <= self._stale_after_s:
-                    total_ongoing += entry[0].get("ongoing", 0.0)
-        desired = math.ceil(total_ongoing / cfg.target_ongoing_requests) \
+            total_load = sum(self._replica_depths_locked(st))
+        desired = math.ceil(total_load / cfg.target_ongoing_requests) \
             if cfg.target_ongoing_requests > 0 else cfg.min_replicas
         desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+        # Scale signal 2: the head's federated metrics — while the
+        # cluster-wide queue-phase latency (ray_tpu_task_phase_seconds
+        # via metrics_get) stays high, a downscale is vetoed even if
+        # the depth counts momentarily dipped.
+        pressure = self._queue_pressure_last
         now = time.time()
         with self._lock:
             current = st.target_replicas
+            st.autoscale_info = {
+                "total_load": round(total_load, 2),
+                "desired": desired,
+                "queue_pressure_s": round(pressure, 4),
+            }
             if desired > current:
                 delay = cfg.upscale_delay_s
             elif desired < current:
+                # the pressure signal is CLUSTER-wide: only let it veto
+                # while this deployment itself still reports load, or an
+                # unrelated batch sweep pins an idle deployment at peak
+                if (cfg.downscale_queue_guard_s > 0
+                        and pressure > cfg.downscale_queue_guard_s
+                        and total_load > 0):
+                    st.autoscale_info["held"] = "queue_pressure"
+                    return
                 delay = cfg.downscale_delay_s
             else:
                 return
@@ -330,6 +485,13 @@ class ServeController:
             st.target_replicas = desired
             st.last_scale_ts = now
         self._reconcile_one(name)
+        try:
+            from ray_tpu.util.metrics import Gauge
+            Gauge("ray_tpu_serve_target_replicas",
+                  "autoscaler target replica count").set(
+                desired, tags={"deployment": name})
+        except Exception:
+            pass
 
     def _loop(self) -> None:
         while not self._stop.wait(self._tick_s):
@@ -342,9 +504,13 @@ class ServeController:
             if rt is None or getattr(rt, "_shutdown", False):
                 return
             try:
+                # one federated queue-pressure sample per tick, shared
+                # by every deployment's autoscale decision
+                self._queue_pressure_last = self._queue_pressure_s()
                 for name in list(self._state):
                     self._check_health(name)
                     self._autoscale_one(name)
+                    self._publish_depths(name)
                 self._compact_counter += 1
                 if self._compact_counter % 20 == 0:
                     self._maybe_compact()
@@ -414,6 +580,11 @@ class ServeController:
                     "version": st.version,
                     "autoscaling": st.deployment.autoscaling_config
                     is not None,
+                    # last autoscale decision inputs (depth sum, the
+                    # federated queue-pressure sample, any hold)
+                    "autoscale": dict(st.autoscale_info),
+                    # reported per-replica depth (routing view)
+                    "depths": self._replica_depths_locked(st),
                     # slots with a fresh PUSHED metrics entry (replica
                     # reporter heartbeats; the controller never polls)
                     "metrics_fresh": sum(
@@ -426,6 +597,16 @@ class ServeController:
     def delete_deployment(self, name: str) -> None:
         with self._lock:
             st = self._state.pop(name, None)
+            published = self._depths_published.pop(name, None)
+        if published:
+            try:
+                from ray_tpu.util.metrics import Gauge
+                gauge = Gauge("ray_tpu_serve_replica_depth")
+                for slot in published[1]:
+                    gauge.remove(tags={"deployment": name,
+                                       "slot": str(slot)})
+            except Exception:
+                pass
         self._scheduler.forget_deployment(name)
         if st:
             for r in st.replicas:

@@ -19,6 +19,15 @@ class AutoscalingConfig:
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 0.0
     downscale_delay_s: float = 2.0
+    # Federated-metrics downscale guard: while the cluster-wide mean
+    # task QUEUE-phase latency over the last controller tick (from the
+    # head's ray_tpu_task_phase_seconds federation) exceeds this,
+    # downscale is deferred — depth counts can read low mid-burst while
+    # queueing latency says the cluster is still behind. Only applies
+    # while the deployment itself reports load (the signal is cluster-
+    # wide; unrelated work must not pin an IDLE deployment at peak).
+    # <=0 disables.
+    downscale_queue_guard_s: float = 0.5
 
 
 @dataclasses.dataclass
@@ -31,6 +40,12 @@ class Deployment:
     user_config: Optional[Dict[str, Any]] = None
     ray_actor_options: Optional[Dict[str, Any]] = None
     max_restarts: int = 3
+    # Downscaled replicas DRAIN: routers stop picking them at the
+    # membership publish, then the controller waits up to this long for
+    # reported ongoing+queue to hit zero before the kill — in-flight
+    # requests complete instead of burning (reference:
+    # graceful_shutdown_timeout_s on the deployment config).
+    graceful_shutdown_timeout_s: float = 10.0
 
     def options(self, **kwargs) -> "Deployment":
         return dataclasses.replace(self, **kwargs)
@@ -62,7 +77,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                                                   AutoscalingConfig]] = None,
                max_ongoing_requests: int = 16,
                user_config: Optional[Dict] = None,
-               ray_actor_options: Optional[Dict] = None):
+               ray_actor_options: Optional[Dict] = None,
+               graceful_shutdown_timeout_s: float = 10.0):
     """``@serve.deployment`` decorator."""
     def wrap(fc):
         asc = autoscaling_config
@@ -74,7 +90,8 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             autoscaling_config=asc,
             max_ongoing_requests=max_ongoing_requests,
             user_config=user_config,
-            ray_actor_options=ray_actor_options)
+            ray_actor_options=ray_actor_options,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s)
     if _func_or_class is not None:
         return wrap(_func_or_class)
     return wrap

@@ -145,9 +145,24 @@ class Replica:
                 self._ongoing -= 1
 
     def metrics(self) -> Dict[str, float]:
+        """Pushed to the controller by the reporter thread. ``ongoing``
+        counts requests inside the replica; ``queue_depth`` is extra
+        backlog the user callable reports through an optional
+        ``queue_depth()`` method (e.g. an LLM engine's waiting queue —
+        requests admitted but not yet holding a decode slot). The
+        controller publishes ``ongoing + queue_depth`` to routers and
+        feeds both to autoscaling."""
         with self._lock:
-            return {"ongoing": self._ongoing, "total": self._total,
-                    "ts": time.time()}
+            ongoing, total = self._ongoing, self._total
+        queue_depth = 0.0
+        probe = getattr(self._callable, "queue_depth", None)
+        if callable(probe):
+            try:
+                queue_depth = float(probe())
+            except Exception:
+                queue_depth = 0.0   # a broken probe must not kill reports
+        return {"ongoing": ongoing, "total": total,
+                "queue_depth": queue_depth, "ts": time.time()}
 
     def ping(self) -> bool:
         return True

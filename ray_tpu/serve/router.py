@@ -6,11 +6,17 @@ queue length), `serve/_private/long_poll.py` (membership push). Replica
 membership is PUSHED: each handle keeps a long-poll listen open against
 the controller (serve/long_poll.py) and applies snapshots the moment a
 deploy/scale/death publishes — no periodic-poll staleness window. Routing
-is P2C over locally-tracked in-flight counts.
+is P2C over REPORTED replica depth (ongoing + engine queue, pushed by
+replica reporters through the controller and fanned out on the
+``depths::<name>`` long-poll key) plus the handle's own in-flight
+delta — so independent client processes see each other's load instead
+of only their own (reference: pow_2_router.py routes on replica queue
+length, not handle-local counts).
 """
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -47,13 +53,26 @@ class DeploymentResponseGenerator:
         return self
 
     def __next__(self) -> Any:
+        return self.next()
+
+    def next(self, timeout: Optional[float] = None) -> Any:
+        """``next(gen)`` with a per-chunk deadline: raises
+        ``GetTimeoutError`` when the replica produces no chunk within
+        ``timeout`` seconds (the response is finished locally — an
+        abandoning client must not leak router in-flight counts)."""
         try:
-            ref = next(self._ref_gen)
+            if timeout is not None and hasattr(self._ref_gen, "next"):
+                ref = self._ref_gen.next(timeout=timeout)
+            else:
+                ref = next(self._ref_gen)
         except StopIteration:
             self._finish()
             raise
+        except Exception:
+            self._finish()
+            raise
         try:
-            return ray_tpu.get(ref)
+            return ray_tpu.get(ref, timeout=timeout)
         except Exception:
             self._finish()
             raise
@@ -77,14 +96,24 @@ class _HandleState:
     per deployment handle family (method composition must not multiply
     listener threads or parked controller listens)."""
 
-    def __init__(self, deployment_name: str, controller):
+    def __init__(self, deployment_name: str, controller,
+                 seed: Optional[int] = None):
         self.deployment_name = deployment_name
         self.controller = controller
         self.lock = threading.Lock()
-        self.replicas: List = []
-        self.version = -1
-        self.inflight: Dict[int, int] = {}
-        self.rng = random.Random(0)
+        self.replicas: List = []                 #: guarded by self.lock
+        self.version = -1                        #: guarded by self.lock
+        self.inflight: Dict[int, int] = {}       #: guarded by self.lock
+        # reported depth per replica index (controller-published view of
+        # ongoing + engine queue), valid for depths_version only
+        self.depths: List[float] = []            #: guarded by self.lock
+        self.depths_version = -1                 #: guarded by self.lock
+        # urandom-seeded: a FIXED seed marched every client process
+        # through identical P2C pairs in lockstep under many-client
+        # load (the herd all picks the same victim); ``seed=`` keeps
+        # tests deterministic.
+        self.rng = random.Random(
+            os.urandom(16) if seed is None else seed)
         self.long_poll = None
 
     def ensure_long_poll(self) -> None:
@@ -104,14 +133,31 @@ class _HandleState:
                 return
             with state.lock:
                 state.replicas = snapshot["replicas"]
-                state.version = version
+                state.version = snapshot.get("version", version)
                 state.inflight = {i: 0
                                   for i in range(len(state.replicas))}
+                # indexing changed: drop depths until a matching
+                # snapshot arrives (next controller tick)
+                if state.depths_version != state.version:
+                    state.depths = []
+
+        def on_depths(snapshot, version):
+            state = ref()
+            if state is None or not isinstance(snapshot, dict):
+                return
+            with state.lock:
+                # depths are positional over the replica list of ONE
+                # membership version; a mismatched snapshot (router
+                # ahead or behind) would mis-score replicas
+                if snapshot.get("version") == state.version:
+                    state.depths = list(snapshot.get("depths") or [])
+                    state.depths_version = snapshot["version"]
 
         try:
             client = LongPollClient(
                 self.controller,
-                {f"replicas::{self.deployment_name}": on_update})
+                {f"replicas::{self.deployment_name}": on_update,
+                 f"depths::{self.deployment_name}": on_depths})
         except Exception:
             with self.lock:
                 self.long_poll = None   # release the claim: retry later
@@ -201,16 +247,27 @@ class DeploymentHandle:
             state.replicas = info["replicas"]
             state.version = info["version"]
             state.inflight = {i: 0 for i in range(len(state.replicas))}
+            if state.depths_version != state.version:
+                state.depths = []   # positional depths no longer valid
+
+    def _score(self, idx: int) -> float:
+        """Load estimate for one replica: the controller-reported depth
+        (ongoing + engine queue across ALL clients, <=1 tick stale)
+        plus this handle's own in-flight count (the not-yet-reported
+        delta). Called under ``state.lock``."""
+        state = self._state
+        reported = (state.depths[idx]
+                    if idx < len(state.depths) else 0.0)
+        return reported + state.inflight.get(idx, 0)
 
     def _pick(self) -> int:
-        """Power-of-two-choices on local in-flight counts."""
+        """Power-of-two-choices on reported depth + local in-flight."""
         state = self._state
         n = len(state.replicas)
         if n == 1:
             return 0
         a, b = state.rng.sample(range(n), 2)
-        return (a if state.inflight.get(a, 0) <= state.inflight.get(b, 0)
-                else b)
+        return a if self._score(a) <= self._score(b) else b
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         state = self._state

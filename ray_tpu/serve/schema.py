@@ -55,7 +55,8 @@ def _import_attr(import_path: str) -> Any:
 # part of the declarative surface
 OVERRIDABLE_OPTIONS = {"num_replicas", "autoscaling_config",
                        "max_ongoing_requests", "user_config",
-                       "ray_actor_options", "max_restarts"}
+                       "ray_actor_options", "max_restarts",
+                       "graceful_shutdown_timeout_s"}
 
 
 def _apply_overrides(app: Application,

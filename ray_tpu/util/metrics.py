@@ -71,6 +71,12 @@ class Gauge(Metric):
             tags: Optional[Dict[str, str]] = None) -> None:
         self._set(_labels_key(tags), value)
 
+    def remove(self, tags: Optional[Dict[str, str]] = None) -> None:
+        """Drop one label series (e.g. a downscaled replica slot) so
+        the exposition stops reporting its last value forever."""
+        with self._lock:
+            self._values.pop(_labels_key(tags), None)
+
 
 class Histogram(Metric):
     kind = "histogram"

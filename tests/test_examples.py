@@ -39,6 +39,17 @@ def test_serve_llama_example(ray_start_regular):
     assert out["usage"]["completion_tokens"] == 8
 
 
+def test_serve_llama_example_load_mode(ray_start_regular):
+    """--load runs a short open-loop burst through ray_tpu.loadgen and
+    returns the SLO report (the single-request demo stays default)."""
+    import serve_llama
+    rep = serve_llama.main(["--load", "--rate", "6",
+                            "--duration", "1.5", "--clients", "4"])
+    assert rep["requests"]["errors"] == 0
+    assert rep["requests"]["completed"] == rep["scheduled_requests"] > 0
+    assert rep["goodput"]["slo"] == {"ttft_s": 1.0, "e2e_s": 5.0}
+
+
 def test_compiled_dag_pipeline_example():
     # pinned local: the example demonstrates (and asserts) the
     # driver-pool shm-channel mode, which correctly degrades to the

@@ -1,0 +1,237 @@
+"""loadgen: arrival determinism, percentile/goodput math, report
+schema, open-loop semantics, and the CLI against a debug-model app."""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ray_tpu.loadgen import (SLO, LatencyRecorder, LengthSampler,
+                             LoadSpec, RequestRecord, arrival_times,
+                             build_payloads, percentile, run_load)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# arrival schedules + length distributions
+# ---------------------------------------------------------------------------
+
+def test_poisson_schedule_deterministic_for_seed():
+    a = arrival_times("poisson", 50, 4, seed=7)
+    assert a == arrival_times("poisson", 50, 4, seed=7)
+    assert a != arrival_times("poisson", 50, 4, seed=8)
+    assert a == sorted(a)
+    assert all(0 <= t < 4 for t in a)
+    # E[n] = rate * duration = 200, sd ~14: loose 4-sigma bounds
+    assert 140 < len(a) < 260
+
+
+def test_constant_schedule_exact_spacing():
+    assert arrival_times("constant", 10, 1.0) == [
+        i / 10 for i in range(10)]
+
+
+def test_arrival_validation():
+    with pytest.raises(ValueError, match="unknown arrival"):
+        arrival_times("bursty", 1, 1)
+    with pytest.raises(ValueError, match="rate"):
+        arrival_times("poisson", 0, 1)
+    with pytest.raises(ValueError, match="duration"):
+        arrival_times("poisson", 1, 0)
+
+
+def test_length_sampler_forms():
+    r = random.Random(0)
+    assert LengthSampler.parse(32).sample(r) == 32
+    assert LengthSampler.parse("16").sample(r) == 16
+    uni = LengthSampler.parse("uniform:4:9")
+    assert {uni.sample(r) for _ in range(300)} == set(range(4, 10))
+    lgn = LengthSampler.parse("lognormal:64:0.5")
+    vals = sorted(lgn.sample(r) for _ in range(301))
+    assert all(v >= 1 for v in vals)
+    assert 40 < vals[150] < 100          # median ~64
+    with pytest.raises(ValueError):
+        LengthSampler.parse("uniform:9:4")
+    with pytest.raises(ValueError):
+        LengthSampler.parse("zipf:1:2")
+
+
+def test_payloads_deterministic_with_shared_prefix():
+    spec = LoadSpec(prompt_len="uniform:4:8", output_len=5,
+                    prefix_len=6, seed=9)
+    a = build_payloads(spec, 20)
+    assert a == build_payloads(spec, 20)
+    prefix = a[0]["prompt"][:6]
+    assert all(p["prompt"][:6] == prefix for p in a)
+    assert all(4 <= len(p["prompt"]) - 6 <= 8 for p in a)
+    assert all(p["max_tokens"] == 5 for p in a)
+
+
+# ---------------------------------------------------------------------------
+# percentile / goodput math (hand-checkable synthetic trace)
+# ---------------------------------------------------------------------------
+
+def test_percentile_nearest_rank():
+    vals = [float(i) for i in range(1, 11)]
+    assert percentile(vals, 50) == 5.0
+    assert percentile(vals, 90) == 9.0
+    assert percentile(vals, 99) == 10.0
+    assert percentile(vals, 100) == 10.0
+    assert percentile([3.0], 99) == 3.0
+    assert percentile([], 50) == 0.0
+
+
+def test_recorder_summary_math_on_synthetic_trace():
+    rec = LatencyRecorder()
+    # request i (1..4): ttft = 0.1*i, e2e = 0.2*i, 3 output tokens
+    # => tpot = (e2e - ttft) / 2 = 0.05*i
+    for i in range(1, 5):
+        rec.add(RequestRecord(scheduled_at=0.0, sent_at=1.0,
+                              first_token_at=1.0 + i * 0.1,
+                              finished_at=1.0 + i * 0.2,
+                              output_tokens=3))
+    rec.add(RequestRecord(scheduled_at=0.0, sent_at=1.0, error="boom"))
+    rep = rec.summary(slo=SLO(ttft_s=0.25, e2e_s=1.0), wall_s=2.0)
+    assert rep["requests"] == {"total": 5, "completed": 4, "errors": 1}
+    assert rep["requests_per_second"] == 2.0
+    assert rep["output_tokens"] == 12
+    assert rep["ttft_s"]["p50"] == pytest.approx(0.2)
+    assert rep["ttft_s"]["p99"] == pytest.approx(0.4)
+    assert rep["e2e_s"]["max"] == pytest.approx(0.8)
+    assert rep["tpot_s"]["p50"] == pytest.approx(0.1)
+    good = rep["goodput"]
+    assert good["completed_within_slo"] == 2     # ttft 0.1, 0.2 pass
+    assert good["fraction"] == 0.5
+    assert good["requests_per_second"] == 1.0
+    assert rep["error_samples"] == ["boom"]
+
+
+def test_slo_unbounded_dimensions():
+    ok = RequestRecord(scheduled_at=0, sent_at=0, first_token_at=5.0,
+                       finished_at=9.0, output_tokens=1)
+    assert SLO().met_by(ok)                      # no bounds: any done
+    assert not SLO(e2e_s=1.0).met_by(ok)
+    assert SLO(e2e_s=10.0).met_by(ok)
+    err = RequestRecord(scheduled_at=0, sent_at=0, error="x")
+    assert not SLO().met_by(err)                 # errors never count
+
+
+# ---------------------------------------------------------------------------
+# run_load: report schema + open-loop semantics (no cluster needed)
+# ---------------------------------------------------------------------------
+
+def test_run_load_report_schema_and_open_loop_lateness():
+    """One slow client vs a 100/s constant schedule: open loop keeps
+    the arrivals on schedule and books the lag as queue time."""
+
+    def slow_target(payload, rec, t0):
+        time.sleep(0.05)
+        now = time.perf_counter() - t0
+        rec.first_token_at = now
+        rec.finished_at = now
+        rec.output_tokens = 1
+
+    spec = LoadSpec(rate=100, duration_s=0.2, clients=1,
+                    arrival="constant", seed=0, slo=SLO(ttft_s=5.0))
+    rep = run_load(slow_target, spec)
+    for key in ("requests", "wall_s", "requests_per_second", "ttft_s",
+                "tpot_s", "e2e_s", "queue_s", "goodput", "spec",
+                "target", "scheduled_requests"):
+        assert key in rep, key
+    assert rep["scheduled_requests"] == 20
+    assert rep["requests"]["completed"] == 20
+    # 20 requests x 50ms serial vs a 0.2s window: the tail waited
+    assert rep["queue_s"]["max"] > 0.5
+    json.dumps(rep)          # JSON-serializable end to end
+
+
+def test_run_load_records_target_errors():
+    def flaky(payload, rec, t0):
+        raise RuntimeError("nope")
+
+    spec = LoadSpec(rate=50, duration_s=0.1, clients=2,
+                    arrival="constant", seed=0)
+    rep = run_load(flaky, spec)
+    assert rep["requests"]["total"] == 5
+    assert rep["requests"]["errors"] == 5
+    assert rep["goodput"]["completed_within_slo"] == 0
+    assert any("nope" in s for s in rep["error_samples"])
+
+
+def test_handle_target_stream_enforces_timeout():
+    """A wedged streaming replica must surface as a counted timeout
+    error, not an eternal client hang: the per-request deadline bounds
+    every chunk wait (regression — timeout_s was unary-only)."""
+    from ray_tpu.loadgen import HandleTarget
+
+    class _SlowGen:
+        def next(self, timeout=None):
+            # honors the per-chunk budget the target hands down, but
+            # the stream never finishes
+            time.sleep(min(timeout or 0.05, 0.05) + 0.02)
+            return "tok"
+
+    class _FakeHandle:
+        def options(self, **kw):
+            return self
+
+        def remote(self, payload):
+            return _SlowGen()
+
+    target = HandleTarget(_FakeHandle(), stream=True, timeout_s=0.1)
+    rec = RequestRecord(scheduled_at=0.0)
+    with pytest.raises(TimeoutError):
+        target({}, rec, time.perf_counter())
+
+    spec = LoadSpec(rate=30, duration_s=0.1, clients=2,
+                    arrival="constant", seed=0, timeout_s=0.1,
+                    drain_timeout_s=5.0)
+    rep = run_load(target, spec)
+    assert rep["requests"]["errors"] == rep["requests"]["total"] > 0
+    assert any("timeout" in s.lower() for s in rep["error_samples"])
+
+
+# ---------------------------------------------------------------------------
+# CLI smoke against a self-hosted debug-model serve app
+# ---------------------------------------------------------------------------
+
+def test_ray_tpu_cli_dispatches_loadgen_after_global_flags():
+    """`ray-tpu --num-nodes 2 loadgen …` must reach the loadgen CLI
+    (regression: only a LEADING `loadgen` token was passed through,
+    and the dispatch dict had no entry — KeyError traceback)."""
+    from ray_tpu.scripts import cli
+
+    for argv in (["loadgen", "--help"],
+                 ["--num-nodes", "2", "loadgen", "--help"]):
+        with pytest.raises(SystemExit) as ei:
+            cli.main(argv)
+        assert ei.value.code == 0, argv
+
+
+def test_cli_smoke_debug_app(tmp_path):
+    out_json = tmp_path / "report.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.loadgen", "--clients", "4",
+         "--rate", "15", "--duration", "1.5", "--prompt-len", "8",
+         "--output-len", "4", "--replicas", "2", "--seed", "1",
+         "--json", str(out_json)],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=REPO_ROOT)
+    assert proc.returncode == 0, (proc.stdout[-500:], proc.stderr[-800:])
+    assert "== loadgen report ==" in proc.stdout
+    assert "goodput" in proc.stdout
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("{")]
+    rep = json.loads(lines[-1])
+    assert rep["requests"]["completed"] > 0
+    assert rep["requests"]["errors"] == 0
+    assert rep["ttft_s"]["p50"] > 0
+    assert rep["spec"]["clients"] == 4
+    disk = json.loads(out_json.read_text())
+    assert disk["requests"] == rep["requests"]

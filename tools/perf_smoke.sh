@@ -115,6 +115,35 @@ for i in range(7):
         r_on = traced_burst(True)
     ratios.append(r_on / r_off)
 apply_system_config(None)   # restore env/default flag resolution
+
+# probe 5: serving data plane — a small OPEN-LOOP burst through a
+# 2-replica deployment via ray_tpu.loadgen (handle -> depth-aware P2C
+# router -> replica), the row every serving-perf PR is gated against
+# (docs/serving.md). Constant arrivals + fixed seed keep it stable.
+from ray_tpu import serve  # noqa: E402
+from ray_tpu.loadgen import (HandleTarget, LoadSpec,  # noqa: E402
+                             SLO, run_load)
+
+
+@serve.deployment(num_replicas=2, max_ongoing_requests=32)
+def _smoke_echo(payload):
+    return {"ok": True}
+
+
+handle = serve.run(_smoke_echo.bind())
+handle.remote({}).result(timeout=30)    # warm the route
+spec = LoadSpec(rate=150.0, duration_s=2.0, clients=16,
+                arrival="constant", stream=False, seed=0,
+                prompt_len=4, output_len=1, slo=SLO(e2e_s=1.0),
+                timeout_s=30, drain_timeout_s=60)
+serving = run_load(HandleTarget(handle, stream=False, timeout_s=30),
+                   spec)
+results["serving_requests_per_s"] = serving["requests_per_second"]
+if serving["requests"]["errors"]:
+    print(f"serving probe errors: {serving['error_samples']}",
+          file=sys.stderr)
+    results["serving_requests_per_s"] = 0.0
+serve.shutdown()
 overhead = max(0.0, (1.0 - statistics.median(ratios)) * 100.0)
 # Single-burst scatter on shared hardware is +-30-70%, far above the 5%
 # budget, so the gate demands a CONSISTENT regression: a real overhead
