@@ -206,11 +206,16 @@ def _run_train(error: str | None) -> dict:
     return out
 
 
-def _control_plane_probe(duration_s: float = 1.5) -> float:
-    """Quick control-plane throughput sample (tasks/s through the full
-    submit→schedule→execute→get loop) so every BENCH_*.json tracks the
-    task-dispatch envelope alongside tokens/s. Bounded and best-effort:
-    a failure must never cost the benchmark its tokens/s line."""
+def _control_plane_probe(duration_s: float = 1.5,
+                         drain_n: int = 2000) -> dict:
+    """Quick control-plane throughput sample so every BENCH_*.json
+    tracks the task-dispatch envelope alongside tokens/s: round-trip
+    tasks/s through the full submit→schedule→execute→get loop, plus a
+    queued submit-then-drain burst whose drain rate is the bottleneck
+    the result pipeline targets (ROADMAP item 4 — the trajectory files
+    finally track it). Bounded and best-effort: a failure must never
+    cost the benchmark its tokens/s line."""
+    out = {"tasks_per_second": 0.0, "drain_tasks_per_second": 0.0}
     own = False
     try:
         import ray_tpu
@@ -229,9 +234,21 @@ def _control_plane_probe(duration_s: float = 1.5) -> float:
         while time.perf_counter() - t0 < duration_s:
             ray_tpu.get([_noop.remote() for _ in range(100)])
             count += 100
-        return round(count / (time.perf_counter() - t0), 1)
+        out["tasks_per_second"] = round(
+            count / (time.perf_counter() - t0), 1)
+        # queued drain: submit without consuming, then time the drain
+        # leg alone (timing from before the submit loop would fold the
+        # submit phase into the reported drain rate). Bounded: a wedged
+        # drain path must degrade this row to 0, never hang the
+        # benchmark's tokens/s line (GetTimeoutError -> except below).
+        refs = [_noop.remote() for _ in range(drain_n)]
+        t1 = time.perf_counter()
+        ray_tpu.get(refs, timeout=120.0)
+        out["drain_tasks_per_second"] = round(
+            drain_n / (time.perf_counter() - t1), 1)
+        return out
     except Exception:
-        return 0.0
+        return out
     finally:
         if own:     # never leak the probe's own cluster on a failure
             try:
@@ -328,7 +345,7 @@ def _child() -> int:
         result = _run_train(error)
     if os.environ.get("BENCH_CONTROL_PLANE", "1") != "0":
         result["control_plane"] = {
-            "tasks_per_second": _control_plane_probe(),
+            **_control_plane_probe(),
             # spans-on vs spans-off delta, paired + median-of-ratios in
             # ONE cluster (sequential unpaired probes are a noise
             # lottery on shared hosts — see tools/perf_smoke.sh probe 4)

@@ -39,6 +39,7 @@
 #include <sys/epoll.h>
 #include <sys/eventfd.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -85,6 +86,7 @@ struct Conn {
   uint64_t gen = 0;          // guards against fd reuse
   bool is_worker = false;
   bool writable = true;
+  bool dirty = false;        // queued frames await the end-of-iteration flush
   std::vector<uint8_t> rbuf;
   std::deque<std::vector<uint8_t>> wq;
   size_t wq_off = 0;         // bytes of wq.front() already written
@@ -112,6 +114,7 @@ struct Core {
   uint64_t next_gen = 1;
   uint64_t next_tid = 1;
   std::unordered_map<int, Conn> conns;
+  std::vector<int> dirty_fds;  // conns with frames queued this iteration
   std::deque<int> free_workers;
   std::unordered_map<uint64_t, int> tagged;   // tag -> worker fd
   std::deque<Pending> queue;
@@ -169,6 +172,11 @@ void epoll_mod(Core &c, int fd, bool want_write) {
 }
 
 // Queue a frame (header built here around op+body parts) on a conn.
+// Writes are DEFERRED to the end of the event-loop iteration
+// (flush_dirty): a burst handled in one iteration — several worker
+// RESULTs, a driver read full of SUBMITs — leaves per peer as ONE
+// scatter-gather syscall instead of one send per frame. Same-iteration
+// flushing keeps single-round-trip latency unchanged.
 void send_frame(Core &c, Conn &conn, uint8_t op,
                 const uint8_t *h, size_t hlen,
                 const uint8_t *body, size_t blen) {
@@ -178,25 +186,66 @@ void send_frame(Core &c, Conn &conn, uint8_t op,
   f.push_back(op);
   f.insert(f.end(), h, h + hlen);
   if (blen) f.insert(f.end(), body, body + blen);
-  bool was_empty = conn.wq.empty();
   conn.wq.emplace_back(std::move(f));
-  if (was_empty) {
-    // try an eager write; register EPOLLOUT only if it would block
-    while (!conn.wq.empty()) {
-      auto &front = conn.wq.front();
-      ssize_t n = ::send(conn.fd, front.data() + conn.wq_off,
-                         front.size() - conn.wq_off, MSG_NOSIGNAL);
-      if (n < 0) {
-        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
-        return;  // peer dead; EPOLLHUP will clean up
+  if (!conn.dirty) {
+    conn.dirty = true;
+    c.dirty_fds.push_back(conn.fd);
+  }
+}
+
+// Drain a conn's write queue with as few syscalls as the kernel allows
+// (sendmsg over up to 64 queued frames); registers EPOLLOUT on a
+// short write.
+void flush_conn(Core &c, Conn &conn) {
+  while (!conn.wq.empty()) {
+    iovec iov[64];
+    int cnt = 0;
+    size_t off = conn.wq_off;
+    for (auto &buf : conn.wq) {
+      if (cnt == 64) break;
+      iov[cnt].iov_base = const_cast<uint8_t *>(buf.data()) + off;
+      iov[cnt].iov_len = buf.size() - off;
+      off = 0;
+      cnt++;
+    }
+    msghdr mh{};
+    mh.msg_iov = iov;
+    mh.msg_iovlen = cnt;
+    ssize_t n = ::sendmsg(conn.fd, &mh, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        epoll_mod(c, conn.fd, true);
+        return;
       }
-      conn.wq_off += size_t(n);
-      if (conn.wq_off == front.size()) {
+      return;  // peer dead; EPOLLHUP will clean up
+    }
+    size_t left = size_t(n);
+    while (left) {
+      auto &front = conn.wq.front();
+      size_t avail = front.size() - conn.wq_off;
+      if (left >= avail) {
+        left -= avail;
         conn.wq.pop_front();
         conn.wq_off = 0;
+      } else {
+        conn.wq_off += left;
+        left = 0;
       }
     }
-    if (!conn.wq.empty()) epoll_mod(c, conn.fd, true);
+  }
+}
+
+void flush_dirty(Core &c) {
+  if (c.dirty_fds.empty()) return;
+  std::vector<int> fds;
+  fds.swap(c.dirty_fds);
+  for (int fd : fds) {
+    auto it = c.conns.find(fd);
+    if (it == c.conns.end()) continue;  // closed this iteration
+    Conn &conn = it->second;
+    if (!conn.dirty) continue;          // fd reuse duplicate entry
+    conn.dirty = false;
+    flush_conn(c, conn);
   }
 }
 
@@ -481,22 +530,8 @@ void on_writable(Core &c, int fd) {
   auto it = c.conns.find(fd);
   if (it == c.conns.end()) return;
   Conn &conn = it->second;
-  while (!conn.wq.empty()) {
-    auto &front = conn.wq.front();
-    ssize_t n = ::send(fd, front.data() + conn.wq_off,
-                       front.size() - conn.wq_off, MSG_NOSIGNAL);
-    if (n < 0) {
-      if (errno == EAGAIN || errno == EWOULDBLOCK) return;
-      close_conn(c, fd);
-      return;
-    }
-    conn.wq_off += size_t(n);
-    if (conn.wq_off == front.size()) {
-      conn.wq.pop_front();
-      conn.wq_off = 0;
-    }
-  }
-  epoll_mod(c, fd, false);
+  flush_conn(c, conn);
+  if (conn.wq.empty()) epoll_mod(c, fd, false);
 }
 
 void *loop_main(void *) {
@@ -536,6 +571,8 @@ void *loop_main(void *) {
       if (evs[i].events & EPOLLIN) on_readable(c, fd);
       if (evs[i].events & EPOLLOUT) on_writable(c, fd);
     }
+    // one coalesced write per peer for everything this iteration queued
+    flush_dirty(c);
     // publish the stats gauges from the loop thread (sole owner of the
     // containers); cross-thread rtdc_stats reads only these atomics
     c.stat_queue_depth.store(c.queue.size(), std::memory_order_relaxed);

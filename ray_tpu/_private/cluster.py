@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
+from ray_tpu._private import events as _events
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
 from ray_tpu._private import daemon as _daemon_schemas  # noqa: F401 — declares the daemon RPC schemas
@@ -419,6 +420,8 @@ class DaemonHandle:
         self._fast_rids: Dict[str, Tuple[Any, int]] = {}  #: guarded by self._fast_lock
         # control-plane batching (submit coalescer + free buffer)
         self._batch_supported = False       # daemon advertises in hello
+        self._result_batch = False          # coalesced completions for
+        #                                     classic submits (hello flag)
         self._batch: Optional[_SubmitCoalescer] = None
         self._batch_lock = tracked_lock("cluster.handle.batch_init",
                                         reentrant=False)
@@ -433,8 +436,7 @@ class DaemonHandle:
     def _on_push(self, method: str, msg: Dict[str, Any]) -> None:
         if method == "task_batch_done":
             # batched completion replies: many task outcomes on one frame
-            for out in msg.get("outcomes", ()):
-                self._complete_batch_task(out)
+            self._ingest_batch(msg.get("outcomes", ()))
             return
         if method in ("task_yield", "task_stream_end", "task_stream_crash"):
             with self._slock:
@@ -488,6 +490,66 @@ class DaemonHandle:
             slot[1] = out
             slot[0].set()
 
+    def _ingest_batch(self, outcomes) -> None:
+        """Ingest one task_batch_done frame WITHOUT re-entering per-task
+        code paths: every final outcome's waiter slot pops under ONE
+        _bw_lock acquisition and every stream termination resolves its
+        queue under ONE _slock acquisition; only then are the events
+        set (waking the waiting task threads). Duplicate outcomes (a
+        batch.result_flush retry, or out-of-order arrival of a resent
+        frame) find no slot and are dropped — exactly-once per task."""
+        t0 = time.perf_counter()
+        finals = []
+        streams = []
+        for out in outcomes:
+            (streams if out.get("stream") else finals).append(out)
+        woke = []
+        if finals:
+            with self._bw_lock:
+                for out in finals:
+                    slot = self._batch_waiters.pop(out.get("task", ""),
+                                                   None)
+                    if slot is not None:
+                        slot[1] = out
+                        woke.append((slot, out))
+            for slot, _out in woke:
+                slot[0].set()
+        if streams:
+            resolved = []
+            with self._slock:
+                for out in streams:
+                    stream = self._streams.get(out.get("task", ""))
+                    if stream is not None:
+                        resolved.append((stream, out))
+            for stream, out in resolved:
+                msg = dict(out)
+                msg["m"] = msg.pop("stream")
+                stream.q.put(msg)
+        self._record_ingest_spans(woke, t0)
+
+    def _record_ingest_spans(self, woke, t0: float) -> None:
+        """result_ingest phase: batch frame arrival -> waiters woken
+        (driver lane, traced outcomes only)."""
+        try:
+            traced = [(slot, out) for slot, out in woke
+                      if out.get("tr")]
+            if not traced:
+                return
+            now = time.perf_counter()
+            node_hex = self.node_id.hex()
+            from ray_tpu._private import worker as _worker  # lazy: circular
+            rt = _worker.global_runtime()
+            buf = getattr(rt, "task_events", None) if rt else None
+            for _slot, out in traced:
+                tr = out["tr"]
+                _events.record_phase(
+                    buf, task_id=out.get("task", ""), name=tr[0],
+                    phase="result_ingest", dur_s=max(now - t0, 0.0),
+                    node_id=node_hex, proc="driver", trace_id=tr[1],
+                    start_wall=_events.wall_at(t0), end_mono=now)
+        except Exception:
+            pass    # observability must never fail an ingest
+
     def _call(self, method: str, **kw) -> Dict[str, Any]:
         if self.dead:
             raise DaemonCrashed(f"daemon {self.node_id.hex()[:8]} is dead")
@@ -516,6 +578,10 @@ class DaemonHandle:
         from ray_tpu._private.config import cfg
         self._batch_supported = bool(out.get("batch")) and bool(
             cfg().submit_batch)
+        # completions for classic (non-coalesced) submits may return on
+        # the task_batch_done pump — independent of submit batching, so
+        # a submit_batch=False driver still drains coalesced
+        self._result_batch = bool(out.get("result_batch"))
         self._job_id = job_id
         return out
 
@@ -582,6 +648,12 @@ class DaemonHandle:
             self._fast_rids[task_hex] = (fl, rid)
         try:
             kind, blob = fl.wait(slot)
+        except _fle.FastLaneUnsubmitted:
+            # frame never reached the wire (another submitter's flush
+            # failed first): nothing ran — classic path, retry-free
+            if self.dead:
+                raise DaemonCrashed("daemon died (fast lane)")
+            return None
         except _fle.FastLaneError as e:
             # submitted but the lane died before the outcome: the call
             # may have executed — surface as a worker crash so retry
@@ -672,6 +744,8 @@ class DaemonHandle:
             batch = self._submit_coalescer()
             if batch is not None:
                 out = self._submit_batched(batch, spec, fid, args_blob)
+            elif self._result_batch:
+                out = self._submit_via_pump(spec, fid, args_blob)
             else:
                 out = self._call(
                     "submit_task", spec=_slim_spec_blob(spec), fid=fid,
@@ -704,6 +778,9 @@ class DaemonHandle:
             "fid": fid,
             "args": args_blob,
             "backpressure": spec.backpressure_num_objects,
+            # opt in to coalesced stream terminations (see
+            # _submit_via_pump)
+            "term_pump": True,
         }
         if getattr(spec, "trace_sampled", False):
             # linger-phase span inputs — attached ONLY for sampled
@@ -723,6 +800,59 @@ class DaemonHandle:
         if out is None:
             raise DaemonCrashed(
                 f"daemon {self.node_id.hex()[:8]} died (batched submit)")
+        if out.get("e"):
+            raise rpc.RemoteError(out["e"])
+        return out
+
+    def _submit_via_pump(self, spec, fid: str,
+                         args_blob: bytes) -> Dict[str, Any]:
+        """Classic per-task submit_task RPC whose COMPLETION returns on
+        the coalesced task_batch_done pump (daemon advertised
+        ``result_batch`` at hello): the RPC reply is an immediate ack,
+        so a submit_batch=False driver still gets batched completion
+        delivery — same outcome dict and error surface as the coalesced
+        path."""
+        task_hex = spec.task_id.hex()
+        slot = [threading.Event(), None]
+        with self._bw_lock:
+            if self.dead:
+                raise DaemonCrashed(
+                    f"daemon {self.node_id.hex()[:8]} is dead")
+            self._batch_waiters[task_hex] = slot
+        kw: Dict[str, Any] = {
+            "spec": _slim_spec_blob(spec), "fid": fid,
+            "args": args_blob,
+            "backpressure": spec.backpressure_num_objects,
+            "task": task_hex,
+            # (task, attempt) dedupe identity, like the batched path
+            "attempt": spec.attempt_number,
+            "via_pump": True,
+            # this driver ingests stream terminations off the pump;
+            # without the flag the daemon pushes them per-task (an
+            # older driver on a persistent daemon would hang its
+            # generator consumers waiting on coalesced terminations
+            # its task_batch_done handler drops)
+            "term_pump": True,
+        }
+        if getattr(spec, "trace_sampled", False):
+            kw["name"] = spec.name
+            kw["trace"] = spec.trace_id
+        try:
+            out = self._call("submit_task", **kw)
+        except BaseException:
+            with self._bw_lock:
+                self._batch_waiters.pop(task_hex, None)
+            raise
+        if out.get("outcome") != "pump":
+            # daemon ran it inline after all: the reply IS the outcome
+            with self._bw_lock:
+                self._batch_waiters.pop(task_hex, None)
+            return out
+        slot[0].wait()
+        out = slot[1]
+        if out is None:
+            raise DaemonCrashed(
+                f"daemon {self.node_id.hex()[:8]} died (pumped submit)")
         if out.get("e"):
             raise rpc.RemoteError(out["e"])
         return out
@@ -1099,6 +1229,11 @@ class _OwnerHolder:
     def release(self, key: str) -> None:
         """Drop one borrower's holds (the dropped ObjectRefs' __del__
         cascades into refcounting — outside the lock)."""
+        # GIL-atomic emptiness probe: a stale non-empty read just takes
+        # the lock; a stale empty read means the hold landed after this
+        # release began — the same outcome as losing the lock race.
+        if not self._held:      # raylint: disable=guarded-by
+            return  # empty table: the common per-task case pays no lock
         with self._lock:
             dropped = self._held.pop(key, None)
         del dropped

@@ -131,6 +131,18 @@ FLAG_DEFS = [
     Flag("free_flush_ms", float, 5.0, "max milliseconds a queued "
          "zero-ref free waits before its buffer is flushed to the "
          "daemon"),
+    # -- drain-side result pipeline (docs/performance.md "Result path") --
+    Flag("result_batch_max", int, 256, "max task completions per "
+         "task_batch_done push frame; the daemon's reply pump flushes "
+         "when this many are buffered for one driver connection"),
+    Flag("result_linger_us", int, 500, "how long (microseconds) the "
+         "daemon's reply pump lingers for more completions before "
+         "flushing a non-full task_batch_done frame; 0 = flush "
+         "immediately"),
+    Flag("exec_pool_size", int, 0, "worker threads in each node's task "
+         "execution pool (the dispatch loop feeds admitted tasks to "
+         "this sized pool instead of spawning per task); 0 = the "
+         "node's max_worker_threads (256)"),
     # -- bench --
     Flag("bench_total_deadline", int, 540, "bench.py total wall-clock "
          "budget (seconds)"),

@@ -41,6 +41,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import cloudpickle
 
+from ray_tpu._private import events as _events
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
 from ray_tpu._private.head import HeadClient, _hb_interval
@@ -450,20 +451,28 @@ class _PullMissing(Exception):
 class _BatchTaskConn:
     """Adapts one batched task's reply surface onto the shared
     ``_run_pushed_task`` machinery: final outcomes ride the coalescing
-    reply pump instead of a per-rid reply frame; stream pushes
-    (task_yield / task_stream_end / task_stream_crash) pass straight
-    through to the real connection. ``key`` is the (task, attempt)
-    dedupe identity — attempt included because task retries reuse the
-    task id and must re-execute, not replay the old outcome."""
+    reply pump instead of a per-rid reply frame; stream TERMINATIONS
+    (task_stream_end / task_stream_crash) coalesce onto the same pump
+    as tagged entries, while task_yield items pass straight through to
+    the real connection (they pace the gen_ack flow). ``key`` is the
+    (task, attempt) dedupe identity — attempt included because task
+    retries reuse the task id and must re-execute, not replay the old
+    outcome. ``trace`` is (name, trace_id) for sampled tasks — it rides
+    outcomes as ``tr`` so both sides can record the drain-side span
+    phases (result_flush / result_ingest)."""
 
-    __slots__ = ("service", "conn", "task_hex", "key")
+    __slots__ = ("service", "conn", "task_hex", "key", "trace",
+                 "term_pump")
 
     def __init__(self, service: "DaemonService", conn: Connection,
-                 task_hex: str, key: tuple):
+                 task_hex: str, key: tuple, trace=None,
+                 term_pump: bool = False):
         self.service = service
         self.conn = conn
         self.task_hex = task_hex
         self.key = key
+        self.trace = trace
+        self.term_pump = term_pump
 
     @property
     def closed(self) -> bool:
@@ -472,49 +481,138 @@ class _BatchTaskConn:
     def reply(self, rid, **kw) -> None:
         out = dict(kw)
         out["task"] = self.task_hex
+        if self.trace is not None:
+            out["tr"] = list(self.trace)
         self.service._batch_task_done(self.conn, self.key, out)
 
     def reply_error(self, rid, err: str) -> None:
         self.reply(rid, e=err)
 
     def push(self, method: str, **kw) -> None:
+        if (self.term_pump
+                and method in ("task_stream_end", "task_stream_crash")):
+            # terminations are final per task: ship them coalesced —
+            # but ONLY when the submitting driver advertised it can
+            # ingest terminations off the pump (entry flag term_pump);
+            # an older driver on a persistent daemon gets the classic
+            # per-task push and never hangs its stream consumer
+            out = dict(kw)
+            out["stream"] = method
+            self.service._batch_pump.add(self.conn, out)
+            return
         self.conn.push(method, **kw)
 
 
 class _BatchReplyPump:
     """Coalesces completed-task outcomes into ``task_batch_done`` push
     frames — one frame carries every completion that landed within the
-    linger window (the batched-reply leg of the submit coalescer)."""
+    linger window (the batched-reply leg of the result pipeline).
+    Final outcomes, object-location updates (``stored`` results), and
+    generator/stream terminations all ride the same frames; classic
+    ``via_pump`` submissions and ``push_task_batch`` tasks share it.
 
-    LINGER_S = 0.0005
-    MAX_PER_FRAME = 256
+    Knobs: ``result_batch_max`` (entries per frame) and
+    ``result_linger_us`` (straggler window).
 
-    def __init__(self):
+    Retry contract: a flush that fails in transit
+    (``batch.result_flush`` drop/error arms — the deterministic
+    stand-in for a lost frame) requeues its entries and resends them
+    on the next pump pass. Resends are idempotent at the driver: final
+    outcomes pop their waiter slot exactly once (a duplicate finds no
+    slot), and stream terminations land on an already-drained stream
+    queue at worst."""
+
+    def __init__(self, task_events=None, node_hex: str = ""):
+        from ray_tpu._private.config import cfg
+        self.linger_s = max(0.0, float(cfg().result_linger_us) / 1e6)
+        self.max_per_frame = max(1, int(cfg().result_batch_max))
+        # result_flush span sink (daemon lane); None in bare-pump tests
+        self.task_events = task_events
+        self.node_hex = node_hex
         self._cv = threading.Condition()
+        # conn -> [(outcome, t_add)]: t_add is perf_counter at buffering
+        # for traced outcomes (0.0 untraced — no clock read)
         self._buf: Dict[Connection, list] = {}  #: guarded by self._cv
         threading.Thread(target=self._loop, daemon=True,
                          name="batch-reply-pump").start()
 
     def add(self, conn: Connection, out: Dict[str, Any]) -> None:
+        t_add = time.perf_counter() if "tr" in out else 0.0
         with self._cv:
-            self._buf.setdefault(conn, []).append(out)
+            self._buf.setdefault(conn, []).append((out, t_add))
             self._cv.notify()
 
     def _loop(self) -> None:
+        failed_last_pass = False
         while True:
             with self._cv:
                 while not self._buf:
                     self._cv.wait()
-            # short linger: completions that land together leave together
-            time.sleep(self.LINGER_S)
+            # short linger: completions that land together leave
+            # together. After a failed pass the linger acts as retry
+            # backoff too — floored so a linger of 0 cannot busy-spin
+            # the pump against a persistently failing (but not yet
+            # closed) connection.
+            linger = self.linger_s
+            if failed_last_pass:
+                linger = max(linger, 0.001)
+            if linger:
+                time.sleep(linger)
             with self._cv:
                 buf, self._buf = self._buf, {}
-            for conn, outs in buf.items():
+            failed_last_pass = False
+            for conn, entries in buf.items():
                 if conn.closed:
                     continue
-                for i in range(0, len(outs), self.MAX_PER_FRAME):
-                    conn.push("task_batch_done",
-                              outcomes=outs[i:i + self.MAX_PER_FRAME])
+                i = 0
+                while i < len(entries):
+                    chunk = entries[i:i + self.max_per_frame]
+                    if not self._send_chunk(conn, chunk):
+                        # lost in transit: requeue this chunk AND the
+                        # rest, preserving order — the resend is
+                        # idempotent at the driver. A dead connection
+                        # drops out at the next pass's closed check.
+                        failed_last_pass = True
+                        with self._cv:
+                            self._buf.setdefault(conn, [])[:0] = \
+                                entries[i:]
+                        break
+                    i += self.max_per_frame
+
+    def _send_chunk(self, conn: Connection, chunk) -> bool:
+        if _fp.ENABLED:
+            try:
+                # drop/error arm = the frame is lost in transit; the
+                # caller requeues and the next pass resends
+                if _fp.fire("batch.result_flush",
+                            n=len(chunk)) is _fp.DROP:
+                    return False
+            except Exception:
+                return False
+        now = time.perf_counter()
+        conn.push("task_batch_done", outcomes=[o for o, _ in chunk])
+        if conn.closed:     # push swallows transport failure into closed
+            return False
+        if self.task_events is not None:
+            self._record_flush_spans(chunk, now)
+        return True
+
+    def _record_flush_spans(self, chunk, now: float) -> None:
+        """result_flush phase: completion buffered on the pump -> its
+        frame on the wire (daemon lane, traced outcomes only)."""
+        try:
+            for out, t_add in chunk:
+                tr = out.get("tr")
+                if not tr or not t_add:
+                    continue
+                _events.record_phase(
+                    self.task_events, task_id=out.get("task", ""),
+                    name=tr[0], phase="result_flush",
+                    dur_s=max(now - t_add, 0.0), node_id=self.node_hex,
+                    proc=f"daemon:{self.node_hex[:8]}", trace_id=tr[1],
+                    start_wall=_events.wall_at(t_add), end_mono=now)
+        except Exception:
+            pass    # observability must never fail a flush
 
 
 # completed batched-task outcomes kept for duplicate-frame resend; cap
@@ -606,7 +704,8 @@ class DaemonService:
         self._batch_running: set = set()           #: guarded by self._lock
         #: guarded by self._lock
         self._batch_done: "OrderedDict[tuple, Dict[str, Any]]" = OrderedDict()
-        self._batch_pump = _BatchReplyPump()
+        self._batch_pump = _BatchReplyPump(
+            task_events=self.task_events, node_hex=self.node_id.hex())
         self._bundles: Dict[Tuple[str, int], Dict[str, Any]] = {}  #: guarded by self._lock
         self._peers: Dict[Tuple[str, int], Client] = {}  #: guarded by self._lock
         # cross-language actors: name -> [actor_id, seqno]
@@ -785,9 +884,12 @@ class DaemonService:
             time.sleep(0.02)
         return {"ok": True, "pid": os.getpid(),
                 "fast_port": self.fast_port,
-                # protocol feature flag: this daemon understands
-                # push_task_batch (drivers fall back per-task otherwise)
-                "batch": True}
+                # protocol feature flags: this daemon understands
+                # push_task_batch (drivers fall back per-task
+                # otherwise) and coalesced completion delivery for
+                # classic submit_task calls (via_pump)
+                "batch": True,
+                "result_batch": True}
 
     def notify_driver(self, kind: str, **kw) -> None:
         conn = self.driver_conn
@@ -933,7 +1035,16 @@ class DaemonService:
         push_task / return_worker) remains for callers that need to hold
         a worker across calls; the reference gets the same effect by
         caching leases per SchedulingKey
-        (``transport/normal_task_submitter.cc:140``)."""
+        (``transport/normal_task_submitter.cc:140``).
+
+        ``via_pump`` submissions (driver saw ``result_batch`` in hello)
+        get their COMPLETION on the coalesced task_batch_done pump
+        instead of this RPC's reply: the reply is an immediate ack, so
+        the classic path's completions batch exactly like the
+        push_task_batch path's. Dedupe keys on (task, attempt) in the
+        shared batch tables — a retried frame never double-executes."""
+        if msg.get("via_pump") and msg.get("task"):
+            return self._submit_task_via_pump(conn, msg)
         from ray_tpu._private import worker_process as wp
 
         msg["_t0"] = time.perf_counter()    # dispatch-phase span start
@@ -949,6 +1060,24 @@ class DaemonService:
             # worker (and its _ACTIVE slot) would leak per failed submit.
             wp.release_worker(client)
             raise
+
+    def _submit_task_via_pump(self, conn, msg):
+        """Classic single-task submit whose outcome returns coalesced
+        (the 'classic submitters ride the result pipeline too' leg)."""
+        key = (msg["task"], msg.get("attempt", 0))
+        msg["_t0"] = time.perf_counter()    # dispatch-phase span start
+        resend = None
+        with self._lock:
+            if key in self._batch_running:
+                return {"outcome": "pump"}  # duplicate of in-flight
+            resend = self._batch_done.get(key)
+            if resend is None:
+                self._batch_running.add(key)
+        if resend is not None:
+            self._batch_pump.add(conn, resend)
+            return {"outcome": "pump"}
+        self._start_batch_task(conn, msg, key)
+        return {"outcome": "pump"}
 
     def handle_push_task_batch(self, conn, rid, msg):
         """Coalesced submit: N tasks on one frame (driver-side
@@ -991,7 +1120,11 @@ class DaemonService:
         """Acquire a pooled worker OFF the RPC lane thread (the pool may
         cold-spawn a process) and run the shared pushed-task machinery
         with the batch reply adapter."""
-        bconn = _BatchTaskConn(self, conn, entry["task"], key)
+        trace = ((entry.get("name", ""), entry["trace"])
+                 if entry.get("trace") else None)
+        bconn = _BatchTaskConn(self, conn, entry["task"], key,
+                               trace=trace,
+                               term_pump=bool(entry.get("term_pump")))
 
         def start():
             from ray_tpu._private import worker_process as wp

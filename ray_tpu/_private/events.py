@@ -24,15 +24,19 @@ import time
 from collections import deque
 from typing import Any, Dict, Iterable, List, Optional
 
-# The six per-task phases surfaced by ``task_breakdown`` and the
+# The per-task phases surfaced by ``task_breakdown`` and the
 # ``ray_tpu_task_phase_seconds`` histogram:
-#   submit   driver: ``submit_task`` entry -> node backlog enqueue
-#   linger   driver: submit-coalescer enqueue -> batch flush on the wire
-#   queue    driver: node backlog enqueue -> dispatch-loop admission
-#   dispatch daemon: task frame arrival -> exec request sent to a worker
-#   exec     worker: user function body (start -> finish)
-#   result   driver: outcome decoded -> return futures completed
-PHASES = ("submit", "linger", "queue", "dispatch", "exec", "result")
+#   submit        driver: ``submit_task`` entry -> node backlog enqueue
+#   linger        driver: submit-coalescer enqueue -> batch flush on the wire
+#   queue         driver: node backlog enqueue -> dispatch-loop admission
+#   dispatch      daemon: task frame arrival -> exec request sent to a worker
+#   exec          worker: user function body (start -> finish)
+#   result_flush  daemon: completion buffered on the reply pump -> its
+#                 task_batch_done frame on the wire (drain-side linger)
+#   result_ingest driver: batch frame arrival -> waiter threads woken
+#   result        driver: outcome decoded -> return futures completed
+PHASES = ("submit", "linger", "queue", "dispatch", "exec",
+          "result_flush", "result_ingest", "result")
 
 # Process-stable wall<->monotonic anchor: spans convert the monotonic
 # timestamps their callers ALREADY hold into wall time arithmetically,
@@ -331,7 +335,8 @@ def phase_histogram():
         return h
     h = _metrics.Histogram(
         "ray_tpu_task_phase_seconds",
-        "per-phase task latency: submit|linger|queue|dispatch|exec|result",
+        "per-phase task latency: submit|linger|queue|dispatch|exec|"
+        "result_flush|result_ingest|result",
         boundaries=(0.0005, 0.005, 0.05, 0.5, 5.0),
         tag_keys=("phase", "node_id"))
     _PHASE_HIST = h

@@ -83,6 +83,30 @@ def _read_frame(sock: socket.socket) -> bytearray:
     return _recv_exact(sock, blen)
 
 
+def _frame_stream(sock: socket.socket):
+    """Yield complete frames from a buffered reader: ONE recv may
+    deliver many small frames (a drain storm's replies / a burst of
+    EXEC frames), where per-frame recv_exact paid two syscalls per
+    frame. Raises ConnectionError on EOF like recv_exact."""
+    buf = bytearray()
+    while True:
+        off = 0
+        n = len(buf)
+        while n - off >= 4:
+            (blen,) = _U32.unpack_from(buf, off)
+            end = off + 4 + blen
+            if end > n:
+                break
+            yield buf[off + 4:end]
+            off = end
+        if off:
+            del buf[:off]
+        chunk = sock.recv(1 << 18)
+        if not chunk:
+            raise ConnectionError("lane socket closed")
+        buf += chunk
+
+
 def _send_lane_frame(sock: socket.socket, wlock: threading.Lock, op: int,
                      head: bytes, payload: bytes = b"") -> None:
     """Lane frame write shared by client and worker sides: header and
@@ -146,6 +170,17 @@ class FastLaneError(Exception):
     """Transport failure on the fast lane (core/daemon died)."""
 
 
+class FastLaneUnsubmitted(FastLaneError):
+    """The frame provably never reached the wire (it was still staged
+    when another thread's flush failed): nothing ran on the daemon, so
+    callers fall back to the classic path without consuming a retry."""
+
+
+# wait() sentinel for a slot whose frame was never written (distinct
+# from None = lane died after the frame may have been delivered)
+_UNSUBMITTED = object()
+
+
 def replay_gen_list(blob: bytes):
     """Decode a KIND_GEN_LIST payload into a live generator replaying
     the worker-drained items — ONE decoder for every driver path
@@ -182,19 +217,126 @@ class FastLaneClient:
         # rid -> [Event, kind, payload]
         self._pending: Dict[int, list] = {}  #: guarded by self._plock
         self._plock = tracked_lock("fast_lane.pending", reentrant=False)
+        # Flat-combining send stage: under concurrent submission the
+        # lock holder drains everyone's frames with ONE sendall (a
+        # drain storm paid a syscall + wire wakeup per task); an
+        # uncontended send stays synchronous — same latency and error
+        # surface as before.
+        self._send_stage: list = []     #: guarded by self._stage_lock
+        self._send_flushing = False     #: guarded by self._stage_lock
+        self._stage_lock = tracked_lock("fast_lane.send_stage",
+                                        reentrant=False)
         self.dead = False
         self._reader = threading.Thread(target=self._read_loop,
                                         daemon=True, name="fastlane-read")
         self._reader.start()
 
     # -- wire -------------------------------------------------------------
-    def _send(self, op: int, head: bytes, payload: bytes = b"") -> None:
-        _send_lane_frame(self._sock, self._wlock, op, head, payload)
+    def _send(self, op: int, head: bytes, payload: bytes = b"",
+              rid: Optional[int] = None) -> None:
+        prefix = (_U32.pack(1 + len(head) + len(payload))
+                  + bytes([op]) + head)
+        if len(payload) > _SEND_CONCAT_MAX:
+            # large frame: rides the stage as a TWO-PART entry so the
+            # flusher writes it in FIFO position without a multi-MB
+            # concat copy. Bypassing the stage (the old direct write
+            # under _wlock) could overtake this thread's own earlier
+            # staged frame — reordering two calls to one actor.
+            frame = (prefix, payload)
+        else:
+            frame = prefix + payload
+        with self._stage_lock:
+            self._send_stage.append((frame, rid))
+            if self._send_flushing:
+                # a flusher is active: it picks this frame up in its
+                # next pass. A flush failure there resolves this slot
+                # by delivery state: still-staged frames come back
+                # FastLaneUnsubmitted (classic fallback, no retry),
+                # written-or-partial ones as lane death (retry
+                # accounting) — same contract as post-submit loss.
+                return
+            self._send_flushing = True
+        self._drain_send_stage(frame)
+
+    def _drain_send_stage(self, own_frame=None) -> None:
+        # A send failure raises to the caller ONLY while own_frame was
+        # provably never delivered: it was the sole frame of the failed
+        # write (sendall raising then guarantees the daemon can't hold
+        # a complete frame). Any other failure splits by delivery
+        # state: frames still staged (never written) resolve their
+        # slots FastLaneUnsubmitted — their submitters take the classic
+        # path retry-free — while frames in the failed or an earlier
+        # write may have reached the daemon, so their slots fail as
+        # lane death (wait() raises "died mid-call" -> retry
+        # accounting). Raising for a possibly-delivered frame would
+        # make the classic fallback re-run a task the daemon may
+        # already be executing.
+        while True:
+            with self._stage_lock:
+                batch = self._send_stage
+                if not batch:
+                    self._send_flushing = False
+                    return
+                self._send_stage = []
+            try:
+                with self._wlock:
+                    self._write_batch(batch)
+            except BaseException:
+                with self._stage_lock:
+                    unwritten = self._send_stage
+                    self._send_stage = []
+                    self._send_flushing = False
+                self._resolve_unsubmitted(unwritten)
+                self._fail_pending()
+                if len(batch) == 1 and batch[0][0] is own_frame:
+                    raise
+                return
+            if own_frame is not None and any(
+                    f is own_frame for f, _ in batch):
+                own_frame = None
+
+    def _write_batch(self, batch) -> None:
+        """Write staged entries in FIFO order (caller holds _wlock):
+        consecutive small frames join into one sendall; a large
+        two-part entry flushes the run, then writes prefix + payload
+        without ever concatenating the big payload."""
+        run: list = []
+        for f, _ in batch:
+            if isinstance(f, tuple):
+                if run:
+                    self._sock.sendall(
+                        run[0] if len(run) == 1 else b"".join(run))
+                    run = []
+                self._sock.sendall(f[0])
+                self._sock.sendall(f[1])
+            else:
+                run.append(f)
+        if run:
+            self._sock.sendall(run[0] if len(run) == 1 else b"".join(run))
+
+    def _resolve_unsubmitted(self, entries) -> None:
+        """Slots of never-written frames: resolve as UNSUBMITTED before
+        _fail_pending sweeps the rest as died-mid-call."""
+        for _, rid in entries:
+            if rid is None:
+                continue
+            with self._plock:
+                slot = self._pending.pop(rid, None)
+            if slot is not None:
+                slot[1] = _UNSUBMITTED
+                slot[0].set()
+
+    def _fail_pending(self) -> None:
+        self.dead = True
+        with self._plock:
+            pending, self._pending = self._pending, {}
+        for slot in pending.values():
+            slot[1] = None
+            slot[0].set()
 
     def _read_loop(self) -> None:
         try:
-            while True:
-                body = _read_frame(self._sock)
+            for body in _frame_stream(self._sock):
                 if not body or body[0] != OP_REPLY or len(body) < 10:
                     continue
                 (rid,) = _U64.unpack_from(body, 1)
@@ -208,12 +350,7 @@ class FastLaneClient:
                     slot[0].set()
         except (ConnectionError, OSError):
             pass
-        self.dead = True
-        with self._plock:
-            pending, self._pending = self._pending, {}
-        for slot in pending.values():
-            slot[1] = None
-            slot[0].set()
+        self._fail_pending()
 
     # -- API --------------------------------------------------------------
     def submit(self, payload: bytes) -> Tuple[int, list]:
@@ -242,7 +379,7 @@ class FastLaneClient:
             if _fp.ENABLED and _fp.fire("fast_lane.submit",
                                         op=op) is _fp.DROP:
                 raise OSError("frame dropped by failpoint")
-            self._send(op, _U64.pack(rid) + extra, payload)
+            self._send(op, _U64.pack(rid) + extra, payload, rid=rid)
         except Exception as e:  # noqa: BLE001 — any send-path failure
             # (socket death OR an injected error of any class) must pop
             # the slot and mark the lane dead; a narrower catch leaked
@@ -257,6 +394,9 @@ class FastLaneClient:
              timeout: Optional[float] = None) -> Tuple[int, bytes]:
         if not slot[0].wait(timeout):
             raise TimeoutError("fast lane reply timed out")
+        if slot[1] is _UNSUBMITTED:
+            raise FastLaneUnsubmitted(
+                "frame never reached the wire (flush failed first)")
         if slot[1] is None:
             raise FastLaneError("fast lane died mid-call")
         return slot[1], slot[2]
@@ -403,16 +543,18 @@ def worker_fast_lane_start(addr: Tuple[str, int], state,
     current = {"tid": 0}
     exec_thread_holder = {}
 
+    # hot-path imports resolved ONCE per worker, not per task
+    import inspect
+
+    import cloudpickle
+
+    from ray_tpu._private import runtime_context
+    from ray_tpu._private.ids import (ActorID, JobID, NodeID,
+                                      PlacementGroupID, TaskID)
+    from ray_tpu._private.worker_process import (_current_rid, _dump_exc,
+                                                 _safe_dumps)
+
     def run_one(tid: int, msg: dict) -> None:
-        import inspect
-
-        from ray_tpu._private import runtime_context
-        from ray_tpu._private.ids import (ActorID, JobID, NodeID,
-                                          PlacementGroupID, TaskID)
-        from ray_tpu._private.worker_process import (_current_rid,
-                                                     _dump_exc,
-                                                     _safe_dumps)
-
         current["tid"] = tid
         _current_rid.rid = f"fl{tid}"
         try:
@@ -433,7 +575,6 @@ def worker_fast_lane_start(addr: Tuple[str, int], state,
             gen_items = None
             token = runtime_context._set_context(**ctx)
             try:
-                import cloudpickle
                 args, kwargs = cloudpickle.loads(msg["args"])
                 if "method" in msg:
                     # targeted actor call: run on the live instance,
@@ -530,8 +671,7 @@ def worker_fast_lane_start(addr: Tuple[str, int], state,
 
     def lane_loop() -> None:
         try:
-            while True:
-                body = _read_frame(sock)
+            for body in _frame_stream(sock):
                 if not body:
                     continue
                 op = body[0]

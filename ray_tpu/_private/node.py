@@ -118,6 +118,22 @@ class ResourceLedger:
                 self._available[k] = self._available.get(k, 0.0) - v * n
             return n
 
+    def release_many(self, groups) -> None:
+        """Release a batch of completions' demands under ONE lock
+        acquisition and ONE notify — the drain-side sibling of
+        :meth:`try_acquire_many`. ``groups`` is an iterable of
+        ``(demand, count)`` pairs (same-shape completions pre-grouped
+        by the caller); per-task release paid a lock round-trip plus a
+        notify_all — and therefore a dispatch-thread wakeup — per
+        completed task."""
+        with self._cond:
+            for demand, count in groups:
+                for k, v in demand.items():
+                    self._available[k] = min(
+                        self._available.get(k, 0.0) + v * count,
+                        self.total.get(k, 0.0))
+            self._cond.notify_all()
+
 
 class _DirectOp:
     """Closure queued on an ActorExecutor by a compiled DAG.
@@ -321,6 +337,94 @@ class ActorExecutor:
             loop.close()
 
 
+class _ExecPool:
+    """Sized task-execution pool fed by the dispatch loop.
+
+    Replaces the per-task ``_launch`` closure + semaphore feeding the
+    shared ``DaemonThreadPool``: the dispatch loop hands whole admitted
+    batches over in ONE lock acquisition + wakeup (``_launch`` paid a
+    semaphore acquire, a pool submit, and a closure allocation per
+    task), it never blocks on a full pool (the semaphore stalled it at
+    capacity), and admitted-but-unstarted specs stay visible as
+    TaskSpecs (``steal_pending``) so a graceful drain hands them back
+    to the scheduler instead of burning them down locally (the closure
+    queue made admitted work opaque and unreclaimable). Kept separate
+    from ``DaemonThreadPool`` on purpose: that pool's contract is
+    fire-and-forget opaque closures for its other consumers; this one
+    needs a drainable, stoppable typed-spec queue."""
+
+    def __init__(self, size: int, run_spec: Callable[[TaskSpec], None],
+                 name: str):
+        self._run_spec = run_spec
+        self._size = max(1, size)
+        self._name = name
+        self._cv = threading.Condition()
+        self._q: deque = deque()    #: guarded by self._cv
+        self._spawned = 0           #: guarded by self._cv
+        self._idle = 0              #: guarded by self._cv
+        self._stopped = False       #: guarded by self._cv
+
+    def submit_batch(self, specs) -> None:
+        with self._cv:
+            self._q.extend(specs)
+            # spawn only to cover queued work not already matched by an
+            # idle worker; stale counters over-spawn (bounded by _size),
+            # never under-spawn
+            spawn = min(len(self._q) - self._idle,
+                        self._size - self._spawned)
+            spawn = max(0, spawn)
+            self._spawned += spawn
+            base = self._spawned
+            self._cv.notify(len(specs))
+        for i in range(spawn):
+            threading.Thread(target=self._work, daemon=True,
+                             name=f"{self._name}-{base - i}").start()
+
+    def steal_pending(self) -> List[TaskSpec]:
+        """Atomically take every admitted-but-unstarted spec (drain
+        handback / node shutdown). In-flight specs are untouched — they
+        finish on their worker threads."""
+        with self._cv:
+            out = list(self._q)
+            self._q.clear()
+        return out
+
+    def has_handback_pending(self) -> bool:
+        """Any queued spec the drain pass could still hand back?
+        Bounced-back specs (scheduler found nowhere else) stay here and
+        run locally — without this filter the drain pass would steal
+        and requeue them every dispatch tick until a thread freed up."""
+        with self._cv:
+            return any(not getattr(s, "_drain_bounced", False)
+                       for s in self._q)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+    def _work(self) -> None:
+        try:
+            while True:
+                with self._cv:
+                    self._idle += 1
+                    while not self._q and not self._stopped:
+                        self._cv.wait()
+                    self._idle -= 1
+                    if not self._q:
+                        return      # stopped and drained
+                    spec = self._q.popleft()
+                try:
+                    self._run_spec(spec)
+                except BaseException:   # noqa: BLE001 — task errors are
+                    # delivered through the runtime's finish paths; a
+                    # stray escape must not kill a pool worker
+                    pass
+        finally:
+            with self._cv:
+                self._spawned -= 1
+
+
 class Node:
     """One (virtual) node: resources + store + dispatch loop + actors."""
 
@@ -358,10 +462,20 @@ class Node:
                                           reentrant=False)
         self._running: set = set()      #: guarded by self._running_lock
         self._running_lock = tracked_lock("node.running", reentrant=False)
-        self._sema = threading.Semaphore(max_worker_threads)
-        from ray_tpu._private.thread_pool import DaemonThreadPool
-        self._task_pool = DaemonThreadPool(
-            max_worker_threads, name=f"task-{node_id.hex()[:8]}")
+        # Coalesced ledger-release staging (flat combining): completing
+        # tasks append here; whichever thread finds no flush in
+        # progress drains the whole batch with ONE release_many call.
+        # Uncontended completions flush inline (no added latency);
+        # under a drain storm hundreds of releases share one ledger
+        # lock acquisition and one dispatch-thread wakeup.
+        self._release_stage: List[Dict[str, float]] = []  #: guarded by self._stage_lock
+        self._stage_flushing = False    #: guarded by self._stage_lock
+        self._stage_lock = tracked_lock("node.release_stage",
+                                        reentrant=False)
+        from ray_tpu._private.config import cfg
+        pool_size = int(cfg().exec_pool_size) or max_worker_threads
+        self._exec_pool = _ExecPool(pool_size, self._run_spec,
+                                    name=f"task-{node_id.hex()[:8]}")
         # Event-loop instrumentation (reference: asio
         # instrumented_io_context / event_stats.h — per-handler counts and
         # queue lag surfaced in debug_state dumps).
@@ -433,10 +547,15 @@ class Node:
             if not self.alive:
                 self._fail_backlog()
                 continue
-            if self.draining and self._backlog_n:
+            if self.draining and (self._backlog_n
+                                  or self._exec_pool
+                                  .has_handback_pending()):
                 # Hand queued-but-unstarted work back to the cluster
-                # scheduler (no retry consumed). Whatever bounces back
-                # (nowhere else fits) falls through and dispatches here.
+                # scheduler (no retry consumed) — both backlog entries
+                # AND specs already admitted into the exec-pool queue
+                # (the backlog can be empty while the pool still holds
+                # unstarted work). Whatever bounces back (nowhere else
+                # fits) falls through and dispatches here.
                 self._resubmit_backlog()
             progressed = False
             self.loop_stats["dispatch_iterations"] += 1
@@ -456,8 +575,13 @@ class Node:
                     admitted = [bucket.popleft() for _ in range(n)]
                     self._backlog_n -= n
                     self._drop_pending_many(admitted)
+                    t0 = time.perf_counter()
                     for spec in admitted:
-                        t0 = time.perf_counter()
+                        # Pairs this admission's ledger acquire with
+                        # exactly one release: the worker may release
+                        # early (see worker._release_task_resources) or
+                        # _run_spec's `finally` does.
+                        spec._resources_released = False
                         if spec.enqueued_at:
                             lag_ms = (t0 - spec.enqueued_at) * 1000
                             if lag_ms > self.loop_stats["max_queue_lag_ms"]:
@@ -474,45 +598,85 @@ class Node:
                                     start_wall=_ev.wall_at(
                                         spec.enqueued_at),
                                     end_mono=t0)
-                        # count BEFORE launch: the task thread may finish
-                        # (and a get() observe it) before control
-                        # returns here
-                        self.loop_stats["tasks_launched"] += 1
-                        self._launch(spec, drop_pending=False)
-                        self.loop_stats["launch_ms_total"] += (
-                            time.perf_counter() - t0) * 1000
+                    # count BEFORE the pool takes them: a task may
+                    # finish (and a get() observe it) before control
+                    # returns here
+                    self.loop_stats["tasks_launched"] += n
+                    with self._running_lock:
+                        self._running.update(s.task_id for s in admitted)
+                    # ONE handoff for the whole admitted batch; the
+                    # sized pool reuses threads instead of paying a
+                    # spawn + closure per task
+                    self._exec_pool.submit_batch(admitted)
+                    self.loop_stats["launch_ms_total"] += (
+                        time.perf_counter() - t0) * 1000
                     progressed = True
                 if not bucket:
                     self._backlog.pop(key, None)
             if self._backlog_n and not progressed:
                 self.ledger.wait_for_change(0.05)
 
-    def _launch(self, spec: TaskSpec, drop_pending: bool = True) -> None:
-        if drop_pending:
-            self._drop_pending(spec)
-        self._sema.acquire()
-        # Pairs this acquire with exactly one release: the worker may
-        # release early (before completing futures — see
-        # worker._release_task_resources) or the `finally` below does.
-        spec._resources_released = False
-        with self._running_lock:
-            self._running.add(spec.task_id)
+    def _run_spec(self, spec: TaskSpec) -> None:
+        """One task's execution on an exec-pool worker thread."""
+        try:
+            self._execute_task(spec, self)
+        finally:
+            with self._running_lock:
+                self._running.discard(spec.task_id)
+            if (spec.kind != TaskKind.ACTOR_CREATION
+                    and not getattr(spec, "_resources_released", True)):
+                # Actors hold their resources for their whole lifetime;
+                # the runtime releases them on actor death.
+                spec._resources_released = True
+                self.stage_release(spec.resources)
 
-        def run():
+    # -- coalesced ledger release (flat combining) -----------------------
+    def stage_release(self, resources: Dict[str, float]) -> None:
+        """Release ledger resources, coalescing concurrent completions:
+        if another thread is already flushing, this release rides its
+        drain (one ledger acquisition + one notify for the whole
+        batch); otherwise this thread flushes inline — the uncontended
+        single-task case keeps the old release latency."""
+        with self._stage_lock:
+            self._release_stage.append(resources)
+            if self._stage_flushing:
+                return      # the in-flight flusher drains us too
+            self._stage_flushing = True
+        self._drain_release_stage()
+
+    def _drain_release_stage(self) -> None:
+        while True:
+            with self._stage_lock:
+                batch = self._release_stage
+                if not batch:
+                    self._stage_flushing = False
+                    return
+                self._release_stage = []
             try:
-                self._execute_task(spec, self)
-            finally:
-                with self._running_lock:
-                    self._running.discard(spec.task_id)
-                if (spec.kind != TaskKind.ACTOR_CREATION
-                        and not getattr(spec, "_resources_released", True)):
-                    # Actors hold their resources for their whole lifetime;
-                    # the runtime releases them on actor death.
-                    spec._resources_released = True
-                    self.ledger.release(spec.resources)
-                self._sema.release()
+                self._release_batch(batch)
+            except BaseException:
+                # never leave the flusher flag stuck: staged entries
+                # appended meanwhile drain on the NEXT stage_release
+                # call (it sees _stage_flushing False and flushes)
+                with self._stage_lock:
+                    self._stage_flushing = False
+                raise
 
-        self._task_pool.submit(run)
+    def _release_batch(self, batch) -> None:
+        if len(batch) == 1:
+            self.ledger.release(batch[0])
+            return
+        # group same-shape demands: one release_many call covers
+        # the whole batch under one ledger lock acquisition
+        groups: "OrderedDict[tuple, list]" = OrderedDict()
+        for res in batch:
+            key = tuple(sorted(res.items()))
+            entry = groups.get(key)
+            if entry is None:
+                groups[key] = [res, 1]
+            else:
+                entry[1] += 1
+        self.ledger.release_many(groups.values())
 
     def _fail_backlog(self) -> None:
         from ray_tpu._private import worker
@@ -563,6 +727,39 @@ class Node:
             self._drop_pending(spec)
         for spec in moved:
             rt.on_node_task_drained(spec, self)
+        self._drain_pool_pending(rt)
+
+    def _drain_pool_pending(self, rt) -> None:
+        """Exec-pool drain interaction: in-flight tasks finish on their
+        worker threads, but admitted-but-unstarted specs still sitting
+        in the pool queue are stolen back, their ledger admission
+        undone, and handed to the scheduler like backlog entries (no
+        retry consumed). Bounced-back specs (nothing else fits) re-feed
+        the pool and run here."""
+        stolen = self._exec_pool.steal_pending()
+        if not stolen:
+            return
+        requeue: List[TaskSpec] = []
+        handback: List[TaskSpec] = []
+        for spec in stolen:
+            if getattr(spec, "_drain_bounced", False):
+                requeue.append(spec)
+            else:
+                handback.append(spec)
+        if requeue:
+            self._exec_pool.submit_batch(requeue)
+        if not handback:
+            return
+        with self._running_lock:
+            for spec in handback:
+                self._running.discard(spec.task_id)
+        for spec in handback:
+            # undo the admission's ledger acquire before rescheduling
+            if not getattr(spec, "_resources_released", True):
+                spec._resources_released = True
+                self.stage_release(spec.resources)
+        for spec in handback:
+            rt.on_node_task_drained(spec, self)
 
     # -- actor hosting -----------------------------------------------------
     def host_actor(self, executor: ActorExecutor) -> None:
@@ -587,4 +784,22 @@ class Node:
             pending_by_actor[aid] = ex.kill("node died")
         if fail_tasks:
             self._fail_backlog()
+            self._fail_pool_pending()
+        # let in-flight pool work unwind, then retire the idle threads
+        self._exec_pool.stop()
         return pending_by_actor
+
+    def _fail_pool_pending(self) -> None:
+        """Node death with specs admitted but not yet started: route
+        them through the same lost-task flow as the backlog."""
+        stolen = self._exec_pool.steal_pending()
+        if not stolen:
+            return
+        from ray_tpu._private import worker
+        rt = worker.global_runtime()
+        with self._running_lock:
+            for spec in stolen:
+                self._running.discard(spec.task_id)
+        if rt is not None:
+            for spec in stolen:
+                rt.on_node_task_lost(spec, self)

@@ -31,8 +31,21 @@ from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu.exceptions import OutOfMemoryError
 
 
+# Values of these exact types need no deep walk: drain-path profiles
+# showed _nbytes_of + _is_device_value re-walking every stored task
+# result (~100us/result on sandboxed kernels for a bare None — the
+# in-function imports and jax.tree_map dominate, not the data).
+_TRIVIAL_TYPES = (type(None), bool, int, float)
+
+
 def _nbytes_of(value: Any) -> int:
     """Best-effort deep size estimate without serializing."""
+    t = type(value)
+    if t in _TRIVIAL_TYPES:
+        # int is arbitrary-precision — getsizeof (one cheap C call)
+        # keeps a huge int honestly accounted so eviction/OOM
+        # thresholds still trigger; the others are fixed-size
+        return sys.getsizeof(value) if t is int else 32
     import numpy as np
 
     seen = set()
@@ -64,6 +77,12 @@ def _nbytes_of(value: Any) -> int:
 
 def _is_device_value(value: Any) -> bool:
     """True if the value is a jax.Array or a pytree containing one."""
+    import sys as _sys
+    if type(value) in _TRIVIAL_TYPES or isinstance(value, (str, bytes,
+                                                           bytearray)):
+        return False    # never a device array; skip the tree walk
+    if "jax" not in _sys.modules:
+        return False    # no jax imported -> no jax.Array can exist
     try:
         import jax
     except ImportError:
@@ -263,6 +282,13 @@ class LocalObjectStore:
             e = self._entries.get(object_id)
             if e is not None and e.pinned > 0:
                 e.pinned -= 1
+
+    def nbytes_of(self, object_id: ObjectID) -> int:
+        """Size cached on the entry at insert time (the same number the
+        eviction/spill accounting uses) — never re-walks the value."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            return e.nbytes if e is not None else 0
 
     def used_bytes(self) -> int:
         with self._lock:

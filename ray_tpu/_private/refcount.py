@@ -75,8 +75,35 @@ class ReferenceCounter:
                 self._get(oid).submitted_task_refs += 1
 
     def remove_submitted_task_refs(self, oids: List[ObjectID]) -> None:
-        for oid in oids:
-            self._dec(oid, "submitted_task_refs")
+        """Drop one submitted-task pin per listed oid — the whole batch
+        decrements under ONE lock acquisition (the drain-side path
+        releases a completed task's arg pins together; per-oid _dec
+        paid a lock round-trip each). Frees cascade outside the lock
+        through the same deferral queue as single decrements."""
+        pending = getattr(self._tls, "pending", None)
+        if pending is not None:     # nested call: defer to outermost
+            pending.extend((oid, "submitted_task_refs") for oid in oids)
+            return
+        self._tls.pending = pending = []
+        try:
+            zeroed: List[ObjectID] = []
+            with self._lock:
+                for oid in oids:
+                    ref = self._refs.get(oid)
+                    if ref is None:
+                        continue
+                    if ref.submitted_task_refs > 0:
+                        ref.submitted_task_refs -= 1
+                    if ref.total() == 0 and oid not in zeroed:
+                        # a duplicated oid in the batch zeroes once
+                        zeroed.append(oid)
+            for oid in zeroed:
+                self._maybe_free(oid)
+            while pending:
+                nxt_oid, nxt_attr = pending.pop(0)
+                self._dec_now(nxt_oid, nxt_attr)
+        finally:
+            self._tls.pending = None
 
     # -- containment (nested refs inside stored values) --------------------
     def add_nested_refs(self, outer: ObjectID, inner: List[ObjectID]) -> None:

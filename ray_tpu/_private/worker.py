@@ -683,8 +683,11 @@ class Runtime:
                     target.store.register_remote(oid, key, nbytes)
                 else:
                     value = node.store.get(oid)
+                    # reuse the size cached at insert time — migrating
+                    # a large pytree must not pay a fresh deep walk
                     target.store.put(oid, value,
-                                     nbytes=_nbytes_of(value))
+                                     nbytes=node.store.nbytes_of(oid)
+                                     or _nbytes_of(value))
             except Exception:
                 continue
             with self._loc_lock:
@@ -1394,6 +1397,7 @@ class Runtime:
 
     def _on_task_done(self, spec: TaskSpec, state: str) -> None:
         self.stats["tasks_finished"] += 1
+        task_hex = spec.task_id.hex()
         # Per-task borrow release (reference: reference_count.h:73): refs
         # the owner created on this task's behalf (nested put/submit from
         # its worker) un-pin NOW — results are already stored, so
@@ -1402,11 +1406,11 @@ class Runtime:
         backend = getattr(self, "cluster_backend", None)
         svc = getattr(backend, "owner_service", None)
         if svc is not None:
-            svc.holder.release("t:" + spec.task_id.hex())
+            svc.holder.release("t:" + task_hex)
         # same release for the driver-local fast lane's workers
-        self.process_router.release_borrows("t:" + spec.task_id.hex())
+        self.process_router.release_borrows("t:" + task_hex)
         from ray_tpu._private.export_events import emit_export
-        emit_export("TASK", task_id=spec.task_id.hex(), name=spec.name,
+        emit_export("TASK", task_id=task_hex, name=spec.name,
                     state=state, kind=str(spec.kind),
                     job_id=self.job_id.hex())
         deps = spec.dependencies()
@@ -1528,12 +1532,14 @@ class Runtime:
     def _release_task_resources(self, spec: TaskSpec,
                                 node: Optional[Node]) -> None:
         """Idempotent early release (runs on the worker thread, strictly
-        before the node dispatch loop's own `finally` release)."""
+        before the exec pool's own `finally` release). Staged: a batch
+        of same-shape completions lands on the ledger under ONE lock
+        acquisition (node.stage_release flat-combining)."""
         from ray_tpu._private.task_spec import TaskKind
         if (node is not None and spec.kind != TaskKind.ACTOR_CREATION
                 and not getattr(spec, "_resources_released", False)):
             spec._resources_released = True
-            node.ledger.release(spec.resources)
+            node.stage_release(spec.resources)
 
     def _drain_generator(self, spec: TaskSpec, node: Node, gen) -> None:
         state = self._generators.setdefault(

@@ -1932,6 +1932,10 @@ class ProcessRouter:
             self._fast_rids[task_hex] = (fl, rid)
         try:
             kind, blob = fl.wait(slot)
+        except _fle.FastLaneUnsubmitted:
+            # frame never reached the wire (another submitter's flush
+            # failed first): nothing ran — classic path, retry-free
+            return None
         except _fle.FastLaneError as e:
             # submitted but the lane died: surface as a worker crash so
             # retry accounting applies (never a silent re-run)
@@ -1966,6 +1970,8 @@ class ProcessRouter:
         """Drop lane workers' owner-side holds for a finished borrower
         ('t:<task>' — per-task borrow release for the driver-local
         lane, mirroring the cluster OwnerHolder)."""
+        if not self._fast_workers:
+            return  # no driver-local lane: per-completion fast path
         for w in list(self._fast_workers):
             dropped = w._holds.pop(key, None)
             del dropped

@@ -365,6 +365,263 @@ def test_try_acquire_many():
     assert led.available()["CPU"] == pytest.approx(2.5)
 
 
+# ---------------------------------------------------------------------------
+# drain-side result pipeline (batched completion delivery)
+# ---------------------------------------------------------------------------
+
+class _FakeConn:
+    """Stand-in for a daemon Connection on the reply pump: records every
+    pushed frame; hashable (dict key in the pump buffer)."""
+
+    def __init__(self):
+        self.frames = []
+        self.closed = False
+
+    def push(self, method, **kw):
+        assert method == "task_batch_done"
+        self.frames.append(kw["outcomes"])
+
+
+def test_result_pump_drop_requeues_and_resends():
+    """batch.result_flush drop arm: a lost task_batch_done frame's
+    entries requeue in order and leave on the next pump pass — nothing
+    is dropped, nothing is duplicated."""
+    from ray_tpu._private.daemon import _BatchReplyPump
+    fp.configure("batch.result_flush", "drop", every=2)
+    pump = _BatchReplyPump()
+    conn = _FakeConn()
+    for i in range(40):
+        pump.add(conn, {"task": f"t{i}", "outcome": "ok"})
+        if i % 10 == 9:
+            time.sleep(0.02)    # several pump passes → several frames
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if sum(len(f) for f in conn.frames) >= 40:
+            break
+        time.sleep(0.02)
+    got = [out["task"] for frame in conn.frames for out in frame]
+    assert sorted(got) == sorted(f"t{i}" for i in range(40)), (
+        "drop arm lost or duplicated completions")
+    assert fp.fire_count("batch.result_flush") > 0, "no drop injected"
+
+
+def test_result_pump_zero_linger_drops_still_deliver():
+    """result_linger_us=0 is documented ('flush immediately'); with the
+    drop arm armed the retry path must still converge — the failure
+    backoff floor keeps the pump off a busy-spin while resending."""
+    from ray_tpu._private.config import apply_system_config
+    from ray_tpu._private.daemon import _BatchReplyPump
+    apply_system_config({"result_linger_us": 0})
+    try:
+        fp.configure("batch.result_flush", "drop", every=2)
+        pump = _BatchReplyPump()
+        assert pump.linger_s == 0.0
+        conn = _FakeConn()
+        for i in range(10):
+            pump.add(conn, {"task": f"z{i}", "outcome": "ok"})
+            time.sleep(0.005)   # several passes → several drop fires
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if sum(len(f) for f in conn.frames) >= 10:
+                break
+            time.sleep(0.02)
+        got = [o["task"] for f in conn.frames for o in f]
+        assert sorted(got) == sorted(f"z{i}" for i in range(10))
+        assert fp.fire_count("batch.result_flush") > 0
+    finally:
+        apply_system_config(None)
+
+
+def test_result_pump_error_arm_is_a_loss_not_a_crash():
+    """The error arm at the flush seam behaves like a transport loss:
+    the pump thread survives and the entries still arrive."""
+    from ray_tpu._private.daemon import _BatchReplyPump
+    fp.configure("batch.result_flush", "error", max_fires=1)
+    pump = _BatchReplyPump()
+    conn = _FakeConn()
+    pump.add(conn, {"task": "only", "outcome": "ok"})
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        if conn.frames:
+            break
+        time.sleep(0.02)
+    assert [o["task"] for f in conn.frames for o in f] == ["only"]
+    assert fp.fire_count("batch.result_flush") == 1
+
+
+def test_ingest_batch_out_of_order_and_duplicates():
+    """Driver-side ingest idempotency: a resent frame arriving after
+    (or interleaved with) its successor wakes each waiter exactly once;
+    duplicates find no slot and are dropped silently."""
+    import queue
+    import threading
+    from types import SimpleNamespace
+
+    from ray_tpu._private.cluster import DaemonHandle
+    h = DaemonHandle.__new__(DaemonHandle)
+    h._bw_lock = threading.Lock()
+    h._slock = threading.Lock()
+    slots = {name: [threading.Event(), None] for name in ("t1", "t2", "t3")}
+    h._batch_waiters = dict(slots)
+    stream = SimpleNamespace(q=queue.Queue())
+    h._streams = {"s1": stream}
+
+    # frame 2 arrives FIRST (out of order), carrying t2+t3 and a stream
+    # termination
+    h._ingest_batch([{"task": "t2", "outcome": "ok", "v": 2},
+                     {"task": "t3", "outcome": "ok", "v": 3},
+                     {"task": "s1", "stream": "task_stream_end"}])
+    assert slots["t2"][0].is_set() and slots["t2"][1]["v"] == 2
+    assert slots["t3"][0].is_set() and slots["t3"][1]["v"] == 3
+    assert not slots["t1"][0].is_set()
+    assert stream.q.get_nowait()["m"] == "task_stream_end"
+
+    # the resent frame 1 lands late: t1 completes now; the duplicate t2
+    # (and a duplicate stream end) are no-ops
+    h._ingest_batch([{"task": "t1", "outcome": "ok", "v": 1},
+                     {"task": "t2", "outcome": "ok", "v": 99},
+                     {"task": "s1", "stream": "task_stream_end"}])
+    assert slots["t1"][0].is_set() and slots["t1"][1]["v"] == 1
+    assert slots["t2"][1]["v"] == 2, "duplicate overwrote the outcome"
+    assert h._batch_waiters == {}
+
+
+def test_result_flush_drop_end_to_end_exactly_once(tmp_path):
+    """Daemon-side batch.result_flush drop arm (env-armed so the
+    spawned daemon inherits it): completions are 'lost in transit'
+    every other frame, the pump resends, and every task body still runs
+    exactly once with every result delivered."""
+    import os
+    marker = tmp_path / "runs.txt"
+    os.environ["RAY_TPU_FAILPOINTS"] = "batch.result_flush=drop:every=2"
+    try:
+        ray_tpu.init(num_nodes=1, resources={"CPU": 4},
+                     cluster="daemons")
+
+        @ray_tpu.remote(num_returns=2)
+        def record(i, path):
+            with open(path, "a") as fh:
+                fh.write(f"{i}\n")
+            return i, -i
+
+        refs = [record.remote(i, str(marker)) for i in range(30)]
+        out = ray_tpu.get([r for ab in refs for r in ab], timeout=120)
+        assert out == [v for i in range(30) for v in (i, -i)]
+        lines = sorted(int(x) for x in marker.read_text().split())
+        assert lines == list(range(30))     # exactly once each
+    finally:
+        os.environ.pop("RAY_TPU_FAILPOINTS", None)
+        ray_tpu.shutdown()
+
+
+def test_mixed_classic_and_batched_submitters_one_daemon(daemon_cluster):
+    """Both completion entry points share one daemon's dedupe tables
+    and one reply pump: a thread of batched (push_task_batch)
+    submissions races classic via_pump submissions on the SAME daemon —
+    every result lands, none twice."""
+    import threading
+    rt = daemon_cluster
+    handles = list(rt.cluster_backend.daemons.values())
+    assert all(h._result_batch for h in handles), (
+        "daemon hello did not advertise result_batch")
+
+    batched_out = {}
+
+    def batched_submitter():
+        refs = [pair.remote(i) for i in range(20)]
+        batched_out["v"] = ray_tpu.get([r for ab in refs for r in ab])
+
+    t = threading.Thread(target=batched_submitter)
+    t.start()
+    # classic path on the same daemons: flip batch support off so new
+    # submissions take the per-task submit_task RPC, whose completion
+    # rides the shared task_batch_done pump (via_pump)
+    for h in handles:
+        h._batch_supported = False
+    try:
+        refs = [pair.remote(100 + i) for i in range(20)]
+        classic = ray_tpu.get([r for ab in refs for r in ab], timeout=60)
+    finally:
+        for h in handles:
+            h._batch_supported = True
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert classic == [v for i in range(100, 120) for v in (i, i + 1)]
+    assert batched_out["v"] == [v for i in range(20) for v in (i, i + 1)]
+
+
+def test_classic_submit_rides_result_pump():
+    """submit_batch=False still gets coalesced completion delivery:
+    the daemon acks via_pump submissions immediately and the outcome
+    returns on a task_batch_done frame."""
+    rt = ray_tpu.init(num_nodes=1, resources={"CPU": 4},
+                      cluster="daemons",
+                      _system_config={"submit_batch": False})
+    try:
+        handle = next(iter(rt.cluster_backend.daemons.values()))
+        assert handle._submit_coalescer() is None   # batching disabled
+        assert handle._result_batch                 # pump still on
+        refs = [pair.remote(i) for i in range(15)]
+        assert ray_tpu.get([r for ab in refs for r in ab],
+                           timeout=60) == [
+            v for i in range(15) for v in (i, i + 1)]
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# coalesced ledger release (release_many)
+# ---------------------------------------------------------------------------
+
+def test_release_many_matches_n_single_releases():
+    from ray_tpu._private.node import ResourceLedger
+    a = ResourceLedger({"CPU": 8.0, "TPU": 4.0})
+    b = ResourceLedger({"CPU": 8.0, "TPU": 4.0})
+    for led in (a, b):
+        assert led.try_acquire_many({"CPU": 1.0}, 6) == 6
+        assert led.try_acquire_many({"CPU": 0.5, "TPU": 2.0}, 2) == 2
+    a.release_many([({"CPU": 1.0}, 6), ({"CPU": 0.5, "TPU": 2.0}, 2)])
+    for _ in range(6):
+        b.release({"CPU": 1.0})
+    for _ in range(2):
+        b.release({"CPU": 0.5, "TPU": 2.0})
+    assert a.available() == b.available()
+    assert a.available() == {"CPU": 8.0, "TPU": 4.0}
+
+
+def test_release_many_clamps_at_total_like_release():
+    from ray_tpu._private.node import ResourceLedger
+    led = ResourceLedger({"CPU": 2.0})
+    # over-release (e.g. a shape released twice across a retry seam)
+    # clamps at capacity exactly like the single-release path
+    led.release_many([({"CPU": 5.0}, 3)])
+    assert led.available() == {"CPU": 2.0}
+    single = ResourceLedger({"CPU": 2.0})
+    single.release({"CPU": 15.0})
+    assert led.available() == single.available()
+
+
+def test_release_many_wakes_dispatch_waiter():
+    """release_many must notify the ledger condition — a dispatch loop
+    parked in wait_for_change wakes when a batch of completions lands."""
+    import threading
+    from ray_tpu._private.node import ResourceLedger
+    led = ResourceLedger({"CPU": 1.0})
+    assert led.try_acquire_many({"CPU": 1.0}, 1) == 1
+    woke = threading.Event()
+
+    def waiter():
+        led.wait_for_change(5.0)
+        woke.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    led.release_many([({"CPU": 1.0}, 1)])
+    assert woke.wait(2.0), "release_many never notified the condition"
+    t.join()
+
+
 def test_recv_exact_shared_implementation():
     """One recv helper for rpc + fast_lane; recv_into semantics and the
     two-phase large-frame send survive a round trip."""

@@ -52,3 +52,7 @@ def test_train_bench_child_cpu_smoke():
     cp = out.get("control_plane")
     if cp is not None:      # platform stamped into EVERY section
         assert cp["platform"] == "cpu" and cp["tpu_fallback"] is True
+        # the drain-side rate rides every BENCH json (ROADMAP item 4:
+        # the trajectory files track the bottleneck being fixed)
+        assert "drain_tasks_per_second" in cp
+        assert "tasks_per_second" in cp

@@ -346,6 +346,58 @@ def test_chaos_drain_races_worker_crashes_daemons(seed, daemon_cluster):
 
 
 @pytest.mark.parametrize("seed", SEEDS)
+def test_chaos_drain_exec_pool_inflight_vs_pending(seed, tmp_path):
+    """Drain a node whose sized exec pool is saturated (PR 10 pooled
+    execution): pooled IN-FLIGHT tasks finish where they run, admitted-
+    but-unstarted specs still in the pool queue are stolen back and
+    handed to the scheduler WITHOUT consuming a retry (max_retries=0
+    throughout — a burned retry would fail the task), and every body
+    runs exactly once, under seeded lane-submit delay noise. Topology
+    comes from the run_chaos.sh sweep (in-process + daemons)."""
+    rt = ray_tpu.init(num_nodes=2, resources={"CPU": 8},
+                      # pool far smaller than the ledger's admission
+                      # width: admitted specs QUEUE in the pool, so the
+                      # drain finds both in-flight and pending work
+                      _system_config={"exec_pool_size": 2})
+    try:
+        fp.activate("fast_lane.submit=delay(10):p=0.25", seed=seed)
+        marker_dir = str(tmp_path)
+
+        @ray_tpu.remote(max_retries=0)
+        def slow(i):
+            with open(os.path.join(marker_dir, f"{i}.ran"), "a") as fh:
+                fh.write("x")
+            time.sleep(0.2)
+            return i * 5
+
+        refs = [slow.remote(i) for i in range(16)]
+        time.sleep(0.15)    # let admission fill the pools mid-flood
+        victim = rt.alive_nodes()[0]
+        assert rt.drain_node(victim.node_id, deadline_s=30,
+                             reason="chaos")
+        out = ray_tpu.get(refs, timeout=120)
+        assert out == [i * 5 for i in range(16)]
+        # exactly once each: the pool-queue handback resubmits specs
+        # that never started — a double run (or a retry-burning failure)
+        # shows up as a doubled marker / missing result
+        for i in range(16):
+            with open(os.path.join(marker_dir, f"{i}.ran")) as fh:
+                assert fh.read() == "x", f"task {i} body ran != once"
+        assert rt.stats["tasks_retried"] == 0
+        # clean drain: the node left via completion, not escalation
+        deadline = time.monotonic() + 30
+        while (rt.get_node(victim.node_id) is not None
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        assert rt.get_node(victim.node_id) is None
+        assert rt.stats["drain_escalations_total"] == 0
+        # the survivor keeps serving pooled work
+        assert ray_tpu.get(slow.remote(99), timeout=60) == 495
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
 def test_chaos_drain_deadline_races_escalation_daemons(seed,
                                                       daemon_cluster):
     """A drain whose window closes mid-load escalates into the node-

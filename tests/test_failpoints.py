@@ -441,6 +441,7 @@ WIRED_SEAMS = [
     "drain.deadline",
     "batch.submit_flush",
     "batch.free_flush",
+    "batch.result_flush",
     "trace.flush",
 ]
 

@@ -314,7 +314,7 @@ def test_spans_flush_to_head_and_breakdown(daemon_cluster):
     from ray_tpu.util.state import task_breakdown
     bd = task_breakdown(full[0])
     assert set(bd) == {"submit", "linger", "queue", "dispatch", "exec",
-                       "result"}
+                       "result_flush", "result_ingest", "result"}
     assert bd["exec"] > 0.0 and bd["dispatch"] > 0.0
 
     # merged chrome trace: one lane per process, monotonic ordering

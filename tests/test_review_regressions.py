@@ -333,3 +333,282 @@ def test_fast_lane_ping_slot_leak(_failpoints_reset):
     finally:
         client.close()
         srv.close()
+
+
+def _lane_client_with_sendall(srv, sendall_fn):
+    """FastLaneClient against a dummy server, with sendall intercepted
+    (socket objects reject attribute assignment, so proxy the sock)."""
+    from ray_tpu._private import fast_lane as fle
+
+    client = fle.FastLaneClient(srv.getsockname())
+
+    class _SockProxy:
+        def __init__(self, sock):
+            self._s = sock
+
+        def __getattr__(self, name):
+            return getattr(self._s, name)
+
+        def sendall(self, data):
+            return sendall_fn(self._s, data)
+
+    client._sock = _SockProxy(client._sock)
+    return client
+
+
+def test_send_stage_later_pass_failure_is_post_submit():
+    """Flat-combining send stage: a flusher whose OWN frame already hit
+    the wire must not see a LATER pass's send failure as a submit
+    error — submit() returns and the failed slot surfaces via wait()
+    ("died mid-call" -> retry accounting). Raising there made the
+    classic fallback re-run a task the daemon was already executing."""
+    import socket
+
+    from ray_tpu._private import fast_lane as fle
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    calls = []
+    holder = {}
+
+    def sendall(sock, data):
+        calls.append(bytes(data))
+        if len(calls) == 1:
+            # a thread staging mid-pass sees _send_flushing True and
+            # returns: the SAME drain's pass 2 flushes it — and fails
+            with holder["c"]._stage_lock:
+                holder["c"]._send_stage.append(
+                    (b"other-thread-frame", None))
+            return sock.sendall(data)
+        raise OSError("connection lost mid-burst")
+
+    client = holder["c"] = _lane_client_with_sendall(srv, sendall)
+    try:
+        rid, slot = client.submit(b"payload")   # must NOT raise
+        assert len(calls) == 2 and client.dead
+        with pytest.raises(fle.FastLaneError):
+            client.wait(slot, timeout=0.5)
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_send_stage_sole_frame_failure_still_raises_to_submitter():
+    """Sole-frame failed write: sendall raising guarantees the daemon
+    can't hold a complete frame, so submit() raises and the classic
+    fallback stays safe. An own frame sharing a failed MULTI-frame
+    write (its bytes may have reached the daemon) must not raise."""
+    import socket
+
+    from ray_tpu._private import fast_lane as fle
+
+    srv = socket.create_server(("127.0.0.1", 0))
+
+    def sendall_fail(sock, data):
+        raise OSError("connection lost")
+
+    client = _lane_client_with_sendall(srv, sendall_fail)
+    try:
+        with pytest.raises(fle.FastLaneError):
+            client.submit(b"payload")
+        assert client.dead and not client._pending
+    finally:
+        client.close()
+        srv.close()
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    client = _lane_client_with_sendall(srv, sendall_fail)
+    try:
+        with client._stage_lock:        # rides the first write too
+            client._send_stage.append((b"earlier-staged-frame", None))
+        rid, slot = client.submit(b"payload")   # must NOT raise
+        assert client.dead
+        with pytest.raises(fle.FastLaneError):
+            client.wait(slot, timeout=0.5)
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_send_stage_unwritten_frame_resolves_unsubmitted():
+    """A frame still STAGED when another thread's flush fails provably
+    never reached the wire: its slot must resolve FastLaneUnsubmitted
+    (callers take the classic path retry-free), not lane death (which
+    consumes a retry — with max_retries=0 a never-submitted task
+    failed permanently)."""
+    import socket
+    import threading
+
+    from ray_tpu._private import fast_lane as fle
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    holder = {}
+
+    def sendall(sock, data):
+        # stage a second submitter's frame (with a live pending slot)
+        # mid-write, then fail the write: the staged frame was never
+        # part of any sendall
+        c = holder["c"]
+        rid2 = next(c._rids)
+        slot2 = [threading.Event(), None, None]
+        with c._plock:
+            c._pending[rid2] = slot2
+        with c._stage_lock:
+            c._send_stage.append((b"unwritten-frame", rid2))
+        holder["slot2"] = slot2
+        raise OSError("connection lost")
+
+    client = holder["c"] = _lane_client_with_sendall(srv, sendall)
+    try:
+        with pytest.raises(fle.FastLaneError):
+            client.submit(b"payload")   # sole own frame: submit raises
+        with pytest.raises(fle.FastLaneUnsubmitted):
+            client.wait(holder["slot2"], timeout=0.5)
+        assert client.dead and not client._pending
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_drain_steals_pool_pending_when_backlog_empty():
+    """Ample ledger capacity admits every queued task straight into the
+    exec-pool queue (node backlog EMPTY); graceful drain must still
+    steal the unstarted specs back retry-free. The backlog-gated drain
+    pass skipped the pool queue entirely: the specs burned down
+    serially on the tiny pool, the deadline escalated, and
+    max_retries=0 tasks that never started failed permanently."""
+    rt = ray_tpu.init(num_nodes=2, resources={"CPU": 64},
+                      _system_config={"exec_pool_size": 2})
+    try:
+        @ray_tpu.remote(max_retries=0)
+        def slowish(i):
+            time.sleep(0.4)
+            return i
+
+        refs = [slowish.remote(i) for i in range(40)]
+        time.sleep(0.3)     # dispatch admits everything into the pools
+        victim = ray_tpu.nodes()[0]["NodeID"]
+        # deadline far below the serial burn-down time of the pool
+        # queue (20 specs x 0.4s / 2 threads = 4s): without handback
+        # the drain escalates and the never-started specs are lost
+        ray_tpu.drain_node(victim, deadline_s=3.0)
+        assert ray_tpu.get(refs, timeout=120) == list(range(40))
+        assert rt.stats["tasks_retried"] == 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_nbytes_of_accounts_big_ints():
+    """int is arbitrary-precision: the trivial-type fast path must not
+    book a multi-MB int as 32 bytes (store _used stayed near zero, so
+    eviction/spill and OOM thresholds never fired)."""
+    import sys as _sys
+
+    from ray_tpu._private.object_store import _nbytes_of
+
+    big = 10 ** 10000
+    assert _nbytes_of(big) >= _sys.getsizeof(big) > 4000
+    assert _nbytes_of(5) <= 64
+    assert _nbytes_of(None) == 32
+    assert _nbytes_of(1.5) == 32
+    assert _nbytes_of(True) == 32
+
+
+def test_stream_terminations_gated_on_term_pump():
+    """Coalesced stream terminations are a driver-advertised capability
+    (entry flag ``term_pump``): a driver that did not set it (an older
+    release on a persistent daemon) must get the classic per-task
+    termination push — coalescing it would strand that driver's
+    stream consumer forever."""
+    from ray_tpu._private.daemon import _BatchTaskConn
+
+    class FakePump:
+        def __init__(self):
+            self.added = []
+
+        def add(self, conn, out):
+            self.added.append(out)
+
+    class FakeService:
+        def __init__(self):
+            self._batch_pump = FakePump()
+
+    class FakeConn:
+        closed = False
+
+        def __init__(self):
+            self.pushed = []
+
+        def push(self, method, **kw):
+            self.pushed.append((method, kw))
+
+    svc, conn = FakeService(), FakeConn()
+    old = _BatchTaskConn(svc, conn, "t1", ("t1", 0))    # no term_pump
+    old.push("task_stream_end", task="t1")
+    assert conn.pushed == [("task_stream_end", {"task": "t1"})]
+    assert svc._batch_pump.added == []
+    new = _BatchTaskConn(svc, conn, "t2", ("t2", 0), term_pump=True)
+    new.push("task_stream_end", task="t2")
+    assert [o["stream"] for o in svc._batch_pump.added] == [
+        "task_stream_end"]
+    assert len(conn.pushed) == 1    # still only the ungated driver's
+
+
+def test_send_stage_large_frame_keeps_fifo_order():
+    """A >SEND_CONCAT_MAX payload must ride the send stage in FIFO
+    position (as a two-part entry), not bypass it: the old direct
+    write under the wire lock could overtake the SAME thread's earlier
+    staged small frame, executing two calls to one actor in reverse
+    submission order."""
+    import socket
+    import threading
+
+    from ray_tpu._private.fast_lane import (
+        _SEND_CONCAT_MAX, FastLaneClient)
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    writes = []
+
+    def sendall(sock, data):
+        writes.append(bytes(data))
+
+    client = _lane_client_with_sendall(srv, sendall)
+    try:
+        # park a small frame in the stage (flusher "active" elsewhere)
+        with client._stage_lock:
+            client._send_flushing = True
+        client._send(0x01, b"", b"small-first", rid=None)
+        assert not writes              # staged, not written
+        with client._stage_lock:
+            client._send_flushing = False
+        # the SAME thread now sends a large frame: it must flush the
+        # earlier small frame first, in order
+        big = b"x" * (_SEND_CONCAT_MAX + 1)
+        client._send(0x02, b"", big, rid=None)
+        blob = b"".join(writes)
+        assert blob.index(b"small-first") < blob.index(b"x" * 64)
+        # the big payload was written whole, never concat-copied into
+        # a joined batch buffer (its sendall is the payload alone)
+        assert big in writes
+    finally:
+        client.close()
+        srv.close()
+
+
+def test_exec_pool_handback_gate_skips_bounced():
+    """The drain pass runs only while the pool queue holds specs it
+    could still hand back: bounced-back specs (nowhere else fits) must
+    not re-trigger steal/requeue churn every dispatch tick."""
+    from ray_tpu._private.node import _ExecPool
+
+    class Spec:
+        def __init__(self, bounced):
+            if bounced:
+                self._drain_bounced = True
+
+    pool = _ExecPool(1, lambda s: None, name="t")
+    with pool._cv:      # keep workers from draining the queue
+        pool._q.extend([Spec(True), Spec(True)])
+    assert not pool.has_handback_pending()
+    with pool._cv:
+        pool._q.append(Spec(False))
+    assert pool.has_handback_pending()

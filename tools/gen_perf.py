@@ -121,11 +121,17 @@ def main() -> int:
           "batched push_task_batch wire path end to end "
           "(docs/performance.md). The envelope section appears only "
           "on hosts whose thread/PID limits can hold the 100k-task / "
-          "5000-actor slices. Numbers are only comparable within one "
-          "host generation: see tools/evidence/batching_ab_r6.md for "
-          "the same-box A/B that isolates code changes from hardware "
-          "changes (control-plane submit 4.4-6.5x, round-trip rows "
-          "execution-bound).")
+          "5000-actor slices (the exec pool's typed spec queue keeps "
+          "the drain's peak thread and dispatch-loop load bounded, so "
+          "the 100k slice fits where the semaphore-fed launch path "
+          "did not). Numbers are only comparable within one "
+          "host generation: see tools/evidence/batching_ab_r6.md "
+          "(control-plane submit 4.4-6.5x) and "
+          "tools/evidence/drain_ab_r10.md (drain-side result "
+          "pipeline: queued-drain rows within 2x of submit — ratio "
+          "~0.5 — with no round-trip/submit regression) for the "
+          "same-box A/Bs that isolate code changes from hardware "
+          "changes.")
     return 0
 
 

@@ -67,11 +67,18 @@ while time.perf_counter() - t0 < 4.0:
     count += 100
 results["tasks_per_second"] = round(count / (time.perf_counter() - t0), 1)
 
-# probe 2: queued burst submit rate (3k tasks, ~2-4s)
+# probe 2: queued burst submit rate + drain ratio (3k tasks, ~2-6s).
+# queued_drain_ratio = drain rate ÷ submit rate — the drain-side
+# result-pipeline metric (ROADMAP item 4: drain within 2x of submit
+# means ratio >= 0.5). Ratio of the SAME burst, so box speed cancels.
 t0 = time.perf_counter()
 refs = [noop.remote() for _ in range(3000)]
-results["queued_submit_per_s"] = round(3000 / (time.perf_counter() - t0), 1)
+t_submit = time.perf_counter() - t0
+results["queued_submit_per_s"] = round(3000 / t_submit, 1)
 ray_tpu.get(refs)
+t_drain = time.perf_counter() - t0 - t_submit
+# drain rate / submit rate = (N/t_drain) / (N/t_submit) = t_submit/t_drain
+results["queued_drain_ratio"] = round(t_submit / t_drain, 3)
 
 # probe 3: batched classic-path burst — exercises the submit coalescer
 # wire path when this script is invoked with the `daemons` mode
@@ -185,8 +192,12 @@ for name, floor in floors.items():
     got = results.get(name, 0.0)
     limit = floor * (1.0 - TOLERANCE)
     verdict = "ok" if got >= limit else "REGRESSION"
-    print(f"{name}: {got:,.0f}/s vs floor {floor:,.0f}/s "
-          f"(min {limit:,.0f}/s) {verdict}")
+    if name.endswith("_ratio"):     # dimensionless rows (drain÷submit)
+        print(f"{name}: {got:.2f} vs floor {floor:.2f} "
+              f"(min {limit:.2f}) {verdict}")
+    else:
+        print(f"{name}: {got:,.0f}/s vs floor {floor:,.0f}/s "
+              f"(min {limit:,.0f}/s) {verdict}")
     if got < limit:
         failed = True
 trip = overhead > TRACING_OVERHEAD_MAX and consistent
