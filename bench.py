@@ -257,6 +257,61 @@ def _control_plane_probe(duration_s: float = 1.5,
                 pass
 
 
+def _objects_probe(seconds_per_size: float = 1.5) -> dict:
+    """Object-plane throughput: worker-side put+get round trips at
+    64KiB / 1MiB / 16MiB, reported as MiB/s moved (put + get both move
+    the payload). This is the zero-copy object plane's headline row
+    (docs/object_plane.md): with the shm arena attached, the 1MiB+
+    points write/read the node arena in place instead of round-tripping
+    pickles through daemon RPC. Best-effort and bounded: a failure must
+    never cost the benchmark its tokens/s line."""
+    out = {"put_get_64KiB_mbps": 0.0, "put_get_1MiB_mbps": 0.0,
+           "put_get_16MiB_mbps": 0.0}
+    own = False
+    try:
+        import ray_tpu
+
+        own = not ray_tpu.is_initialized()
+        if own:
+            ray_tpu.init(num_nodes=1, resources={"CPU": 4})
+
+        @ray_tpu.remote
+        def _put_get_loop(nbytes, seconds):
+            import time as _time
+
+            import numpy as _np
+
+            import ray_tpu as _rt
+            a = _np.ones(nbytes // 4, dtype=_np.float32)
+            r = _rt.put(a)
+            _rt.get([r])        # warm the path
+            n = 0
+            t0 = _time.perf_counter()
+            while _time.perf_counter() - t0 < seconds:
+                r = _rt.put(a)
+                b = _rt.get([r])[0]
+                assert b.nbytes == nbytes
+                del b, r
+                n += 1
+            return n, _time.perf_counter() - t0
+
+        for size, label in ((64 << 10, "put_get_64KiB_mbps"),
+                            (1 << 20, "put_get_1MiB_mbps"),
+                            (16 << 20, "put_get_16MiB_mbps")):
+            ref = _put_get_loop.remote(size, seconds_per_size)
+            n, dt = ray_tpu.get(ref, timeout=60.0)
+            out[label] = round((n * size * 2) / dt / (1 << 20), 1)
+        return out
+    except Exception:
+        return out
+    finally:
+        if own:
+            try:
+                ray_tpu.shutdown()
+            except Exception:
+                pass
+
+
 def _tracing_overhead_probe() -> float:
     """Tracing overhead on the control-plane loop: balanced-order
     spans-on/spans-off pairs in one cluster, median of per-pair ratios
@@ -352,6 +407,10 @@ def _child() -> int:
             "tracing_overhead_pct": _tracing_overhead_probe(),
             # every section carries the platform stamp so a partial
             # json consumer can't mistake a CPU-fallback row for TPU
+            "platform": result.get("platform", "unknown"),
+            "tpu_fallback": result.get("tpu_fallback", True)}
+        result["objects"] = {
+            **_objects_probe(),
             "platform": result.get("platform", "unknown"),
             "tpu_fallback": result.get("tpu_fallback", True)}
     print(json.dumps(result))

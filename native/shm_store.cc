@@ -8,10 +8,30 @@
 //   - create_request_queue.h    : create backpressure (here: create fails
 //                                 with RTPU_ERR_FULL after eviction fails;
 //                                 the Python layer queues/spills)
+//   - client.cc / fling.cc      : cross-process clients; here the segment
+//                                 is attached BY NAME (rtpu_store_attach)
+//                                 and per-object refcounts live in a slot
+//                                 table INSIDE the segment, so any attached
+//                                 process can pin a buffer against eviction
+//                                 without a store round trip.
+//
+// Segment layout:
+//
+//   [ ArenaHeader: magic | nslots | ExtSlot[NSLOTS] ]  (page aligned)
+//   [ object data region: first-fit allocations ]
+//
+// Each object entry owns one ExtSlot for the lifetime of the entry. The
+// slot's `refs` field is a PROCESS-SHARED refcount mutated with atomic
+// builtins from any process that mapped the segment; `gen` bumps when
+// the slot is recycled so a stale (slot, gen) pair is detectable. The
+// store owner (the daemon) only frees/evicts a buffer when BOTH its
+// in-process refcount and the slot's external refcount are zero — LRU
+// eviction can never unmap bytes a worker still views.
 //
 // Objects are immutable after seal. Clients map the same shm segment and
 // read payloads zero-copy (numpy frombuffer on the offset). A pthread
-// mutex (process-shared when needed) guards the metadata.
+// mutex guards the owner's metadata; attached handles touch only the
+// slot table (atomics) and raw ranges.
 
 #include <cstdint>
 #include <cstring>
@@ -36,6 +56,29 @@ constexpr int RTPU_ERR_EXISTS = -3;
 constexpr int RTPU_ERR_NOT_SEALED = -4;
 constexpr int RTPU_ERR_BAD = -5;
 
+constexpr uint64_t RTPU_MAGIC = 0x314d485355505452ULL;  // "RTPUSHM1"
+constexpr uint32_t RTPU_NSLOTS = 4096;
+constexpr uint32_t RTPU_NO_SLOT = UINT32_MAX;
+
+struct ExtSlot {
+  uint32_t refs;  // process-shared refcount (atomic builtins only)
+  uint32_t gen;   // bumped on slot recycle (forensics; the grant
+                  // protocol never hands out a slot across a recycle:
+                  // the owner only recycles at refs==0 under its
+                  // mutex, and grants ref-before-reply)
+};
+
+struct ArenaHeader {
+  uint64_t magic;
+  uint32_t nslots;
+  uint32_t reserved;
+  ExtSlot slots[RTPU_NSLOTS];
+};
+
+// data region starts page-aligned past the header
+constexpr uint64_t RTPU_DATA_OFF =
+    ((sizeof(ArenaHeader) + 4095) / 4096) * 4096;
+
 struct FreeBlock {
   uint64_t offset;
   uint64_t size;
@@ -48,6 +91,7 @@ struct ObjectEntry {
   bool deleted = false;  // delete requested while refs outstanding
   bool pinned = false;   // creator ref retained; delete() consumes it
   int64_t refcount = 0;
+  uint32_t slot = RTPU_NO_SLOT;  // ext-refcount slot in the header
   uint64_t lru_tick = 0;  // last release time; eviction order
 };
 
@@ -57,10 +101,40 @@ struct Store {
   uint64_t used = 0;
   uint64_t tick = 0;
   int shm_fd = -1;
+  bool attached = false;  // attach-only handle: no metadata, no unlink
   std::string shm_name;
   pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
   std::map<std::string, ObjectEntry> objects;
   std::vector<FreeBlock> free_list;  // sorted by offset, coalesced
+  std::vector<uint8_t> slot_used;    // owner-side slot allocation map
+  uint32_t next_slot = 0;
+
+  ArenaHeader* hdr() const { return reinterpret_cast<ArenaHeader*>(base); }
+
+  uint32_t ext_refs(uint32_t slot) const {
+    if (slot >= RTPU_NSLOTS) return 0;
+    return __atomic_load_n(&hdr()->slots[slot].refs, __ATOMIC_ACQUIRE);
+  }
+
+  uint32_t alloc_slot() {
+    for (uint32_t i = 0; i < RTPU_NSLOTS; ++i) {
+      uint32_t cand = (next_slot + i) % RTPU_NSLOTS;
+      if (!slot_used[cand]) {
+        slot_used[cand] = 1;
+        next_slot = (cand + 1) % RTPU_NSLOTS;
+        return cand;
+      }
+    }
+    return RTPU_NO_SLOT;  // table full: entry gets no ext slot
+  }
+
+  void free_slot(uint32_t slot) {
+    if (slot >= RTPU_NSLOTS) return;
+    // recycle: bump gen (a forensic marker for debugging recycled
+    // slots), then clear usage. refs is 0 by the caller's contract.
+    __atomic_add_fetch(&hdr()->slots[slot].gen, 1, __ATOMIC_RELEASE);
+    slot_used[slot] = 0;
+  }
 
   uint64_t allocate(uint64_t size) {
     // first fit
@@ -98,26 +172,48 @@ struct Store {
     }
   }
 
-  // Evict sealed refcount-0 objects (oldest release first) until
+  void free_entry(std::map<std::string, ObjectEntry>::iterator it) {
+    deallocate(it->second.offset, it->second.size);
+    free_slot(it->second.slot);
+    objects.erase(it);
+  }
+
+  // Reap deleted entries whose last reference (in-process AND external)
+  // is gone: external releases are silent atomic decrements from other
+  // processes, so deferred deletes need this sweep to complete.
+  uint64_t reap() {
+    uint64_t freed = 0;
+    for (auto it = objects.begin(); it != objects.end();) {
+      auto cur = it++;
+      if (cur->second.deleted && cur->second.refcount == 0 &&
+          ext_refs(cur->second.slot) == 0) {
+        freed += cur->second.size;
+        free_entry(cur);
+      }
+    }
+    return freed;
+  }
+
+  // Evict sealed refcount-0 ext-0 objects (oldest release first) until
   // `needed` bytes could be contiguously available or nothing evictable.
   uint64_t evict(uint64_t needed) {
-    uint64_t freed = 0;
+    uint64_t freed = reap();  // deferred deletes first: already dead
     while (true) {
       if (allocatable(needed)) return freed;
       const std::string* victim = nullptr;
       uint64_t best_tick = UINT64_MAX;
       for (auto& kv : objects) {
         if (kv.second.sealed && kv.second.refcount == 0 &&
-            !kv.second.deleted && kv.second.lru_tick < best_tick) {
+            !kv.second.deleted && ext_refs(kv.second.slot) == 0 &&
+            kv.second.lru_tick < best_tick) {
           best_tick = kv.second.lru_tick;
           victim = &kv.first;
         }
       }
       if (victim == nullptr) return freed;
       auto it = objects.find(*victim);
-      deallocate(it->second.offset, it->second.size);
       freed += it->second.size;
-      objects.erase(it);
+      free_entry(it);
     }
   }
 
@@ -132,17 +228,25 @@ struct Store {
 
 extern "C" {
 
+// Create (or re-initialize) the segment as its OWNER: the header is
+// reset and allocations start fresh. `capacity` is the DATA capacity —
+// the segment is sized capacity + header so callers keep their byte
+// accounting. Attach to a live store with rtpu_store_attach instead —
+// opening an existing arena here would wipe its slot table under the
+// owner.
 Store* rtpu_store_open(const char* name, uint64_t capacity) {
+  if (capacity == 0) return nullptr;
+  uint64_t segment = capacity + RTPU_DATA_OFF;
   std::string shm_name = std::string("/") + name;
   int fd = shm_open(shm_name.c_str(), O_CREAT | O_RDWR, 0600);
   if (fd < 0) return nullptr;
-  if (ftruncate(fd, (off_t)capacity) != 0) {
+  if (ftruncate(fd, (off_t)segment) != 0) {
     close(fd);
     shm_unlink(shm_name.c_str());
     return nullptr;
   }
   void* base =
-      mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+      mmap(nullptr, segment, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   if (base == MAP_FAILED) {
     close(fd);
     shm_unlink(shm_name.c_str());
@@ -150,18 +254,62 @@ Store* rtpu_store_open(const char* name, uint64_t capacity) {
   }
   Store* s = new Store();
   s->base = base;
-  s->capacity = capacity;
+  s->capacity = segment;
   s->shm_fd = fd;
   s->shm_name = shm_name;
-  s->free_list.push_back(FreeBlock{0, capacity});
+  s->slot_used.assign(RTPU_NSLOTS, 0);
+  s->free_list.push_back(FreeBlock{RTPU_DATA_OFF, capacity});
+  // header init: zero the slot table, publish the magic LAST (release)
+  // so an attacher that sees the magic sees an initialized table
+  ArenaHeader* h = s->hdr();
+  std::memset(h, 0, sizeof(ArenaHeader));
+  h->nslots = RTPU_NSLOTS;
+  __atomic_store_n(&h->magic, RTPU_MAGIC, __ATOMIC_RELEASE);
   return s;
 }
+
+// Attach an EXISTING segment by name (the fd-passing role of plasma's
+// fling.cc, done via shm_open-by-name): maps read-write (direct puts
+// write payload bytes), touches only raw ranges + the slot table.
+// Returns nullptr when the segment is absent or not an arena.
+Store* rtpu_store_attach(const char* name) {
+  std::string shm_name = std::string("/") + name;
+  int fd = shm_open(shm_name.c_str(), O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (uint64_t)st.st_size <= RTPU_DATA_OFF) {
+    close(fd);
+    return nullptr;
+  }
+  uint64_t capacity = (uint64_t)st.st_size;
+  void* base =
+      mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Store* s = new Store();
+  s->base = base;
+  s->capacity = capacity;
+  s->shm_fd = fd;
+  s->attached = true;
+  s->shm_name = shm_name;
+  if (__atomic_load_n(&s->hdr()->magic, __ATOMIC_ACQUIRE) != RTPU_MAGIC) {
+    munmap(base, capacity);
+    close(fd);
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int rtpu_store_is_attached(Store* s) { return s->attached ? 1 : 0; }
 
 void rtpu_store_close(Store* s, int unlink) {
   if (s == nullptr) return;
   munmap(s->base, s->capacity);
   close(s->shm_fd);
-  if (unlink) shm_unlink(s->shm_name.c_str());
+  if (unlink && !s->attached) shm_unlink(s->shm_name.c_str());
   delete s;
 }
 
@@ -170,12 +318,13 @@ void rtpu_store_close(Store* s, int unlink) {
 // mapping (and Store) are deliberately leaked until process exit so
 // those views stay valid; the name is removed so /dev/shm doesn't leak.
 void rtpu_store_unlink(Store* s) {
-  if (s == nullptr) return;
+  if (s == nullptr || s->attached) return;
   shm_unlink(s->shm_name.c_str());
 }
 
 void* rtpu_store_base(Store* s) { return s->base; }
 uint64_t rtpu_store_capacity(Store* s) { return s->capacity; }
+uint64_t rtpu_store_data_off(void) { return RTPU_DATA_OFF; }
 
 uint64_t rtpu_store_used(Store* s) {
   pthread_mutex_lock(&s->mu);
@@ -198,7 +347,7 @@ int rtpu_create(Store* s, const char* id, uint64_t size, uint64_t* offset) {
     pthread_mutex_unlock(&s->mu);
     return RTPU_ERR_EXISTS;
   }
-  if (size == 0 || size > s->capacity) {
+  if (size == 0 || size > s->capacity - RTPU_DATA_OFF) {
     pthread_mutex_unlock(&s->mu);
     return RTPU_ERR_BAD;
   }
@@ -216,6 +365,7 @@ int rtpu_create(Store* s, const char* id, uint64_t size, uint64_t* offset) {
   e.size = size;
   e.sealed = false;
   e.refcount = 1;  // creator holds a ref until seal+release
+  e.slot = s->alloc_slot();
   s->objects[id] = e;
   *offset = off;
   pthread_mutex_unlock(&s->mu);
@@ -230,6 +380,23 @@ int rtpu_seal(Store* s, const char* id) {
     return RTPU_ERR_NOT_FOUND;
   }
   it->second.sealed = true;
+  pthread_mutex_unlock(&s->mu);
+  return RTPU_OK;
+}
+
+// Entry metadata regardless of seal state (idempotent reserve support:
+// a retried create finds the EXISTS entry and re-reads its offset).
+int rtpu_stat(Store* s, const char* id, uint64_t* offset, uint64_t* size,
+              int* sealed) {
+  pthread_mutex_lock(&s->mu);
+  auto it = s->objects.find(id);
+  if (it == s->objects.end() || it->second.deleted) {
+    pthread_mutex_unlock(&s->mu);
+    return RTPU_ERR_NOT_FOUND;
+  }
+  *offset = it->second.offset;
+  *size = it->second.size;
+  *sealed = it->second.sealed ? 1 : 0;
   pthread_mutex_unlock(&s->mu);
   return RTPU_OK;
 }
@@ -249,7 +416,7 @@ int rtpu_pin(Store* s, const char* id) {
   return RTPU_OK;
 }
 
-// Get a sealed object: increfs and returns offset+size.
+// Get a sealed object: increfs (in-process) and returns offset+size.
 int rtpu_get(Store* s, const char* id, uint64_t* offset, uint64_t* size) {
   pthread_mutex_lock(&s->mu);
   auto it = s->objects.find(id);
@@ -268,6 +435,56 @@ int rtpu_get(Store* s, const char* id, uint64_t* offset, uint64_t* size) {
   return RTPU_OK;
 }
 
+// Get a sealed object for an EXTERNAL (attached-process) reader: the
+// owner increments the object's process-shared slot refcount on the
+// client's behalf and hands back (offset, size, slot). The client reads
+// the range through its own mapping and releases with
+// rtpu_ext_release(slot) — no store round trip on release, and eviction
+// is blocked until the slot count drops to zero.
+int rtpu_ext_get(Store* s, const char* id, uint64_t* offset,
+                 uint64_t* size, uint32_t* slot) {
+  pthread_mutex_lock(&s->mu);
+  auto it = s->objects.find(id);
+  if (it == s->objects.end() || it->second.deleted) {
+    pthread_mutex_unlock(&s->mu);
+    return RTPU_ERR_NOT_FOUND;
+  }
+  if (!it->second.sealed) {
+    pthread_mutex_unlock(&s->mu);
+    return RTPU_ERR_NOT_SEALED;
+  }
+  if (it->second.slot == RTPU_NO_SLOT) {
+    pthread_mutex_unlock(&s->mu);
+    return RTPU_ERR_BAD;  // slot table exhausted: caller takes blob path
+  }
+  __atomic_add_fetch(&s->hdr()->slots[it->second.slot].refs, 1,
+                     __ATOMIC_ACQ_REL);
+  *offset = it->second.offset;
+  *size = it->second.size;
+  *slot = it->second.slot;
+  pthread_mutex_unlock(&s->mu);
+  return RTPU_OK;
+}
+
+// Release an external slot ref; valid from ANY handle (owner or
+// attached) mapping the segment. CAS loop floors at zero so a buggy
+// double-release cannot wrap the count and pin the slot forever.
+void rtpu_ext_release(Store* s, uint32_t slot) {
+  if (slot >= RTPU_NSLOTS) return;
+  uint32_t* p = &s->hdr()->slots[slot].refs;
+  uint32_t cur = __atomic_load_n(p, __ATOMIC_ACQUIRE);
+  while (cur > 0) {
+    if (__atomic_compare_exchange_n(p, &cur, cur - 1, false,
+                                    __ATOMIC_ACQ_REL, __ATOMIC_ACQUIRE))
+      return;
+  }
+}
+
+uint32_t rtpu_ext_refs(Store* s, uint32_t slot) {
+  if (slot >= RTPU_NSLOTS) return 0;
+  return __atomic_load_n(&s->hdr()->slots[slot].refs, __ATOMIC_ACQUIRE);
+}
+
 int rtpu_release(Store* s, const char* id) {
   pthread_mutex_lock(&s->mu);
   auto it = s->objects.find(id);
@@ -277,10 +494,11 @@ int rtpu_release(Store* s, const char* id) {
   }
   if (it->second.refcount > 0) it->second.refcount--;
   it->second.lru_tick = ++s->tick;
-  if (it->second.deleted && it->second.refcount == 0) {
+  if (it->second.deleted && it->second.refcount == 0 &&
+      s->ext_refs(it->second.slot) == 0) {
     // Deferred delete: last outstanding reader is gone, free now.
-    s->deallocate(it->second.offset, it->second.size);
-    s->objects.erase(it);
+    // (An external ref still held leaves it for reap()/evict().)
+    s->free_entry(it);
   }
   pthread_mutex_unlock(&s->mu);
   return RTPU_OK;
@@ -296,10 +514,10 @@ int rtpu_contains(Store* s, const char* id) {
 }
 
 // Delete: the owner decided the object is dead. If readers still hold
-// refs the buffer is only MARKED deleted and the deallocation happens at
-// the last release (plasma semantics: clients' zero-copy buffers stay
-// valid for their lifetime; the object just becomes unreachable for new
-// gets).
+// refs (in-process or external) the buffer is only MARKED deleted and
+// the deallocation happens at the last release / next reap (plasma
+// semantics: clients' zero-copy buffers stay valid for their lifetime;
+// the object just becomes unreachable for new gets).
 int rtpu_delete(Store* s, const char* id) {
   pthread_mutex_lock(&s->mu);
   auto it = s->objects.find(id);
@@ -313,11 +531,10 @@ int rtpu_delete(Store* s, const char* id) {
     it->second.pinned = false;
     if (it->second.refcount > 0) it->second.refcount--;
   }
-  if (it->second.refcount > 0) {
+  if (it->second.refcount > 0 || s->ext_refs(it->second.slot) > 0) {
     it->second.deleted = true;
   } else {
-    s->deallocate(it->second.offset, it->second.size);
-    s->objects.erase(it);
+    s->free_entry(it);
   }
   pthread_mutex_unlock(&s->mu);
   return RTPU_OK;
@@ -326,6 +543,13 @@ int rtpu_delete(Store* s, const char* id) {
 uint64_t rtpu_evict_bytes(Store* s, uint64_t needed) {
   pthread_mutex_lock(&s->mu);
   uint64_t freed = s->evict(needed);
+  pthread_mutex_unlock(&s->mu);
+  return freed;
+}
+
+uint64_t rtpu_reap(Store* s) {
+  pthread_mutex_lock(&s->mu);
+  uint64_t freed = s->reap();
   pthread_mutex_unlock(&s->mu);
   return freed;
 }

@@ -84,33 +84,74 @@ def _spawn(module: str, args: List[str],
 
 
 class ArenaCache:
-    """Same-host attach to daemon shm arenas by name (zero-copy reads)."""
+    """Same-host attach to daemon shm arenas by name (zero-copy reads,
+    direct-put writes, and shared-slot ref releases). Attach-only: a
+    missing segment (remote host, no native build) caches as failed and
+    every object falls back to the RPC byte path."""
 
     def __init__(self):
         self._arenas: Dict[str, Any] = {}  #: guarded by self._lock
+        self._failed: set = set()          #: guarded by self._lock
         self._lock = tracked_lock("cluster.arena_cache", reentrant=False)
+
+    def handle(self, arena: str):
+        """Attached ShmObjectStore for ``arena``, or None."""
+        with self._lock:
+            store = self._arenas.get(arena)
+            if store is not None:
+                return store
+            if arena in self._failed:
+                return None
+            try:
+                from ray_tpu.native_store import ShmObjectStore
+                store = ShmObjectStore.attach(arena)
+            except Exception:
+                self._failed.add(arena)
+                return None
+            self._arenas[arena] = store
+            return store
 
     def read(self, arena: str, capacity: int, off: int,
              size: int) -> Optional[memoryview]:
-        try:
-            from ray_tpu.native_store import ShmObjectStore
-        except Exception:
+        store = self.handle(arena)
+        if store is None:
             return None
-        with self._lock:
-            store = self._arenas.get(arena)
-            if store is None:
-                try:
-                    store = ShmObjectStore(arena, capacity)
-                except Exception:
-                    return None
-                self._arenas[arena] = store
         return store.read_range(off, size)
 
+    def write(self, arena: str, off: int, payload) -> bool:
+        """Fill a daemon-reserved (unsealed) range in place — the
+        direct-put payload write; the bytes never ride an RPC frame."""
+        store = self.handle(arena)
+        if store is None:
+            return False
+        try:
+            store.write_range(off, payload)
+            return True
+        except Exception:
+            return False
+
+    def ext_release(self, arena: str, slot: int) -> bool:
+        """Drop a shared-slot object ref through the local mapping (the
+        zero-RPC release leg of the ref/release protocol)."""
+        store = self.handle(arena)
+        if store is None:
+            return False
+        try:
+            store.ext_release(slot)
+            return True
+        except Exception:
+            return False
+
     def close(self) -> None:
+        # Deliberate leak, not munmap: zero-copy views handed to user
+        # code may outlive this cluster session, and their finalizers
+        # must find a mapping (and a live handle) — see
+        # ShmObjectStore.detach_leak. The daemon owns the segment name;
+        # nothing here keeps /dev/shm entries alive.
         with self._lock:
             for store in self._arenas.values():
                 try:
-                    store.close(unlink=False)
+                    store.detach_leak()
                 except Exception:
                     pass
             self._arenas.clear()
@@ -404,6 +445,10 @@ class DaemonHandle:
         self.on_actor_worker_died = None  # set by the backend
         self.client = Client(addr, timeout=None, on_push=self._on_push)
         self.dead = False
+        # zero-copy object plane (set from the hello reply)
+        self.objectplane = False
+        self.arena_name: Optional[str] = None
+        self.arena_capacity = 0
         # fast lane: direct submit to the daemon's native (C++) core
         self.fast_port: Optional[int] = None
         self._fast = None
@@ -573,6 +618,13 @@ class DaemonHandle:
                          job_id=cloudpickle.dumps(job_id),
                          namespace=namespace, sys_path=sys_path)
         self.fast_port = out.get("fast_port")
+        # zero-copy object plane: the daemon's arena, attachable by
+        # name when we share its host (direct puts + slot-ref'd gets)
+        from ray_tpu._private.config import cfg as _cfg
+        self.objectplane = (bool(out.get("objectplane"))
+                            and bool(_cfg().objectplane_attach))
+        self.arena_name = out.get("arena")
+        self.arena_capacity = int(out.get("arena_capacity") or 0)
         # protocol feature flag: daemons that understand push_task_batch
         # advertise it; anything older gets the per-task wire protocol
         from ray_tpu._private.config import cfg
@@ -1003,8 +1055,32 @@ class DaemonHandle:
             pass
 
     # -- object plane -----------------------------------------------------
+    def _release_shm_grant(self, oid: bytes, out: Dict[str, Any]) -> None:
+        """Drop the ref a get_object shm reply granted us: slot grants
+        release through the local mapping (one atomic, zero RPC); the
+        legacy internal-ref grant — or a slot we failed to map — falls
+        back to the release_object RPC."""
+        slot = out.get("slot")
+        if slot is not None:
+            if self.arenas.ext_release(out["shm"], slot):
+                return
+            try:
+                self.client.call("release_object", oid=oid, slot=slot,
+                                 timeout=5.0)
+            except rpc.RpcError:
+                pass
+            return
+        try:
+            self.client.call("release_object", oid=oid, timeout=5.0)
+        except rpc.RpcError:
+            pass
+
     def get_object_blob(self, oid: bytes) -> Optional[bytes]:
-        out = self._call("get_object", oid=oid, prefer_shm=True)
+        # slot_ok: this client understands ext-slot grants (releases
+        # through the mapping, or release_object{slot} on attach
+        # failure) — daemons withhold slots from clients that don't
+        out = self._call("get_object", oid=oid, prefer_shm=True,
+                         slot_ok=True)
         if out.get("missing"):
             return None
         if "shm" in out and out.get("shm"):
@@ -1017,12 +1093,72 @@ class DaemonHandle:
                 out2 = self._call("get_object", oid=oid, prefer_shm=False)
                 return None if out2.get("missing") else out2["blob"]
             finally:
-                try:
-                    self.client.call("release_object", oid=oid,
-                                     timeout=5.0)
-                except rpc.RpcError:
-                    pass
+                self._release_shm_grant(oid, out)
         return out["blob"]
+
+    def get_object_view(self, oid: bytes, dtype, shape):
+        """Zero-copy read-only numpy view of a RAW-tier arena entry on
+        the same host: the daemon grants a shared-slot ref, we map the
+        range with np.frombuffer, and a finalizer drops the ref — no
+        payload bytes cross any wire, no serialization at all. None →
+        caller takes the blob path (remote host, attach failure, or a
+        daemon without the slot protocol)."""
+        import numpy as np
+        out = self._call("get_object", oid=oid, prefer_shm=True,
+                         slot_ok=True)
+        if out.get("missing") or not out.get("shm"):
+            return None
+        if out.get("slot") is None:
+            self._release_shm_grant(oid, out)   # legacy internal ref
+            return None
+        handle = self.arenas.handle(out["shm"])
+        if handle is None:
+            self._release_shm_grant(oid, out)
+            return None
+        try:
+            base = handle.view_range(out["off"], out["size"])
+        except Exception:
+            self._release_shm_grant(oid, out)   # never pin on failure
+            return None
+        import weakref
+        # finalizer on the BASE frombuffer array: numpy collapses base
+        # chains, so a slice of the reshaped result bases on `base` —
+        # releasing on the derived array's death would drop the slot
+        # ref while sub-views still map the bytes
+        weakref.finalize(base, _ext_release_quiet, handle, out["slot"])
+        arr = base.view(np.dtype(dtype))
+        if shape is not None:
+            arr = arr.reshape(tuple(shape))
+        return arr
+
+    def arena_reserve(self, key: bytes, size: int
+                      ) -> Optional[Dict[str, Any]]:
+        """Reserve arena space for a direct put; {off, arena} or None
+        (no arena / full — caller falls back to the blob RPC)."""
+        try:
+            out = self._call("create_object", oid=key, size=size)
+        except (DaemonCrashed, rpc.RemoteError):
+            return None
+        if not out.get("ok"):
+            return None
+        return out
+
+    def arena_seal(self, key: bytes, ref: bytes, raw,
+                   nbytes: int) -> bool:
+        try:
+            out = self._call("seal_object", oid=key, ref=ref,
+                             raw=list(raw) if raw else None,
+                             nbytes=nbytes)
+        except (DaemonCrashed, rpc.RemoteError):
+            return False
+        return bool(out.get("ok"))
+
+    def push_object(self, oid: bytes, to_addr,
+                    ref: bytes = b"") -> Dict[str, Any]:
+        """Proactive push of a local object to a peer daemon (sender
+        side runs the PushManager: chunked, deduped, directory-aware)."""
+        return self._call("push_object", oid=oid, to_addr=list(to_addr),
+                          ref=ref)
 
     def put_object_blob(self, oid: bytes, blob: bytes) -> None:
         self._call("put_object", oid=oid, blob=blob)
@@ -1086,6 +1222,15 @@ class DaemonHandle:
         self.client.close()
 
 
+def _ext_release_quiet(handle, slot: int) -> None:
+    """Finalizer for zero-copy driver-side views: drop the shared-slot
+    ref through the local mapping (must never raise)."""
+    try:
+        handle.ext_release(slot)
+    except Exception:
+        pass
+
+
 def out_is_final(out) -> bool:
     return out is None or out.get("outcome") != "gen"
 
@@ -1134,34 +1279,129 @@ class RemoteActorInstance:
 
 class RemoteStore:
     """Store facade for a RemoteNode: values live in the daemon's object
-    table; the driver keeps a metadata mirror (ids + sizes) and fetches
-    on demand (RPC bytes, or zero-copy shm range on the same host)."""
+    table; the driver keeps a metadata mirror (key, size, tier, raw
+    dtype/shape) and fetches on demand. Same-host paths are zero-copy:
+    large puts reserve + mmap-write + seal arena space (the payload
+    never rides an RPC frame), and RAW-tier gets return read-only
+    ``np.frombuffer`` views pinned by shared-slot refs."""
 
     def __init__(self, daemon: DaemonHandle):
+        from ray_tpu.objectplane.tiers import TierAccounting
         self.daemon = daemon
-        #: guarded by self._lock
-        self._meta: Dict[Any, Tuple[bytes, int]] = {}  # ObjectID -> (key, n)
+        # ObjectID -> (key, nbytes, tier, raw|None)
+        self._meta: Dict[Any, tuple] = {}  #: guarded by self._lock
         self._lock = tracked_lock("cluster.remote_store", reentrant=False)
+        # UNCHAINED ledger: this store is a metadata MIRROR — the bytes
+        # live in the daemon's arena, and the daemon already publishes
+        # that occupancy to the gauge each heartbeat. Chaining here
+        # would double-count every daemon-held object in federated sums.
+        self.tiers = TierAccounting()
+        self.stats = {"gets": 0, "puts": 0, "direct_puts": 0,
+                      "zero_copy_gets": 0}
 
     def register_remote(self, object_id, daemon_key: bytes,
-                        nbytes: int) -> None:
+                        nbytes: int, raw=None,
+                        tier: Optional[str] = None) -> None:
+        from ray_tpu.objectplane.tiers import TIER_HOST
+        tier = tier or TIER_HOST
+        raw = tuple(raw) if raw else None
         with self._lock:
-            self._meta[object_id] = (daemon_key, nbytes)
+            prev = self._meta.get(object_id)
+            self._meta[object_id] = (daemon_key, nbytes, tier, raw)
+        if prev is None:
+            self.tiers.add(tier, nbytes)
 
     def put(self, object_id, value, nbytes: int = 0) -> None:
+        key = b"put:" + object_id.binary()
+        if self._direct_put_raw(object_id, key, value):
+            return
         from ray_tpu._private.device_objects import wire_dumps
         blob = wire_dumps(value)
-        key = b"put:" + object_id.binary()
+        if self._direct_put_blob(object_id, key, blob):
+            return
         self.daemon.put_object_blob(key, blob)
-        with self._lock:
-            self._meta[object_id] = (key, len(blob))
+        self.register_remote(object_id, key, len(blob))
+        self.stats["puts"] += 1
+
+    # -- direct put (same-host zero-RPC-payload path) --------------------
+    def _direct_put_raw(self, object_id, key: bytes, value) -> bool:
+        """Large contiguous numpy arrays store as RAW arena bytes: the
+        payload is written through the driver's own mapping and
+        consumers (driver or attached workers) frombuffer it back with
+        zero serialization."""
+        if not getattr(self.daemon, "objectplane", False):
+            return False
+        from ray_tpu.objectplane.tiers import raw_put_eligible
+        raw = raw_put_eligible(value)
+        if raw is None:
+            return False
+        return self._arena_put(object_id, key,
+                               memoryview(value).cast("B"), raw)
+
+    def _direct_put_blob(self, object_id, key: bytes,
+                         blob: bytes) -> bool:
+        """Large pickled payloads still skip the RPC frame: the blob is
+        mmap-written in place; only reserve+seal metadata travels."""
+        if not getattr(self.daemon, "objectplane", False):
+            return False
+        from ray_tpu._private.config import cfg
+        if len(blob) < int(cfg().direct_put_min_bytes):
+            return False
+        return self._arena_put(object_id, key, blob, None)
+
+    def _arena_put(self, object_id, key: bytes, payload, raw) -> bool:
+        size = (payload.nbytes if isinstance(payload, memoryview)
+                else len(payload))
+        out = self.daemon.arena_reserve(key, size)
+        if out is None:
+            return False    # arena full / no native store: blob path
+        if not self.daemon.arenas.write(out["arena"], out["off"],
+                                        payload):
+            # we cannot map the arena (different host / no native
+            # build): stop attempting direct puts on this handle and
+            # abort the reserve
+            self.daemon.objectplane = False
+            self.daemon.free_objects([key])
+            return False
+        if not self.daemon.arena_seal(key, object_id.binary(), raw,
+                                      size):
+            self.daemon.free_objects([key])
+            return False
+        self.register_remote(object_id, key, size, raw=raw)
+        self.stats["puts"] += 1
+        self.stats["direct_puts"] += 1
+        return True
 
     def get(self, object_id):
         with self._lock:
             entry = self._meta.get(object_id)
         if entry is None:
             raise KeyError(object_id)
-        blob = self.daemon.get_object_blob(entry[0])
+        key, nbytes, tier, raw = entry
+        self.stats["gets"] += 1
+        if raw is not None:
+            # only attempt the view when the arena is actually mappable
+            # (attach failures cache): a remote-host driver would
+            # otherwise pay grant + release + re-request round trips
+            # per get before reaching the blob path
+            attachable = (self.daemon.arena_name is not None
+                          and self.daemon.arenas.handle(
+                              self.daemon.arena_name) is not None)
+            arr = (self.daemon.get_object_view(key, raw[0], raw[1])
+                   if attachable else None)
+            if arr is not None:
+                self.stats["zero_copy_gets"] += 1
+                from ray_tpu.objectplane.tiers import count_zero_copy_get
+                count_zero_copy_get()
+                return arr
+            # remote host / attach failure: raw bytes over RPC
+            import numpy as np
+            blob = self.daemon.get_object_blob(key)
+            if blob is None:
+                raise KeyError(object_id)
+            return np.frombuffer(blob, dtype=np.dtype(raw[0])).reshape(
+                tuple(raw[1]))
+        blob = self.daemon.get_object_blob(key)
         if blob is None:
             raise KeyError(object_id)
         return cloudpickle.loads(blob)
@@ -1173,7 +1413,10 @@ class RemoteStore:
     def delete(self, object_id) -> None:
         with self._lock:
             entry = self._meta.pop(object_id, None)
-        if entry is not None and not self.daemon.dead:
+        if entry is None:
+            return
+        self.tiers.add(entry[2], -entry[1])
+        if not self.daemon.dead:
             # coalesced: the zero-ref callback fires once per object,
             # but the wire sees size/time-bounded free_objects batches
             self.daemon.queue_free(entry[0])
@@ -1187,28 +1430,31 @@ class RemoteStore:
             entry = self._meta.get(object_id)
         return entry[1] if entry else 0
 
-    def meta_of(self, object_id) -> Tuple[bytes, int]:
-        """(daemon store key, nbytes) — the handle a peer daemon needs
-        to pull this object directly (drain migration path)."""
+    def meta_of(self, object_id) -> Tuple[bytes, int, Any]:
+        """(daemon store key, nbytes, raw dtype/shape|None) — the handle
+        a peer daemon needs to transfer this object directly (push
+        prefetch / drain migration)."""
         with self._lock:
-            return self._meta[object_id]
+            key, nbytes, _tier, raw = self._meta[object_id]
+        return key, nbytes, raw
 
     def has_daemon_key(self, daemon_key: bytes) -> bool:
         """Directory support: does this node hold the given store key?"""
         with self._lock:
-            return any(k == daemon_key for k, _ in self._meta.values())
+            return any(e[0] == daemon_key for e in self._meta.values())
 
     def used_bytes(self) -> int:
         with self._lock:
-            return sum(n for _, n in self._meta.values())
+            return sum(e[1] for e in self._meta.values())
+
+    def tier_bytes(self) -> Dict[str, int]:
+        """Occupancy by (host-shm | device-HBM | spilled) tier."""
+        return self.tiers.snapshot()
 
     def close(self) -> None:
         with self._lock:
             self._meta.clear()
-
-    @property
-    def stats(self):
-        return {"gets": 0, "puts": 0}
+        self.tiers.clear()
 
 
 class _OwnerHolder:

@@ -81,7 +81,24 @@ FLAG_DEFS = [
     Flag("native_store", bool, True, "use the C++ shm arena for large "
          "objects (False = pure-dict store)"),
     Flag("pull_chunk", int, 4 << 20, "inter-daemon object transfer chunk "
-         "size in bytes (object_buffer_pool role)"),
+         "size in bytes (object_buffer_pool role; push and pull share it)"),
+    Flag("objectplane_attach", bool, True, "workers (and the same-host "
+         "driver) map the node daemon's shm arena and resolve host-tier "
+         "objects zero-copy with shared-slot ref/release; False = the "
+         "classic per-RPC object path (docs/object_plane.md)"),
+    Flag("direct_put_min_bytes", int, 256 * 1024, "puts at/above this "
+         "size reserve+write+seal arena space directly — the payload "
+         "never rides an RPC/pipe frame; smaller puts stay classic"),
+    Flag("raw_tier_min_bytes", int, 64 * 1024, "contiguous numpy arrays "
+         "at/above this size store as RAW arena bytes; same-node "
+         "consumers get read-only np.frombuffer views with zero "
+         "serialization. COUPLED to direct_put_min_bytes: the raw path "
+         "rides direct puts, so the effective gate is "
+         "max(raw_tier_min_bytes, direct_put_min_bytes) — lower both "
+         "to widen zero-copy coverage"),
+    Flag("push_prefetch", bool, True, "proactively push task deps to "
+         "the consumer's node at dispatch (PushManager: in-flight + "
+         "directory + pull dedupe); False = pull-only transfer"),
     Flag("inline_object_size", int, 100 * 1024, "values <= this inline in "
          "the owner memory store (max_direct_call_object_size role)"),
     # -- memory monitor / OOM defense --

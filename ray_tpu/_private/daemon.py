@@ -76,6 +76,14 @@ declare("get_object_chunk", "oid", "off", "size")
 declare("release_object", "oid")
 declare("free_objects", "oids")
 declare("pull_object", "oid", "from_addr", "priority")
+# zero-copy object plane (docs/object_plane.md): reserve+seal let a
+# same-host client write the payload straight into the arena (only
+# metadata rides the wire); push_object/push_chunk are the proactive
+# daemon->daemon transfer direction (PushManager)
+declare("create_object", "oid", "size")
+declare("seal_object", "oid", "ref", "raw", "nbytes")
+declare("push_object", "oid", "to_addr", "ref")
+declare("push_chunk", "oid", "off", "total", "blob", "ref", "raw")
 declare("daemon_ping")
 # cross-language tier (C++ clients): names resolve through the head KV,
 # args/results are plain msgpack values — no Python pickles cross the
@@ -188,12 +196,30 @@ class PreemptionWatcher:
 # ---------------------------------------------------------------------------
 
 class ObjectTable:
-    def __init__(self, arena_name: str, capacity: int):
+    def __init__(self, arena_name: str, capacity: int,
+                 sweep: bool = True):
         self._small: Dict[bytes, bytes] = {}  #: guarded by self._lock
         self._lock = tracked_lock("daemon.object_table", reentrant=False)
         self.arena_name = arena_name
         self.capacity = capacity
+        # logical ObjectID binary -> daemon store key: lets same-node
+        # consumers (attached workers) resolve a ray_tpu ref without
+        # the owner round trip (the node-local slice of the object
+        # directory); raw-tier entries carry (dtype, shape) so views
+        # need no unpickle at all
+        self._by_oid: Dict[bytes, bytes] = {}   #: guarded by self._lock
+        self._ref_of: Dict[bytes, bytes] = {}   #: guarded by self._lock
+        self._raw: Dict[bytes, Any] = {}        #: guarded by self._lock
         self._shm = None
+        if sweep:
+            # stale-segment hygiene: a SIGKILL'd predecessor daemon of
+            # this node never unlinked its arena — reap it before
+            # creating ours (same name => same node)
+            try:
+                from ray_tpu.objectplane.arena import sweep_stale_segments
+                sweep_stale_segments(arena_name)
+            except Exception:
+                pass
         try:
             from ray_tpu.native_store import ShmObjectStore
 
@@ -239,12 +265,90 @@ class ObjectTable:
             return None
         return (self.arena_name, self.capacity, off, size)
 
+    def get_ext_meta(self, oid: bytes):
+        """(arena, capacity, off, size, slot) with the object's
+        PROCESS-SHARED slot refcount incremented on the client's behalf
+        (the client reads through its own mapping and drops the ref with
+        a local atomic — no release round trip), or None."""
+        if self._shm is None:
+            return None
+        try:
+            off, size, slot = self._shm.get_ext(oid)
+        except Exception:
+            return None
+        return (self.arena_name, self.capacity, off, size, slot)
+
+    def ext_release(self, slot: int) -> None:
+        if self._shm is not None:
+            try:
+                self._shm.ext_release(slot)
+            except Exception:
+                pass
+
     def release(self, oid: bytes) -> None:
         if self._shm is not None:
             try:
                 self._shm.release(oid)
             except Exception:
                 pass
+
+    # -- oid index (node-local object directory slice) -------------------
+    def register_oid(self, ref: bytes, key: bytes, raw=None) -> None:
+        if not ref:
+            return
+        with self._lock:
+            self._by_oid[ref] = key
+            self._ref_of[key] = ref
+            if raw is not None:
+                self._raw[key] = raw
+
+    def key_for(self, ref: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._by_oid.get(ref)
+
+    def raw_for(self, key: bytes):
+        with self._lock:
+            return self._raw.get(key)
+
+    # -- direct-put (reserve + client write + seal) ----------------------
+    def reserve(self, key: bytes, size: int) -> Optional[int]:
+        """Reserve arena space for a client-side write; None = no arena
+        or no room (caller falls back to the blob path). Idempotent for
+        a retried reserve of the same (key, size)."""
+        if self._shm is None:
+            return None
+        from ray_tpu.native_store import ShmStoreFull
+        try:
+            return self._shm.reserve(key, size)
+        except (ShmStoreFull, KeyError):
+            return None
+
+    def seal(self, key: bytes, ref: bytes = b"", raw=None) -> bool:
+        """Seal a reserved entry (idempotent; pin matches put(pin=True)
+        so this layer's refcounting owns lifetime)."""
+        if self._shm is None:
+            return False
+        try:
+            self._shm.seal(key, pin=True)
+        except KeyError:
+            return False
+        self.register_oid(ref, key, raw=raw)
+        return True
+
+    def abort_reserve(self, key: bytes) -> None:
+        """Drop a reserved-but-never-sealed entry (failed direct put)."""
+        self.delete(key)
+
+    def reap(self) -> int:
+        """Free deferred-deleted entries whose external (attached-
+        process) refs have dropped; external releases are silent atomic
+        decrements, so the owner sweeps periodically."""
+        if self._shm is None:
+            return 0
+        try:
+            return self._shm.reap()
+        except Exception:
+            return 0
 
     def contains(self, oid: bytes) -> bool:
         with self._lock:
@@ -288,8 +392,21 @@ class ObjectTable:
     def delete(self, oid: bytes) -> None:
         with self._lock:
             self._small.pop(oid, None)
+            self._raw.pop(oid, None)
+            ref = self._ref_of.pop(oid, None)
+            if ref is not None:
+                self._by_oid.pop(ref, None)
         if self._shm is not None:
             try:
+                # an aborted direct put leaves an UNSEALED entry whose
+                # creator ref was never pinned/released — drop it first
+                # or the delete defers forever
+                try:
+                    _off, _size, sealed = self._shm.stat(oid)
+                    if not sealed:
+                        self._shm.release(oid)
+                except KeyError:
+                    pass
                 self._shm.delete(oid)
             except Exception:
                 pass
@@ -649,6 +766,13 @@ class DaemonRuntime:
         the heartbeat loop flushes it to the head)."""
         return self.service.task_events
 
+    def shm_ops(self, call: str, kw: Dict[str, Any]):
+        """Daemon-LOCAL object-plane ops for this daemon's workers
+        (never forwarded to the owner): meta resolution for zero-copy
+        gets, reserve/seal/abort for direct puts. The worker side only
+        issues these once its arena attach succeeded."""
+        return self.service.handle_worker_shm_op(call, kw)
+
     def forward_core_op(self, msg: Dict[str, Any]) -> Tuple[bool, bytes]:
         owner = self.service.owner
         if owner is None:
@@ -682,6 +806,14 @@ class DaemonService:
         self.persist = persist
         self.objects = ObjectTable(f"rtpu_{node_id_hex[:12]}",
                                    object_store_bytes)
+        # Hand the arena to every worker this daemon spawns (the
+        # worker-hello leg of the zero-copy plane): workers attach the
+        # segment by name and resolve host-tier objects in place.
+        from ray_tpu._private.config import cfg as _cfg
+        if self.objects._shm is not None and _cfg().objectplane_attach:
+            from ray_tpu._private import worker_process as _wp
+            _wp.set_arena_info(self.objects.arena_name,
+                               self.objects._shm.capacity())
         self.owner: Optional[Client] = None
         self.driver_conn: Optional[Connection] = None
         # per-process span buffer (task_event_buffer.cc role): daemon
@@ -731,6 +863,13 @@ class DaemonService:
         from ray_tpu._private.thread_pool import DaemonThreadPool
         self._task_pool = DaemonThreadPool(1024, name="daemon-task")
         self.pulls = PullManager(self.objects, self._peer)
+        # proactive node-to-node transfer (the push direction; dedupes
+        # in flight, against the owner's directory, and against pulls)
+        from ray_tpu.objectplane.push import PushManager, PushReceiver
+        self.pushes = PushManager(self.objects, self._peer,
+                                  locate_fn=self._locate_via_owner)
+        self.push_rx = PushReceiver(self.objects,
+                                    register_oid=self.objects.register_oid)
         # Native daemon core (native/daemon_core.cc): the C++ event loop
         # that owns the plain-task hot path — drivers submit straight to
         # it, it leases a dedicated worker, forwards the payload, routes
@@ -889,7 +1028,13 @@ class DaemonService:
                 # otherwise) and coalesced completion delivery for
                 # classic submit_task calls (via_pump)
                 "batch": True,
-                "result_batch": True}
+                "result_batch": True,
+                # zero-copy object plane: same-host clients attach this
+                # arena by name for direct puts / slot-ref'd gets
+                "objectplane": self.objects._shm is not None,
+                "arena": self.objects.arena_name,
+                "arena_capacity": (self.objects._shm.capacity()
+                                   if self.objects._shm else 0)}
 
     def notify_driver(self, kind: str, **kw) -> None:
         conn = self.driver_conn
@@ -1021,6 +1166,16 @@ class DaemonService:
             if ok and len(blob) > INLINE_RESULT:
                 oid = b"res:" + spec.task_id.binary()
                 self.objects.put(oid, blob)
+                n = spec.num_returns
+                if spec.return_ids and (n == 1 or not isinstance(n, int)):
+                    # node-local oid index: same-node attached workers
+                    # resolve this result without the owner round trip.
+                    # Multi-return (int n > 1) blobs hold the WHOLE
+                    # tuple — the driver fetches once and splits
+                    # (worker.py stored path); indexing ref0 here would
+                    # hand consumers the tuple as ref0's value
+                    self.objects.register_oid(
+                        spec.return_ids[0].binary(), oid)
                 conn.reply(rid, outcome="stored", oid=oid,
                            nbytes=len(blob))
             else:
@@ -1395,12 +1550,87 @@ class DaemonService:
         return {"ok": True}
 
     # -- object plane -----------------------------------------------------
+    def handle_worker_shm_op(self, call: str, kw: Dict[str, Any]):
+        """Object-plane ops from this daemon's OWN workers, served over
+        the worker pipe without touching the owner (the zero-copy
+        protocol's metadata leg — payloads never ride the pipe)."""
+        obj = self.objects
+        if call == "shm_get_meta":
+            out = []
+            for oid in kw["oids"]:
+                entry = None
+                key = obj.key_for(oid)
+                if key is not None:
+                    meta = obj.get_ext_meta(key)    # increfs ext slot
+                    if meta is not None:
+                        arena, cap, off, size, slot = meta
+                        entry = {"arena": arena, "capacity": cap,
+                                 "off": off, "size": size, "slot": slot,
+                                 "raw": obj.raw_for(key)}
+                out.append(entry)
+            return out
+        if call == "shm_release":
+            for slot in kw.get("slots", ()):
+                obj.ext_release(slot)
+            return True
+        if call == "shm_put_reserve":
+            off = obj.reserve(kw["key"], int(kw["size"]))
+            if off is None:
+                return {"full": True}
+            return {"off": off}
+        if call == "shm_put_seal":
+            return {"ok": obj.seal(kw["key"], ref=kw.get("ref") or b"",
+                                   raw=kw.get("raw"))}
+        if call == "shm_put_abort":
+            obj.abort_reserve(kw["key"])
+            return {"ok": True}
+        raise ValueError(f"unknown shm op {call!r}")
+
     def handle_put_object(self, conn, rid, msg):
         self.objects.put(msg["oid"], msg["blob"])
+        key = msg["oid"]
+        if key.startswith(b"put:"):
+            # driver puts key by logical oid: index it so same-node
+            # attached workers resolve the ref without the owner
+            self.objects.register_oid(key[4:], key)
         return {"ok": True}
+
+    def handle_create_object(self, conn, rid, msg):
+        """Reserve arena space for a same-host client's direct put (the
+        client writes the payload through its own mapping, then
+        seal_object). Idempotent for a retried (oid, size)."""
+        off = self.objects.reserve(msg["oid"], int(msg["size"]))
+        if off is None:
+            return {"full": True}
+        return {"ok": True, "off": off, "arena": self.objects.arena_name,
+                "capacity": (self.objects._shm.capacity()
+                             if self.objects._shm else 0)}
+
+    def handle_seal_object(self, conn, rid, msg):
+        """Seal a direct-put entry (idempotent retry target: a dropped
+        seal reply just re-seals). ``ref``/``raw`` feed the node-local
+        oid index so attached workers resolve the object zero-copy."""
+        raw = msg.get("raw")
+        ok = self.objects.seal(msg["oid"], ref=msg.get("ref") or b"",
+                               raw=tuple(raw) if raw else None)
+        return {"ok": ok}
 
     def handle_get_object(self, conn, rid, msg):
         if msg["prefer_shm"]:
+            # ext-slot grants only to callers that ADVERTISE the slot
+            # protocol (slot_ok) — an older driver would release via
+            # release_object(oid), which decrements the entry's PIN
+            # ref (corrupting ownership) and leaks the slot ref
+            meta = (self.objects.get_ext_meta(msg["oid"])
+                    if msg.get("slot_ok") else None)
+            if meta is not None:
+                # ext slot ref taken on the caller's behalf: the caller
+                # reads through its own mapping and drops the ref with
+                # a local atomic (or release_object{slot} if its attach
+                # failed) — no payload round trip, no release RPC
+                arena, cap, off, size, slot = meta
+                return {"shm": arena, "capacity": cap, "off": off,
+                        "size": size, "slot": slot}
             ref = self.objects.get_shm_ref(msg["oid"])
             if ref is not None:
                 arena, cap, off, size = ref
@@ -1412,6 +1642,10 @@ class DaemonService:
         return {"blob": blob}
 
     def handle_release_object(self, conn, rid, msg):
+        if msg.get("slot") is not None:
+            # ext-slot release fallback (client could not attach)
+            self.objects.ext_release(int(msg["slot"]))
+            return {"ok": True}
         self.objects.release(msg["oid"])
         return {"ok": True}
 
@@ -1453,6 +1687,29 @@ class DaemonService:
                 last = ({"ok": False, "missing": True} if pull.missing
                         else {"ok": False, "error": pull.error})
         return last or {"ok": False, "missing": True}
+
+    @rpc.concurrent
+    def handle_push_object(self, conn, rid, msg):
+        """Driver-directed proactive push of a local object to a peer
+        daemon (dep prefetch, drain migration). Dedupes in flight and
+        against copies the destination already holds; ``ref`` carries
+        the logical ObjectID so the receiver's node-local index lets
+        its attached workers resolve the pushed copy zero-copy."""
+        push = self.pushes.request(msg["oid"], tuple(msg["to_addr"]),
+                                   ref=msg.get("ref") or b"")
+        if not push.event.wait(timeout=120.0):
+            return {"ok": False, "error": "push timed out"}
+        if push.ok:
+            return {"ok": True, "skipped": push.skipped}
+        return {"ok": False, "error": push.error}
+
+    def handle_push_chunk(self, conn, rid, msg):
+        """Receiver side of a proactive push: chunks assemble into one
+        buffer; ``have`` tells the sender to stop (a pull landed it)."""
+        return self.push_rx.chunk(msg["oid"], int(msg["off"]),
+                                  int(msg["total"]), msg["blob"],
+                                  ref=msg.get("ref") or b"",
+                                  raw=msg.get("raw"))
 
     def handle_object_meta(self, conn, rid, msg):
         size = self.objects.nbytes_of(msg["oid"])
@@ -1957,6 +2214,9 @@ class DaemonService:
         return {"leases": leases, "running": running,
                 "store_used": self.objects.used_bytes(),
                 "pull_stats": dict(self.pulls.stats),
+                "push_stats": dict(self.pushes.stats),
+                "push_rx_stats": dict(self.push_rx.stats),
+                "arena": self.objects.arena_name,
                 "fast_lane": fast,
                 "agent_port": getattr(self, "agent_port", None),
                 "actors": len(
@@ -2065,8 +2325,21 @@ def main() -> None:
     _TRACE_PUSH_S = 0.5     # span-flush cadence: bounds head-store
     _TRACE_BATCH_MAX = 2000  # write rate under bursty task loads
 
+    from ray_tpu.objectplane import tiers as _tiers
+
     while True:  # heartbeat loop; exit if the head declared us dead
         time.sleep(_hb_interval())
+        try:
+            # object-plane housekeeping: reap deferred deletes whose
+            # attached-process refs dropped (external releases are
+            # silent atomics), publish host-tier occupancy — the gauge
+            # rides the metrics snapshot below to the head
+            service.objects.reap()
+            service.push_rx.sweep()
+            _tiers.publish_tier_bytes(_tiers.TIER_HOST,
+                                      service.objects.used_bytes())
+        except Exception:
+            pass
         span_batch = []
         if time.monotonic() - last_trace_push >= _TRACE_PUSH_S:
             span_batch = service.task_events.events_after(trace_cursor)

@@ -438,6 +438,10 @@ class Node:
         self.store = store
         self._execute_task = execute_task
         self.alive = True
+        # Optional dep-staging hook (daemon-backed nodes): called at
+        # enqueue so a proactive object push overlaps the task's queue
+        # wait (reference: ObjectManager::Push ahead of task-arg pulls).
+        self.prefetch: Optional[Callable[[TaskSpec], None]] = None
         # Graceful drain: alive + draining = finish running work, take
         # no new placements; the dispatch loop hands queued-but-
         # unstarted tasks back to the runtime for resubmission elsewhere.
@@ -494,6 +498,13 @@ class Node:
     # -- normal task path --------------------------------------------------
     def enqueue(self, spec: TaskSpec) -> None:
         spec.enqueued_at = time.perf_counter()
+        if self.prefetch is not None and spec.dependencies():
+            # stage remote deps toward this node while the task waits
+            # for admission (cheap no-op when every dep is local)
+            try:
+                self.prefetch(spec)
+            except Exception:
+                pass    # staging is best-effort; pulls cover misses
         with self._pending_lock:
             for k, v in spec.resources.items():
                 self._pending_demand[k] = self._pending_demand.get(k, 0.0) + v

@@ -122,6 +122,9 @@ class ObjectEntry:
     pinned: int = 0  # pin count: >0 means not evictable/spillable
     # native shm tier: (dtype, shape) of the array parked in the C++ store
     native_meta: Optional[tuple] = None
+    # explicit tier (host-shm | device-hbm | spilled): drives the
+    # ray_tpu_object_store_bytes{tier} occupancy accounting
+    tier: str = "host-shm"
 
 
 # numpy arrays at least this large go to the native shm arena when built
@@ -144,6 +147,10 @@ class LocalObjectStore:
         self._used = 0                  #: guarded by self._lock
         self.stats = {"puts": 0, "gets": 0, "spills": 0, "restores": 0,
                       "evictions": 0, "native_puts": 0}
+        # explicit (host-shm | device-hbm | spilled) occupancy, chained
+        # into the process aggregate -> ray_tpu_object_store_bytes{tier}
+        from ray_tpu.objectplane.tiers import store_accounting
+        self.tiers = store_accounting()
         # Outstanding zero-copy views into the native arena, per object.
         # The C++ store defers deallocation while refs are held; this
         # count decides whether close() may munmap (see close()).
@@ -177,6 +184,8 @@ class LocalObjectStore:
                     f"object of {size} bytes exceeds store capacity "
                     f"{self.capacity_bytes}")
             entry = ObjectEntry(value=value, nbytes=size, device_tier=device)
+            if device:
+                entry.tier = "device-hbm"
             if not device:
                 native_meta = self._try_native_put(object_id, value, size)
                 if native_meta is not None:
@@ -188,6 +197,7 @@ class LocalObjectStore:
                     self._used += size
             self._entries[object_id] = entry
             self.stats["puts"] += 1
+            self.tiers.add(entry.tier, size)
             return size
 
     def _try_native_put(self, object_id: ObjectID, value: Any,
@@ -270,6 +280,7 @@ class LocalObjectStore:
                     pass
             elif not entry.device_tier:
                 self._used -= entry.nbytes
+            self.tiers.add(entry.tier, -entry.nbytes)
 
     def pin(self, object_id: ObjectID) -> None:
         with self._lock:
@@ -293,6 +304,10 @@ class LocalObjectStore:
     def used_bytes(self) -> int:
         with self._lock:
             return self._used
+
+    def tier_bytes(self) -> Dict[str, int]:
+        """Occupancy by (host-shm | device-hbm | spilled) tier."""
+        return self.tiers.snapshot()
 
     def object_ids(self):
         with self._lock:
@@ -358,6 +373,8 @@ class LocalObjectStore:
             entry.value = None
             self._used -= entry.nbytes
             self.stats["spills"] += 1
+            self.tiers.move(entry.tier, "spilled", entry.nbytes)
+            entry.tier = "spilled"
 
     def _restore(self, object_id: ObjectID, entry: ObjectEntry) -> None:
         with self._lock:    # re-entrant: callers already hold it
@@ -377,3 +394,5 @@ class LocalObjectStore:
             entry.spilled_path = None
             self._used += entry.nbytes
             self.stats["restores"] += 1
+            self.tiers.move("spilled", "host-shm", entry.nbytes)
+            entry.tier = "host-shm"

@@ -202,6 +202,15 @@ class Runtime:
         # Objects whose every copy died with a node; reconstruction is
         # triggered lazily on the next get/wait/dependency touch.
         self._lost: Set[ObjectID] = set()
+        # Proactive dep-push staging (objectplane): a small shared pool
+        # (never thread-per-enqueue) + an in-flight (dep, dest) table so
+        # one fan-out stages each dep once.
+        from ray_tpu._private.thread_pool import DaemonThreadPool
+        self._prefetch_pool = DaemonThreadPool(2, name="push-prefetch")
+        #: guarded by self._prefetch_lock
+        self._prefetch_inflight: Set[tuple] = set()
+        self._prefetch_lock = tracked_lock("worker.push_prefetch",
+                                           reentrant=False)
 
         self._tasks: Dict[TaskID, _InFlightTask] = {}  #: guarded by self._tasks_lock
         self._tasks_lock = tracked_lock("worker.tasks", reentrant=False)
@@ -335,12 +344,88 @@ class Runtime:
         node = Node(handle.node_id, resources, {}, store,
                     execute_task=self._execute_on_remote_node)
         node.daemon = handle
+        # proactive dep staging: enqueue-time pushes overlap the
+        # transfer with the task's queue wait (PushManager dedupes)
+        node.prefetch = (lambda spec, _node=node:
+                         self._push_prefetch_deps(spec, _node))
         with self._nodes_lock:
             self._nodes[handle.node_id] = node
         self.gcs.register_node(node.info())
         from ray_tpu._private.scheduler import bump_cluster_epoch
         bump_cluster_epoch()
         return node
+
+    def _push_prefetch_deps(self, spec: TaskSpec, node: Node) -> None:
+        """Proactively push task deps that live only on OTHER daemon
+        nodes to ``node`` (reference: ``object_manager.cc:354 Push``) —
+        by the time the task (or a same-node consumer) needs them, a
+        local copy exists. The PushManager dedupes in-flight pushes,
+        copies the destination already holds, and chunks a concurrent
+        pull already transferred; failures are harmless (the classic
+        pull/owner path still serves the object on demand)."""
+        deps = spec.dependencies()
+        if not deps or getattr(node, "daemon", None) is None:
+            return
+        from ray_tpu._private.config import cfg
+        if not cfg().push_prefetch:
+            return
+        with self._loc_lock:
+            locs = {dep: list(self._locations.get(dep, ()))
+                    for dep in deps}
+        work = []
+        for dep, node_ids in locs.items():
+            if not node_ids or node.node_id in node_ids:
+                continue
+            src = self.get_node(node_ids[0])
+            src_daemon = getattr(src, "daemon", None)
+            meta_of = getattr(getattr(src, "store", None), "meta_of",
+                              None)
+            if (src is None or not src.alive or src_daemon is None
+                    or meta_of is None or node.store.contains(dep)):
+                continue
+            # driver-side (dep, dest) in-flight dedupe: a fan-out of
+            # tasks sharing one dep must stage it ONCE, not once per
+            # enqueue (the daemon's PushManager dedupes too, but this
+            # avoids the redundant RPCs entirely)
+            fly = (dep, node.node_id)
+            with self._prefetch_lock:
+                if fly in self._prefetch_inflight:
+                    continue
+                self._prefetch_inflight.add(fly)
+            try:
+                key, nbytes, raw = meta_of(dep)
+            except KeyError:
+                with self._prefetch_lock:
+                    self._prefetch_inflight.discard(fly)
+                continue
+            work.append((dep, fly, src_daemon, key, nbytes, raw))
+        if not work:
+            return
+
+        def run_one(dep, fly, src_daemon, key, nbytes, raw) -> None:
+            try:
+                out = src_daemon.push_object(
+                    key, node.daemon.addr, ref=dep.binary())
+                if out.get("ok"):
+                    node.store.register_remote(dep, key, nbytes,
+                                               raw=raw)
+                    with self._loc_lock:
+                        self._locations.setdefault(dep, set()).add(
+                            node.node_id)
+                    self.stats["objects_push_prefetched"] = (
+                        self.stats.get("objects_push_prefetched", 0)
+                        + 1)
+            except Exception:
+                pass            # on-demand pull/owner path covers it
+            finally:
+                with self._prefetch_lock:
+                    self._prefetch_inflight.discard(fly)
+
+        # small shared pool, never thread-per-task: a 10k-task fan-out
+        # with remote deps must not spawn 10k threads each parked in a
+        # (bounded) push RPC
+        for item in work:
+            self._prefetch_pool.submit(lambda it=item: run_one(*it))
 
     def _execute_on_remote_node(self, spec: TaskSpec, node: Node) -> None:
         """Task execution on a node-daemon process (wire protocol:
@@ -674,13 +759,22 @@ class Runtime:
                 dst_daemon = getattr(target, "daemon", None)
                 if src_daemon is not None and dst_daemon is not None:
                     # daemon→daemon transfer: bytes move directly over
-                    # the object plane (chunked/deduped PullManager),
-                    # never through the driver
-                    key, nbytes = node.store.meta_of(oid)
-                    if not dst_daemon.pull_object(
+                    # the object plane, never through the driver —
+                    # proactive push first (chunked/deduped
+                    # PushManager), pull as the fallback direction
+                    key, nbytes, raw = node.store.meta_of(oid)
+                    moved_ok = False
+                    try:
+                        moved_ok = src_daemon.push_object(
+                            key, dst_daemon.addr,
+                            ref=oid.binary()).get("ok", False)
+                    except Exception:
+                        moved_ok = False
+                    if not moved_ok and not dst_daemon.pull_object(
                             key, from_addr=src_daemon.addr, priority=1):
                         continue
-                    target.store.register_remote(oid, key, nbytes)
+                    target.store.register_remote(oid, key, nbytes,
+                                                 raw=raw)
                 else:
                     value = node.store.get(oid)
                     # reuse the size cached at insert time — migrating
@@ -831,6 +925,29 @@ class Runtime:
         self.futures.complete(oid)
         if _owner_pin:
             self.refcounter.pin(oid)
+        return ref
+
+    def put_stored(self, oid_bin: bytes, key: bytes, nbytes: int,
+                   raw, node_hex: str) -> ObjectRef:
+        """Owner-side registration of a worker DIRECT put: the payload
+        is already written + sealed in ``node``'s arena under ``key``
+        (zero-copy object plane) — record ownership, location, and the
+        raw-tier dtype/shape; no value ever reaches the driver."""
+        oid = ObjectID(bytes(oid_bin))
+        node = self.get_node(NodeID.from_hex(node_hex))
+        store = getattr(node, "store", None) if node is not None else None
+        register = getattr(store, "register_remote", None)
+        if node is None or not node.alive or register is None:
+            # unknown/dead/non-daemon node: the worker falls back to
+            # the classic value put (its arena entry is aborted)
+            raise RuntimeError(f"no daemon store on node {node_hex!r}")
+        register(oid, bytes(key), int(nbytes),
+                 raw=tuple(raw) if raw else None)
+        with self._loc_lock:
+            self._locations.setdefault(oid, set()).add(node.node_id)
+        ref = ObjectRef(oid, owner_hex=self.worker_id.hex(),
+                        task_name="put")
+        self.futures.complete(oid)
         return ref
 
     def _store_value(self, oid: ObjectID, value: Any,

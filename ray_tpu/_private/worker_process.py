@@ -59,6 +59,14 @@ class WorkerCrashed(Exception):
     """The worker process died while something was running on it."""
 
 
+# object-plane ops served by the worker's HOST daemon itself (never
+# forwarded to the owner): zero-copy meta resolution + direct-put
+# reserve/seal (docs/object_plane.md)
+_SHM_LOCAL_OPS = frozenset({"shm_get_meta", "shm_release",
+                            "shm_put_reserve", "shm_put_seal",
+                            "shm_put_abort"})
+
+
 # ---------------------------------------------------------------------------
 # function table (code shipping)
 # ---------------------------------------------------------------------------
@@ -299,11 +307,183 @@ class WorkerProxyRuntime:
 
     # -- objects ---------------------------------------------------------
     def get(self, refs, timeout: Optional[float] = None):
-        return self._state.call_host("get", refs=list(refs),
+        refs = list(refs)
+        out = self._shm_get(refs, timeout)
+        if out is not None:
+            return out
+        return self._state.call_host("get", refs=refs,
                                      timeout=timeout)
 
+    def _shm_get(self, refs, timeout: Optional[float]):
+        """Zero-copy resolve through the attached node arena: (offset,
+        nbytes) metadata from the daemon, ``np.frombuffer`` on the
+        mapping — no payload crosses the pipe and raw-tier arrays skip
+        serialization entirely. Per-object slot refs (taken daemon-side
+        on our behalf) keep every view safe from LRU eviction until
+        released. Returns None to take the classic owner path (arena
+        absent/failed, or the host predates the protocol)."""
+        try:
+            from ray_tpu.objectplane import arena as _oparena
+            ar = _oparena.get_arena()
+            if ar is None or not refs or ar.store() is None:
+                return None
+            metas = self._state.call_host(
+                "shm_get_meta", oids=[r.id.binary() for r in refs])
+        except Exception:
+            return None
+        if not isinstance(metas, list) or len(metas) != len(refs):
+            return None
+        values = [None] * len(refs)
+        missing: List[int] = []
+        pending = {i: m for i, m in enumerate(metas)
+                   if isinstance(m, dict)}
+        try:
+            for i, meta in enumerate(metas):
+                if not isinstance(meta, dict):
+                    missing.append(i)
+                    continue
+                # ownership handoff BEFORE resolving: from here this
+                # slot's single release belongs to the code below (view
+                # finalizer, or the loads finally) — the except sweep
+                # must never release it a second time, or a concurrent
+                # reader's ref would be consumed and eviction could
+                # unmap bytes it still views
+                del pending[i]
+                raw = meta.get("raw")
+                if raw:
+                    values[i] = ar.view(meta["off"], meta["size"],
+                                        meta["slot"], dtype=raw[0],
+                                        shape=raw[1])
+                else:
+                    store = ar.store()
+                    view = store.view_range(meta["off"], meta["size"])
+                    try:
+                        values[i] = cloudpickle.loads(memoryview(view))
+                    finally:
+                        ar.release_slot(meta["slot"])
+        except Exception:
+            # mid-resolve failure: drop every granted-but-unconsumed
+            # slot ref and fall back wholesale (slots already handed
+            # off released above or via their view finalizers)
+            for meta in pending.values():
+                try:
+                    ar.release_slot(meta["slot"])
+                except Exception:
+                    pass
+            return None
+        if missing:
+            fetched = self._state.call_host(
+                "get", refs=[refs[i] for i in missing], timeout=timeout)
+            for i, v in zip(missing, fetched):
+                values[i] = v
+        return values
+
     def put(self, value, _owner_pin: bool = False):
+        if not _owner_pin:
+            ref = self._shm_put(value)
+            if ref is not None:
+                return ref
         return self._state.call_host("put", value=value)
+
+    def _shm_put(self, value):
+        """Direct put: reserve arena space through the daemon, write
+        the payload IN PLACE through our own mapping, and send only the
+        seal message — the payload never rides the pipe or an RPC
+        frame. Returns the owner-registered ObjectRef, or None to take
+        the classic path (small value, no arena, any failure)."""
+        try:
+            from ray_tpu.objectplane import arena as _oparena
+            ar = _oparena.get_arena()
+            if ar is None or ar.store() is None:
+                return None
+            from ray_tpu._private.object_store import _is_device_value
+            if _is_device_value(value):
+                return None     # device tier stays owner-managed
+            from ray_tpu._private.config import cfg
+            min_direct = int(cfg().direct_put_min_bytes)
+            from ray_tpu.objectplane.tiers import raw_put_eligible
+            raw = raw_put_eligible(value)
+            if raw is not None:
+                payload = memoryview(value).cast("B")
+                nbytes = payload.nbytes
+            else:
+                from ray_tpu._private.worker import _find_nested_refs
+                if _find_nested_refs(value):
+                    # nested ObjectRefs need the owner's borrowed-ref
+                    # registration (classic put path) — a sealed blob
+                    # would hold refs the refcounter can't see
+                    return None
+                blob = _safe_dumps(value)
+                if len(blob) < min_direct:
+                    return None
+                payload = blob
+                nbytes = len(blob)
+            node_hex = self._node_hex()
+            if node_hex is None:
+                return None     # no task context: owner path
+            from ray_tpu._private.ids import ObjectID
+            oid = ObjectID.from_random()
+            key = b"wput:" + oid.binary()
+            out = self._state.call_host("shm_put_reserve", key=key,
+                                        size=nbytes)
+            if not isinstance(out, dict) or "off" not in out:
+                return None     # arena full: classic path spills/inlines
+        except Exception:
+            return None
+        try:
+            ar.write(out["off"], payload)
+        except Exception:
+            # the reserve succeeded but the write didn't (mapping
+            # detached mid-flight): drop the reservation or its
+            # creator-ref'd bytes would leak for the arena's lifetime
+            self._shm_put_abort(key)
+            return None
+        if not self._seal_with_retry(key, oid, raw, nbytes):
+            self._shm_put_abort(key)
+            return None
+        try:
+            return self._state.call_host(
+                "put_stored", oid=oid.binary(), key=key, nbytes=nbytes,
+                raw=raw, node=node_hex)
+        except Exception:
+            self._shm_put_abort(key)
+            return None
+
+    def _seal_with_retry(self, key: bytes, oid, raw,
+                         nbytes: int) -> bool:
+        from ray_tpu._private import failpoints as _fp
+        for _ in range(3):
+            if _fp.ENABLED:
+                try:
+                    # drop arm = the seal message is lost in transit;
+                    # resend — sealing is idempotent at the daemon
+                    if _fp.fire("shm.seal", nbytes=nbytes) is _fp.DROP:
+                        continue
+                except Exception:
+                    continue
+            try:
+                out = self._state.call_host(
+                    "shm_put_seal", key=key, ref=oid.binary(), raw=raw)
+            except Exception:
+                return False
+            return bool(isinstance(out, dict) and out.get("ok"))
+        return False
+
+    def _shm_put_abort(self, key: bytes) -> None:
+        try:
+            self._state.call_host("shm_put_abort", key=key)
+        except Exception:
+            pass
+
+    @staticmethod
+    def _node_hex() -> Optional[str]:
+        try:
+            from ray_tpu._private import runtime_context
+            ctx = runtime_context._ctx.get()
+            nid = getattr(ctx, "node_id", None) if ctx else None
+            return nid.hex() if nid is not None else None
+        except Exception:
+            return None
 
     def wait(self, refs, num_returns: int = 1,
              timeout: Optional[float] = None, fetch_local: bool = True):
@@ -346,6 +526,15 @@ class _WorkerState:
         self.boot = boot
         self.namespace = boot.get("namespace", "default")
         self.job_id = boot.get("job_id")
+        arena = boot.get("arena")
+        if arena:
+            # the daemon's worker hello hands us its arena (name,
+            # capacity): attach lazily on first object-plane use
+            try:
+                from ray_tpu.objectplane import arena as _oparena
+                _oparena.configure(arena[0], arena[1])
+            except Exception:
+                pass    # plane unavailable: classic RPC path
         self._send_lock = threading.Lock()
         self._ids = itertools.count()
         self._pending: Dict[str, list] = {}
@@ -997,6 +1186,14 @@ def dispatch_core_op(rt, holder, call: str, kw: Dict[str, Any],
         ref = rt.put(kw["value"])
         holder._hold(task_rid, ref)
         return ref
+    if call == "put_stored":
+        # direct-put registration: the worker already wrote + sealed
+        # the payload in its node's arena; the owner only records
+        # ownership, location, and (for raw tier) the array dtype/shape
+        ref = rt.put_stored(kw["oid"], kw["key"], kw["nbytes"],
+                            kw.get("raw"), kw["node"])
+        holder._hold(task_rid, ref)
+        return ref
     if call == "wait":
         return rt.wait(kw["refs"], num_returns=kw["num_returns"],
                        timeout=kw["timeout"],
@@ -1235,11 +1432,20 @@ class WorkerClient:
     def _serve_core(self, msg: Dict[str, Any]) -> None:
         try:
             forward = getattr(self.runtime, "forward_core_op", None)
+            shm = (getattr(self.runtime, "shm_ops", None)
+                   if msg.get("call") in _SHM_LOCAL_OPS else None)
             local_fn = (_local_fn_blob(msg)
                         if (forward is not None
                             and msg.get("call") == "fetch_function")
                         else None)
-            if local_fn is not None:
+            if shm is not None:
+                # object-plane metadata ops are DAEMON-LOCAL: the whole
+                # point is that neither metadata resolution nor payload
+                # ever round-trips through the owner
+                value = shm(msg["call"], cloudpickle.loads(msg["payload"]))
+                reply = {"op": "reply", "for": msg["id"], "ok": True,
+                         "value": cloudpickle.dumps(value)}
+            elif local_fn is not None:
                 # function blobs are content-addressed (sha1 fid): serve
                 # from this process's table when present — xlang fids
                 # only exist here, and it skips a driver round trip
@@ -1472,6 +1678,16 @@ def set_extra_sys_path(paths: List[str]) -> None:
         _SYS_PATH_VERSION[0] += 1
 
 
+# The hosting daemon's shm arena (name, capacity): handed to every
+# worker in the boot frame so it can attach the segment and run the
+# zero-copy object protocol. Unset outside daemon processes.
+_ARENA_INFO: List[Optional[tuple]] = [None]
+
+
+def set_arena_info(name: str, capacity: int) -> None:
+    _ARENA_INFO[0] = (name, int(capacity))
+
+
 def live_workers() -> List["WorkerClient"]:
     return [w for w in list(_ALL_WORKERS) if w.alive()]
 _PRESTARTING = [0]
@@ -1529,6 +1745,8 @@ def _make_boot() -> Dict[str, Any]:
                                               session_log_dir)
     boot["log_dir"] = (session_log_dir()
                        if log_to_driver_enabled() else None)
+    if _ARENA_INFO[0] is not None:
+        boot["arena"] = _ARENA_INFO[0]
     return boot
 
 

@@ -1,0 +1,33 @@
+"""Zero-copy object plane: the cross-process data path for one node.
+
+Reference capability (NOT a port): plasma + the object manager
+(``src/ray/object_manager/``) — a node-level store that every process on
+the node maps (``plasma/``: mmap'd segments handed to clients, LRU of
+sealed-unreferenced), plus proactive node-to-node transfer with dedup
+(``object_manager.cc:354 Push``, ``push_manager.h``).
+
+Three pieces:
+
+- :mod:`~ray_tpu.objectplane.tiers` — the explicit
+  (host-shm | device-HBM | spilled) tier model and its metrics
+  (``ray_tpu_object_store_bytes{tier}``,
+  ``ray_tpu_object_zero_copy_gets_total``);
+- :mod:`~ray_tpu.objectplane.arena` — worker-side attach to the node
+  daemon's shm arena: read-only ``np.frombuffer`` views with a
+  process-shared per-object ref/release protocol (eviction can never
+  unmap a buffer a worker still views), and direct puts that reserve +
+  write arena space in place (only a seal message crosses the wire);
+- :mod:`~ray_tpu.objectplane.push` — ``PushManager``: proactive
+  daemon-to-daemon pushes of hot objects, deduplicated in flight and
+  against the owner's object directory, chunks read straight from the
+  arena.
+
+See docs/object_plane.md for the protocol and knob table.
+"""
+
+from ray_tpu.objectplane.tiers import (TIER_DEVICE, TIER_HOST,  # noqa: F401
+                                       TIER_SPILLED)
+from ray_tpu.objectplane.arena import (WorkerArena, configure,  # noqa: F401
+                                       get_arena,
+                                       sweep_stale_segments)
+from ray_tpu.objectplane.push import PushManager, PushReceiver  # noqa: F401

@@ -56,3 +56,10 @@ def test_train_bench_child_cpu_smoke():
         # the trajectory files track the bottleneck being fixed)
         assert "drain_tasks_per_second" in cp
         assert "tasks_per_second" in cp
+        # object-plane throughput rows (ROADMAP item 1: put_get_1MiB is
+        # the zero-copy plane's headline; 64KiB/16MiB bracket it)
+        obj = out.get("objects")
+        assert obj is not None
+        for k in ("put_get_64KiB_mbps", "put_get_1MiB_mbps",
+                  "put_get_16MiB_mbps"):
+            assert k in obj

@@ -439,6 +439,9 @@ WIRED_SEAMS = [
     "drain.announce",
     "drain.migrate_object",
     "drain.deadline",
+    "daemon.push_transfer",
+    "shm.attach",
+    "shm.seal",
     "batch.submit_flush",
     "batch.free_flush",
     "batch.result_flush",

@@ -1,0 +1,512 @@
+"""Zero-copy object plane: worker shm-arena attach, tiered store,
+push-dedup transfer (ray_tpu/objectplane/, docs/object_plane.md).
+
+Covers the plane's contract at three levels:
+
+- native: process-shared slot refcounts block LRU eviction while any
+  attached process holds a view; reserve/seal are idempotent;
+- store: explicit (host-shm | device-hbm | spilled) tiers with
+  occupancy accounting; stale-segment sweep on (re)start;
+- cluster e2e (daemons topology): same-node consumers see read-only
+  arena-backed views (no pickle on the raw hot path, mutation raises),
+  direct puts move only a seal message, classic and attached consumers
+  coexist on one daemon, and everything degrades to the per-RPC path
+  when the arena is unavailable.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.native_store import available
+
+needs_native = pytest.mark.skipif(
+    not available(), reason="native shm store unavailable (no g++)")
+
+
+# ---------------------------------------------------------------------------
+# native: shared-slot ref/release protocol
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_ext_ref_blocks_eviction_then_release_frees():
+    from ray_tpu.native_store import ShmObjectStore, ShmStoreFull
+    store = ShmObjectStore(f"rtpu_tst_ext_{os.getpid()}", 1 << 20)
+    try:
+        store.put(b"a", b"\x07" * (600 * 1024))
+        store.put(b"b", b"\x08" * (300 * 1024))
+        # an ATTACHED process's view: ext slot ref taken on its behalf
+        off, size, slot = store.get_ext(b"a")
+        att = ShmObjectStore.attach(store.name)
+        try:
+            view = att.view_range(off, size)
+            assert bytes(view[:4]) == b"\x07" * 4
+            assert store.ext_refs(slot) == 1
+            # pressure needing a's bytes: b is evictable, but freeing
+            # it cannot make 600 KiB contiguous — and a is PINNED by
+            # the attached client's slot ref, so the create must fail
+            # instead of unmapping bytes the client still views
+            with pytest.raises(ShmStoreFull):
+                store.put(b"c", b"\x01" * (600 * 1024))
+            assert store.contains(b"a")
+            assert bytes(view[:4]) == b"\x07" * 4   # still mapped
+            del view
+            att.ext_release(slot)
+            assert store.ext_refs(slot) == 0
+            store.put(b"c", b"\x01" * (600 * 1024))  # now evictable
+            assert not store.contains(b"a")
+            assert store.contains(b"c")
+        finally:
+            att.close()
+    finally:
+        store.close(unlink=True)
+
+
+@needs_native
+def test_deferred_delete_waits_for_ext_release_then_reaps():
+    from ray_tpu.native_store import ShmObjectStore
+    store = ShmObjectStore(f"rtpu_tst_reap_{os.getpid()}", 1 << 20)
+    try:
+        store.put(b"x", b"abc" * 1000, pin=True)
+        _off, _size, slot = store.get_ext(b"x")
+        store.delete(b"x")              # readers outstanding: deferred
+        assert store.num_objects() == 1
+        assert store.reap() == 0        # still ext-referenced
+        store.ext_release(slot)
+        assert store.reap() == 3000     # last ref gone: bytes freed
+        assert store.num_objects() == 0
+    finally:
+        store.close(unlink=True)
+
+
+@needs_native
+def test_view_slot_ref_survives_derived_views():
+    """Review regression: numpy collapses view base chains, so a slice
+    of the reshaped array bases on the frombuffer BASE — the slot ref
+    must release only when the base dies (i.e. when NO view of the
+    bytes survives), not when the derived array is dropped."""
+    import gc
+
+    from ray_tpu.native_store import ShmObjectStore
+    from ray_tpu.objectplane.arena import WorkerArena
+    store = ShmObjectStore(f"rtpu_tst_deriv_{os.getpid()}", 1 << 20)
+    try:
+        store.put(b"a", np.arange(1024, dtype=np.float32).tobytes(),
+                  pin=True)
+        off, size, slot = store.get_ext(b"a")
+        wa = WorkerArena(store.name, 0)
+        view = wa.view(off, size, slot, dtype="<f4", shape=(1024,))
+        sl = view[10:20]
+        del view
+        gc.collect()
+        assert store.ext_refs(slot) == 1    # slice still maps the bytes
+        assert float(sl[0]) == 10.0
+        del sl
+        gc.collect()
+        assert store.ext_refs(slot) == 0    # last view gone: released
+    finally:
+        store.close(unlink=True)
+
+
+@needs_native
+def test_reserve_write_seal_idempotent_via_attach():
+    from ray_tpu.native_store import ShmObjectStore
+    store = ShmObjectStore(f"rtpu_tst_rsv_{os.getpid()}", 1 << 20)
+    try:
+        off = store.reserve(b"k", 8)
+        assert store.reserve(b"k", 8) == off    # retried reserve
+        att = ShmObjectStore.attach(store.name)
+        try:
+            att.write_range(off, b"12345678")
+        finally:
+            att.close()
+        store.seal(b"k", pin=True)
+        store.seal(b"k", pin=True)              # retried seal
+        o, s = store.get_ref(b"k")
+        assert bytes(store.read_range(o, s)) == b"12345678"
+        store.release(b"k")
+    finally:
+        store.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# stale-segment hygiene (daemon restart)
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_object_table_sweeps_stale_segments_of_same_node():
+    """A SIGKILL'd daemon leaks its arena in /dev/shm; the successor of
+    the SAME node sweeps it before creating a fresh one (and never
+    touches other nodes' segments)."""
+    from ray_tpu._private.daemon import ObjectTable
+    from ray_tpu.native_store import ShmObjectStore
+    name = f"rtpu_tstsweep{os.getpid() % 10_000:04d}"
+    other = f"rtpu_tstother{os.getpid() % 10_000:04d}"
+    # "crashed daemon": segment left behind, name never unlinked
+    leaked = ShmObjectStore(name, 1 << 20)
+    leaked.put(b"stale", b"old-bytes")
+    leaked.close(unlink=False)
+    bystander = ShmObjectStore(other, 1 << 20)
+    try:
+        assert os.path.exists(f"/dev/shm/{name}")
+        table = ObjectTable(name, 1 << 20)      # the restarted daemon
+        try:
+            # fresh arena: the stale object is gone, the segment exists
+            assert table._shm is not None
+            assert not table._shm.contains(b"stale")
+            assert os.path.exists(f"/dev/shm/{name}")
+            # the other node's live segment was not swept
+            assert os.path.exists(f"/dev/shm/{other}")
+        finally:
+            table.close()
+    finally:
+        bystander.close(unlink=True)
+
+
+def test_sweep_stale_segments_scoped_to_prefix(tmp_path):
+    from ray_tpu.objectplane.arena import sweep_stale_segments
+    assert sweep_stale_segments("") == []     # no prefix: never sweeps
+
+
+# ---------------------------------------------------------------------------
+# tier model
+# ---------------------------------------------------------------------------
+
+def test_local_store_tier_accounting_spill_restore(tmp_path):
+    from ray_tpu._private.ids import NodeID, ObjectID
+    from ray_tpu._private.object_store import LocalObjectStore
+    store = LocalObjectStore(NodeID.from_random(), 4000,
+                             spill_dir=str(tmp_path))
+    store._native = None    # force the pure-python host tier
+    a, b = ObjectID.from_random(), ObjectID.from_random()
+    store.put(a, b"x" * 3000, nbytes=3000)
+    assert store.tier_bytes().get("host-shm") == 3000
+    store.put(b, b"y" * 3000, nbytes=3000)      # pressure: a spills
+    tiers = store.tier_bytes()
+    assert tiers.get("spilled") == 3000
+    assert tiers.get("host-shm") == 3000
+    assert store.get(a) == b"x" * 3000          # restore flips it back
+    tiers = store.tier_bytes()
+    assert tiers.get("spilled", 0) in (0, 3000)  # b may have spilled
+    store.delete(a)
+    store.delete(b)
+    tiers = store.tier_bytes()
+    assert tiers.get("host-shm", 0) == 0
+    assert tiers.get("spilled", 0) == 0
+    store.close()
+
+
+# ---------------------------------------------------------------------------
+# cluster e2e (daemons topology)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(autouse=True)
+def _reset_failpoints():
+    """Env-armed failpoints activate in-process at init; never leak an
+    armed registry into later test files."""
+    yield
+    from ray_tpu._private import failpoints as _fp
+    _fp.reset()
+
+
+@pytest.fixture
+def daemon_cluster():
+    rt = ray_tpu.init(num_nodes=2, resources={"CPU": 4},
+                      cluster="daemons")
+    yield rt
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def _produce(n):
+    return np.full(n // 8, 7.0)
+
+
+@ray_tpu.remote
+def _consume(refs):
+    got = ray_tpu.get(refs)[0]
+    mutated = False
+    try:
+        got[0] = 1.0
+        mutated = True
+    except (ValueError, TypeError):
+        pass
+    from ray_tpu.objectplane.arena import arena_stats
+    return {"sum": float(got.sum()), "mutated": mutated,
+            "writeable": bool(got.flags.writeable)
+            if hasattr(got, "flags") else None,
+            "stats": arena_stats()}
+
+
+@needs_native
+def test_same_node_consumer_gets_arena_backed_readonly_view(
+        daemon_cluster):
+    """The satellite contract: a same-node consumer's buffer is backed
+    by the shared arena (zero-copy — the arena's zero_copy_gets counter
+    moved in THAT worker), the view is read-only, and mutation raises."""
+    r = _produce.remote(1 << 21)
+    ray_tpu.get(r)      # result landed (stored in the daemon)
+    out = ray_tpu.get(_consume.remote([r]))
+    assert out["sum"] == 7.0 * (1 << 18)
+    assert out["mutated"] is False
+    assert out["writeable"] is False
+    assert out["stats"]["attached"] == 1
+    assert out["stats"]["zero_copy_gets"] >= 1
+
+
+@needs_native
+def test_worker_direct_put_roundtrip_and_driver_get(daemon_cluster):
+    """Worker direct put: payload written in place, only the seal +
+    registration messages cross the wire; the driver reads the same
+    object back (zero-copy view on this same-host box)."""
+
+    @ray_tpu.remote
+    def put_big():
+        a = np.arange(512 * 1024, dtype=np.float32)     # 2 MiB
+        ref = ray_tpu.put(a)
+        from ray_tpu.objectplane.arena import arena_stats
+        return ref, arena_stats()
+
+    ref, stats = ray_tpu.get(put_big.remote())
+    assert stats["direct_puts"] >= 1
+    got = ray_tpu.get(ref)
+    assert got.dtype == np.float32 and got.shape == (512 * 1024,)
+    assert float(got[12345]) == 12345.0
+    assert got.flags.writeable is False     # raw-tier view/frombuffer
+
+
+@needs_native
+def test_multi_return_not_aliased_to_tuple_blob(daemon_cluster):
+    """Review regression: a multi-return task's stored blob holds the
+    WHOLE tuple; the daemon's oid index must not alias ref0 to it, or
+    a same-node consumer would read (r0, r1) as r0's value."""
+
+    @ray_tpu.remote(num_returns=2)
+    def duo(n):
+        return np.full(n // 8, 1.0), np.full(n // 8, 2.0)
+
+    r0, r1 = duo.remote(1 << 21)
+    ray_tpu.get([r0, r1])
+
+    @ray_tpu.remote
+    def consume(refs):
+        v = ray_tpu.get(refs)[0]
+        assert not isinstance(v, tuple), "aliased to the tuple blob"
+        return float(v.sum())
+
+    assert ray_tpu.get(consume.remote([r0])) == float(1 << 18)
+    assert ray_tpu.get(consume.remote([r1])) == 2.0 * (1 << 18)
+
+
+@needs_native
+def test_mixed_classic_and_attached_consumers_one_daemon(
+        daemon_cluster):
+    """Classic (plane-disabled) and attached consumers coexist on one
+    daemon: both read the same stored object correctly."""
+
+    @ray_tpu.remote
+    def consume_classic(refs):
+        from ray_tpu.objectplane import arena as _arena
+        _arena.set_disabled(True)
+        try:
+            got = ray_tpu.get(refs)[0]
+            return float(got.sum())
+        finally:
+            _arena.set_disabled(False)
+
+    r = _produce.remote(1 << 21)
+    ray_tpu.get(r)
+    classic = ray_tpu.get(consume_classic.remote([r]))
+    attached = ray_tpu.get(_consume.remote([r]))
+    assert classic == attached["sum"] == 7.0 * (1 << 18)
+
+
+@needs_native
+def test_push_object_dedupes_and_lands_copy(daemon_cluster):
+    """PushManager contract: a driver-directed push lands the object on
+    the peer; a second push of the same object dedupes against the copy
+    the destination now holds (directory/receiver probe)."""
+    rt = daemon_cluster
+    h_a, h_b = list(rt.cluster_backend.daemons.values())
+    key = b"put:pushtest-0001"
+    h_a.put_object_blob(key, b"z" * (2 << 20))
+    out = h_a.push_object(key, h_b.addr)
+    assert out["ok"] and not out.get("skipped")
+    assert h_b.client.call("object_meta", oid=key)["size"] == 2 << 20
+    out2 = h_a.push_object(key, h_b.addr)
+    assert out2["ok"] and out2.get("skipped")
+    stats = h_a.client.call("daemon_stats")["push_stats"]
+    assert stats["pushes_started"] >= 2
+    assert stats["pushes_skipped_held"] >= 1
+    assert stats["bytes_pushed"] >= 2 << 20
+
+
+@needs_native
+def test_remote_store_direct_put_tiers_and_zero_copy_get(
+        daemon_cluster):
+    """Driver-side direct put of a large contiguous array: raw tier,
+    host-shm occupancy accounted, zero-copy read-only view back."""
+    rt = daemon_cluster
+    from ray_tpu._private.ids import ObjectID
+    node = next(n for n in rt.nodes()
+                if getattr(n, "daemon", None) is not None)
+    store = node.store
+    if not store.daemon.objectplane:
+        pytest.skip("daemon has no native arena")
+    oid = ObjectID.from_random()
+    arr = np.arange(256 * 1024, dtype=np.float32)       # 1 MiB
+    before = store.stats["direct_puts"]
+    store.put(oid, arr)
+    assert store.stats["direct_puts"] == before + 1
+    assert store.tier_bytes().get("host-shm", 0) >= arr.nbytes
+    got = store.get(oid)
+    assert got.flags.writeable is False
+    assert (got == arr).all()
+    del got
+    store.delete(oid)
+
+
+class _FakeObjects:
+    def __init__(self):
+        self.stored = {}
+
+    def contains(self, oid):
+        return oid in self.stored
+
+    def put(self, oid, blob):
+        self.stored[oid] = blob
+
+
+def test_push_receiver_distinct_range_accounting_and_raw_meta():
+    """Review regressions: (1) duplicate/overlapping chunks from two
+    concurrent senders must not count toward completion twice (a buffer
+    with holes would enter the table); (2) raw-tier (dtype, shape)
+    metadata travels with the push so the receiver's oid index serves
+    the copy as views, not pickle-lookalike bytes."""
+    from ray_tpu.objectplane.push import PushReceiver
+    objs = _FakeObjects()
+    regs = {}
+    rx = PushReceiver(objs, register_oid=lambda ref, key, raw=None:
+                      regs.__setitem__(ref, (key, raw)))
+    rx.chunk(b"o", 0, 8, b"1234")
+    out = rx.chunk(b"o", 0, 8, b"1234")     # duplicate offset
+    assert not out.get("have")
+    assert b"o" not in objs.stored          # 4+4 dup != complete
+    rx.chunk(b"o", 4, 8, b"5678", ref=b"r", raw=("<f4", (2,)))
+    assert objs.stored[b"o"] == b"12345678"
+    assert regs[b"r"] == (b"o", ("<f4", (2,)))
+
+
+def test_push_receiver_have_short_circuits():
+    from ray_tpu.objectplane.push import PushReceiver
+    objs = _FakeObjects()
+    objs.stored[b"o"] = b"already"
+    rx = PushReceiver(objs)
+    assert rx.chunk(b"o", 0, 7, b"already").get("have") is True
+
+
+def test_push_receiver_interval_merge_handles_misaligned_senders():
+    """Review regression: completion is the UNION of covered intervals,
+    not a sum of per-offset lengths — two senders with different chunk
+    sizes must not 'complete' a buffer that still has a hole."""
+    from ray_tpu.objectplane.push import PushReceiver
+    objs = _FakeObjects()
+    rx = PushReceiver(objs)
+    rx.chunk(b"m", 0, 10, b"aaaa")      # covers 0-4
+    rx.chunk(b"m", 4, 10, b"bbbb")      # covers 4-8
+    rx.chunk(b"m", 0, 10, b"aaaaaa")    # covers 0-6 (overlap): union
+    #                                     is still only 0-8, but the
+    #                                     naive sum would be 14 >= 10
+    assert b"m" not in objs.stored      # bytes 8-10 never arrived
+    rx.chunk(b"m", 8, 10, b"cc")
+    assert objs.stored[b"m"] == b"aaaaaabbcc"
+
+
+def test_push_receiver_sweep_expires_abandoned_partials():
+    from ray_tpu.objectplane.push import PushReceiver
+    objs = _FakeObjects()
+    rx = PushReceiver(objs)
+    rx.chunk(b"s", 0, 100, b"x")        # partial: sender then "dies"
+    assert rx.sweep(max_age_s=-1.0) == 1
+    assert rx.stats["pending_expired"] == 1
+    rx.chunk(b"s", 0, 4, b"full")       # a fresh transfer still works
+    assert objs.stored[b"s"] == b"full"
+
+
+# ---------------------------------------------------------------------------
+# graceful degradation
+# ---------------------------------------------------------------------------
+
+def test_plane_disabled_falls_back_to_rpc_path():
+    """objectplane_attach=False (and equally: no native build) keeps
+    every object op on the classic per-RPC path — no-compiler boxes
+    stay green."""
+    rt = ray_tpu.init(num_nodes=1, resources={"CPU": 2},
+                      cluster="daemons",
+                      _system_config={"objectplane_attach": False})
+    try:
+        for h in rt.cluster_backend.daemons.values():
+            assert h.objectplane is False
+
+        @ray_tpu.remote
+        def round_trip(n):
+            a = np.ones(n // 4, dtype=np.float32)
+            ref = ray_tpu.put(a)
+            return float(ray_tpu.get([ref])[0].sum())
+
+        assert ray_tpu.get(round_trip.remote(1 << 20)) == float(1 << 18)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_shm_attach_failpoint_drops_to_rpc_fallback(monkeypatch):
+    """shm.attach drop arm: the worker's mapping fails -> the plane
+    disables for that process and object ops fall back per-RPC; the
+    task still succeeds (never task failure)."""
+    monkeypatch.setenv("RAY_TPU_FAILPOINTS", "shm.attach=drop")
+    rt = ray_tpu.init(num_nodes=1, resources={"CPU": 2},
+                      cluster="daemons")
+    try:
+        @ray_tpu.remote
+        def round_trip(n):
+            a = np.ones(n // 4, dtype=np.float32)
+            ref = ray_tpu.put(a)
+            total = float(ray_tpu.get([ref])[0].sum())
+            from ray_tpu.objectplane.arena import arena_stats
+            return total, arena_stats()
+
+        total, stats = ray_tpu.get(round_trip.remote(1 << 20))
+        assert total == float(1 << 18)
+        if stats:   # arena configured: the attach must have failed
+            assert stats["attached"] == 0
+            assert stats["attach_failures"] >= 1
+            assert stats["zero_copy_gets"] == 0
+    finally:
+        ray_tpu.shutdown()
+
+
+@needs_native
+def test_shm_seal_drop_retries_idempotently(monkeypatch):
+    """shm.seal drop arm: the first seal message is lost; the writer
+    resends and the retried seal lands the SAME entry (exactly-once
+    object, correct bytes)."""
+    monkeypatch.setenv("RAY_TPU_FAILPOINTS", "shm.seal=drop:max=1")
+    rt = ray_tpu.init(num_nodes=1, resources={"CPU": 2},
+                      cluster="daemons")
+    try:
+        @ray_tpu.remote
+        def put_and_read(n):
+            a = np.arange(n // 4, dtype=np.float32)
+            ref = ray_tpu.put(a)
+            got = ray_tpu.get([ref])[0]
+            from ray_tpu.objectplane.arena import arena_stats
+            return float(got[n // 8]), arena_stats()
+
+        v, stats = ray_tpu.get(put_and_read.remote(1 << 20))
+        assert v == float(1 << 17)
+        if stats.get("attached"):
+            assert stats["direct_puts"] >= 1    # direct path survived
+    finally:
+        ray_tpu.shutdown()

@@ -96,6 +96,37 @@ def burst_batched(n=600) -> float:
 burst_batched()     # warm the classic path
 results["burst_batched_per_s"] = round(burst_batched(), 1)
 
+# probe 6: object plane — worker-side 1MiB put+get round trips, in
+# MiB/s moved (put and get each move the payload). In daemons mode
+# this is the zero-copy arena path (direct put + frombuffer get); in
+# the in-process topology it measures the worker-pipe round trip
+# (docs/object_plane.md).
+
+
+@ray_tpu.remote
+def _put_get_1mib(seconds=1.5):
+    import time as _time
+
+    import numpy as _np
+
+    import ray_tpu as _rt
+    a = _np.ones((1 << 20) // 4, dtype=_np.float32)
+    r = _rt.put(a)
+    _rt.get([r])        # warm
+    n = 0
+    t0 = _time.perf_counter()
+    while _time.perf_counter() - t0 < seconds:
+        r = _rt.put(a)
+        b = _rt.get([r])[0]
+        assert b.nbytes == 1 << 20
+        del b, r
+        n += 1
+    return n, _time.perf_counter() - t0
+
+
+n_pg, dt_pg = ray_tpu.get(_put_get_1mib.remote(), timeout=60.0)
+results["put_get_1MiB_mbps"] = round(n_pg * 2 / dt_pg, 1)
+
 # probe 4: tracing overhead — the same burst with spans ON vs OFF.
 # Methodology: PAIRED bursts in one cluster with BALANCED ordering
 # (on-first on even rounds, off-first on odd) and the MEDIAN of the
@@ -184,6 +215,19 @@ except FileNotFoundError:
     print(f"no {FLOOR_PATH}; run tools/perf_smoke.sh "
           f"[daemons] --rebaseline")
     sys.exit(1)
+
+# The object-plane row's daemons floor assumes the native shm arena;
+# a no-compiler box runs the classic RPC path by design (graceful
+# fallback) and must not fail the gate for missing g++.
+try:
+    from ray_tpu.native_store import available as _native_available
+    _have_native = _native_available()
+except Exception:
+    _have_native = False
+if not _have_native and "put_get_1MiB_mbps" in floors:
+    print("put_get_1MiB_mbps: skipped (no native arena on this box; "
+          "classic path is ungated)")
+    floors.pop("put_get_1MiB_mbps")
 
 failed = False
 for name, floor in floors.items():
