@@ -604,6 +604,12 @@ class DaemonHandle:
             self.mark_dead()
             raise DaemonCrashed(str(e))
 
+    def profile_burst(self, duration: float = 2.0) -> List[Dict[str, Any]]:
+        """Stack-sampling burst on this daemon + its pool workers; one
+        record per process (the `ray-tpu profile` fan-out leg)."""
+        out = self._call("profile_burst", duration=float(duration))
+        return [r for r in out.get("procs", []) if isinstance(r, dict)]
+
     # -- wiring -----------------------------------------------------------
     def hello(self, owner_addr: Tuple[str, int], job_id, namespace: str):
         # ship the driver's import roots (the code-search-path role):

@@ -128,6 +128,17 @@ FLAG_DEFS = [
     Flag("trace_sample", float, 1.0, "fraction of tasks traced when "
          "task_trace is on; sampling is deterministic in the task id so "
          "driver, daemon, and worker agree per task (1.0 = every task)"),
+    Flag("profiling_hz", float, 0.0, "continuous stack-sampler rate "
+         "(samples/second) in every process — driver, head, daemon, "
+         "workers; 0 = off (the default; on-demand bursts via `ray_tpu "
+         "profile` / util.state.cluster_profile work either way). "
+         "Profiles federate to the head on heartbeats "
+         "(docs/observability.md)"),
+    Flag("lock_metrics", bool, False, "meter tracked runtime locks: "
+         "wait/hold-time histograms (ray_tpu_lock_wait_seconds / "
+         "ray_tpu_lock_hold_seconds{lock}) plus a contended counter on "
+         "every named lock; mutually exclusive with lock_sanitizer "
+         "(sanitizer wins when both are set)"),
     # -- accelerator topology --
     Flag("tpu_topology", str, "", "TPU slice topology for ICI-aware gang "
          "scheduling, '<gen>:<AxBxC>' (e.g. 'v5p:4x4x4'); '' = no "

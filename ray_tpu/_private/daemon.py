@@ -49,6 +49,8 @@ from ray_tpu._private.ids import ActorID, NodeID, TaskID
 from ray_tpu._private.lock_sanitizer import tracked_lock
 from ray_tpu._private.task_spec import TaskKind, TaskSpec
 from ray_tpu._private.rpc import Client, Connection, Server, declare
+from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import profiling as _profiling
 
 INLINE_RESULT = 100 * 1024  # reference: max_direct_call_object_size
 
@@ -93,6 +95,10 @@ declare("xlang_create_actor", "cls", "name", "args")
 declare("xlang_call_actor", "name", "method", "args")
 declare("daemon_stop")
 declare("daemon_stats")
+# on-demand profiling burst: the daemon samples its own stacks AND fans
+# out to its live pool workers; blocks ~duration (handler is
+# @concurrent so it cannot head-of-line-block the connection lane)
+declare("profile_burst", "duration")
 declare("syncer_exchange", "view")
 declare("syncer_view")
 declare("oom_check", "task_id", "fast_lane")
@@ -210,6 +216,13 @@ class ObjectTable:
         self._by_oid: Dict[bytes, bytes] = {}   #: guarded by self._lock
         self._ref_of: Dict[bytes, bytes] = {}   #: guarded by self._lock
         self._raw: Dict[bytes, Any] = {}        #: guarded by self._lock
+        # arena slots handed to external clients via get_ext_meta; the
+        # native lib has no slot-enumeration API, so leak observability
+        # (ray_tpu_arena_slot_refs) polls ext_refs() over this set. A
+        # SIGKILL'd client that never dropped its grant stays visible
+        # here instead of silently pinning arena bytes (docs/
+        # object_plane.md "limitations").
+        self._ext_slots: Dict[int, bytes] = {}  #: guarded by self._lock
         self._shm = None
         if sweep:
             # stale-segment hygiene: a SIGKILL'd predecessor daemon of
@@ -276,6 +289,8 @@ class ObjectTable:
             off, size, slot = self._shm.get_ext(oid)
         except Exception:
             return None
+        with self._lock:
+            self._ext_slots[slot] = oid
         return (self.arena_name, self.capacity, off, size, slot)
 
     def ext_release(self, slot: int) -> None:
@@ -284,6 +299,34 @@ class ObjectTable:
                 self._shm.ext_release(slot)
             except Exception:
                 pass
+
+    def slot_ref_stats(self) -> Dict[str, int]:
+        """{"held": slots with outstanding external refs, "refs": total
+        outstanding external refs} over every slot ever granted via
+        get_ext_meta. Fully-released slots leave tracking here; what
+        remains with refs > 0 is either live readers or a leaked grant
+        (SIGKILL'd client). Zeros on the dict-only fallback."""
+        if self._shm is None:
+            return {"held": 0, "refs": 0}
+        with self._lock:
+            slots = list(self._ext_slots.items())
+        held = refs = 0
+        released = []
+        for slot, _oid in slots:
+            try:
+                n = int(self._shm.ext_refs(slot))
+            except Exception:
+                n = 0
+            if n > 0:
+                held += 1
+                refs += n
+            else:
+                released.append(slot)
+        if released:
+            with self._lock:
+                for slot in released:
+                    self._ext_slots.pop(slot, None)
+        return {"held": held, "refs": refs}
 
     def release(self, oid: bytes) -> None:
         if self._shm is not None:
@@ -710,6 +753,9 @@ class _BatchReplyPump:
         conn.push("task_batch_done", outcomes=[o for o, _ in chunk])
         if conn.closed:     # push swallows transport failure into closed
             return False
+        dwell = max((now - t for _, t in chunk if t), default=0.0)
+        if dwell:
+            _metrics.note_queue_dwell("daemon.reply_pump", dwell)
         if self.task_events is not None:
             self._record_flush_spans(chunk, now)
         return True
@@ -904,6 +950,10 @@ class DaemonService:
         if _lm.log_to_driver_enabled():
             self._log_monitor = _lm.LogMonitor(
                 _lm.session_log_dir(), self._forward_worker_log)
+        # continuous profiler (profiling_hz knob, default off): this
+        # daemon's record plus worker records ingested off result
+        # frames ship to the head each heartbeat (main loop)
+        _profiling.maybe_start_from_config(f"daemon:{node_id_hex[:8]}")
 
     # -- fast lane (native core) workers --------------------------------
     def _fast_dedicate_worker(self):
@@ -2222,6 +2272,40 @@ class DaemonService:
                 "actors": len(
                     self.runtime.process_router._actor_workers)}
 
+    @rpc.concurrent
+    def handle_profile_burst(self, conn, rid, msg):
+        """On-demand stack-sampling burst: this daemon plus every live
+        pool worker, one record per process. Blocks ~duration
+        (@concurrent: runs off the connection lane)."""
+        duration = max(0.1, min(float(msg.get("duration") or 2.0), 30.0))
+        from ray_tpu._private import worker_process as _wp
+        procs: Dict[str, Dict[str, Any]] = {}
+        workers = list(_wp.live_workers())
+        threads = []
+        for w in workers:
+            def burst_one(w=w):
+                try:
+                    rec = w.profile_burst(duration)
+                    if isinstance(rec, dict) and rec.get("proc"):
+                        procs[rec["proc"]] = rec
+                except Exception:
+                    pass    # a dying worker must not fail the burst
+            t = threading.Thread(target=burst_one, daemon=True,
+                                 name="profile-burst-worker")
+            t.start()
+            threads.append(t)
+        own = _profiling.burst_record(
+            f"daemon:{self.node_id.hex()[:8]}", duration_s=duration)
+        for t in threads:
+            t.join(timeout=duration + 10.0)
+        procs[own["proc"]] = own
+        # continuous-mode records (own sampler + result-frame ingests)
+        # ride along so burst consumers see the low-rate history too
+        node = _profiling.node_profile()
+        for rec in (node or {}).get("procs", []):
+            procs.setdefault(rec.get("proc", "?"), rec)
+        return {"procs": list(procs.values())}
+
     def handle_daemon_stop(self, conn, rid, msg):
         def stop():
             time.sleep(0.1)
@@ -2231,6 +2315,63 @@ class DaemonService:
 
         threading.Thread(target=stop, daemon=True).start()
         return {"ok": True}
+
+
+# profile-flush cadence: cumulative snapshots, so a lower rate than
+# spans costs nothing but staleness
+_PROFILE_PUSH_S = 2.0
+
+
+def _gate_profile_flush(last_push: float,
+                        now: Optional[float] = None,
+                        period: float = _PROFILE_PUSH_S):
+    """The heartbeat's profile payload, or None (off-cadence, nothing
+    sampled, or lost to the ``profile.flush`` seam). Records are
+    CUMULATIVE and the head stores them with replace semantics, so the
+    retry discipline is the trace.flush one: the caller advances its
+    cadence stamp only on an acked beat — a dropped payload is re-sent
+    (fresher) on the next beat."""
+    now = time.monotonic() if now is None else now
+    if now - last_push < period:
+        return None
+    try:
+        payload = _profiling.node_profile()
+    except Exception:
+        return None
+    if payload is not None and _fp.ENABLED:
+        try:
+            if _fp.fire("profile.flush",
+                        procs=len(payload.get("procs", []))) is _fp.DROP:
+                payload = None
+        except Exception:
+            payload = None
+    return payload
+
+
+def _publish_object_plane_metrics(service: DaemonService) -> None:
+    """Leak + transfer observability gauges, refreshed each beat so
+    they ride the metrics snapshot to the head: arena slot grants still
+    referenced (a SIGKILL'd client's leaked grant shows up here) and
+    the push engine's cumulative/in-flight counters."""
+    from ray_tpu.util.metrics import Gauge
+    slots = service.objects.slot_ref_stats()
+    g = Gauge("ray_tpu_arena_slot_refs",
+              "external arena slot grants: slots still referenced "
+              "('held') and total outstanding refs ('refs')",
+              tag_keys=("state",))
+    g.set(float(slots["held"]), tags={"state": "held"})
+    g.set(float(slots["refs"]), tags={"state": "refs"})
+    push = Gauge("ray_tpu_push_stats",
+                 "object-plane push engine counters (cumulative), "
+                 "tx = PushManager, rx = PushReceiver",
+                 tag_keys=("side", "stat"))
+    for stat, v in service.pushes.stats.items():
+        push.set(float(v), tags={"side": "tx", "stat": stat})
+    for stat, v in service.push_rx.stats.items():
+        push.set(float(v), tags={"side": "rx", "stat": stat})
+    Gauge("ray_tpu_push_inflight",
+          "pushes queued or transferring right now").set(
+        float(service.pushes.inflight_count()))
 
 
 def main() -> None:
@@ -2321,6 +2462,7 @@ def main() -> None:
     trace_cursor = 0
     last_metrics_push = 0.0
     last_trace_push = 0.0
+    last_profile_push = 0.0
     _METRICS_PUSH_S = 1.0
     _TRACE_PUSH_S = 0.5     # span-flush cadence: bounds head-store
     _TRACE_BATCH_MAX = 2000  # write rate under bursty task loads
@@ -2338,6 +2480,7 @@ def main() -> None:
             service.push_rx.sweep()
             _tiers.publish_tier_bytes(_tiers.TIER_HOST,
                                       service.objects.used_bytes())
+            _publish_object_plane_metrics(service)
         except Exception:
             pass
         span_batch = []
@@ -2360,10 +2503,12 @@ def main() -> None:
                 snapshot = export_snapshot()
             except Exception:
                 snapshot = None
+        profile = _gate_profile_flush(last_profile_push)
         try:
             out = head.heartbeat(args.node_id, resources,
                                  wall_ts=time.time(),
-                                 events=span_batch, metrics=snapshot)
+                                 events=span_batch, metrics=snapshot,
+                                 profile=profile)
             # advance the cursor ONLY on an acknowledged beat: an
             # "unknown" reply (restarted head, pre-re-register) returns
             # BEFORE ingesting the events — advancing would lose the
@@ -2374,6 +2519,8 @@ def main() -> None:
                     last_trace_push = time.monotonic()
                 if snapshot is not None:
                     last_metrics_push = time.monotonic()
+                if profile is not None:
+                    last_profile_push = time.monotonic()
         except rpc.RpcError:
             head.close()
             new_head = reconnect()

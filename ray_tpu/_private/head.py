@@ -73,8 +73,9 @@ declare("register_node", "node_id", "resources", "labels", "addr")
 # batch for the task-event store), ``metrics`` (absolute metric snapshot
 # federated into the cluster /metrics view) — all optional/empty.
 declare("heartbeat", "node_id", "available", "wall_ts", "events",
-        "metrics")
+        "metrics", "profile")
 declare("metrics_get")
+declare("profile_get")
 declare("list_nodes")
 declare("drain_node", "node_id", "deadline_s", "reason")
 declare("mark_node_dead", "node_id", "reason")
@@ -255,6 +256,11 @@ class HeadService:
         #: guarded by self._lock
         self._node_metrics: Dict[str, List[Dict[str, Any]]] = {}
         self._node_clock_off: Dict[str, float] = {}  #: guarded by self._lock
+        # profile federation: node_id -> latest CUMULATIVE profile
+        # payload off that daemon's heartbeat (replace semantics — the
+        # counters only grow, so the newest payload supersedes all)
+        #: guarded by self._lock
+        self._node_profiles: Dict[str, Dict[str, Any]] = {}
         # node_id -> (wall-clock deadline, reason): drains survive a
         # head restart (membership does not, so the record re-attaches
         # when the draining daemon re-registers after the respawn).
@@ -338,6 +344,9 @@ class HeadService:
             snapshot = msg.get("metrics")
             if snapshot is not None:
                 self._node_metrics[node_id] = snapshot
+            profile = msg.get("profile")
+            if profile is not None:
+                self._node_profiles[node_id] = profile
         if was_dead:
             # A heartbeat from a node we declared dead: tell it to exit
             # (reference: raylets that lost GCS contact must not rejoin
@@ -361,6 +370,18 @@ class HeadService:
         with self._lock:
             return {"nodes": {nid: snap for nid, snap
                               in self._node_metrics.items()}}
+
+    def handle_profile_get(self, conn, rid, msg):
+        """Federated per-node profile payloads (daemon heartbeats) plus
+        the head's own continuous-sampler record."""
+        with self._lock:
+            nodes = dict(self._node_profiles)
+        try:
+            from ray_tpu.util import profiling as _profiling
+            own = _profiling.process_profile()
+        except Exception:
+            own = None
+        return {"nodes": nodes, "head": own}
 
     def handle_list_nodes(self, conn, rid, msg):
         with self._lock:
@@ -427,6 +448,7 @@ class HeadService:
             # the dicts must not grow forever under node churn)
             self._node_metrics.pop(node_id, None)
             self._node_clock_off.pop(node_id, None)
+            self._node_profiles.pop(node_id, None)
             if self._store is not None:
                 self._store.delete(_DRAIN_KEY + node_id.encode())
         self._publish("node", {"kind": "death", "node_id": node_id,
@@ -680,16 +702,22 @@ class HeadClient:
     def heartbeat(self, node_id: str, available: Dict[str, float],
                   wall_ts: float = 0.0,
                   events: Optional[List[Dict[str, Any]]] = None,
-                  metrics: Optional[List[Dict[str, Any]]] = None):
+                  metrics: Optional[List[Dict[str, Any]]] = None,
+                  profile: Optional[Dict[str, Any]] = None):
         return self._call("heartbeat", node_id=node_id,
                           available=available, wall_ts=wall_ts,
                           events=events or [], metrics=metrics,
-                          timeout=5.0)
+                          profile=profile, timeout=5.0)
 
     def metrics_get(self) -> Dict[str, List[Dict[str, Any]]]:
         """node_id -> latest federated metric snapshot. Bounded: a
         wedged head must not hang a dashboard scrape thread forever."""
         return self._call("metrics_get", timeout=5.0)["nodes"]
+
+    def profile_get(self) -> Dict[str, Any]:
+        """{"nodes": node_id -> federated profile payload, "head": the
+        head's own record or None}."""
+        return self._call("profile_get", timeout=5.0)
 
     def list_nodes(self) -> List[Dict[str, Any]]:
         return self._call("list_nodes")["nodes"]
@@ -841,6 +869,11 @@ def main() -> None:
     args = parser.parse_args()
     server = Server(HeadService(state_path=args.state_path or None),
                     host=args.host, port=args.port).start()
+    try:    # continuous profiler (profiling_hz knob; default off)
+        from ray_tpu.util import profiling as _profiling
+        _profiling.maybe_start_from_config("head")
+    except Exception:
+        pass
     if args.announce_fd >= 0:
         import os
 

@@ -28,6 +28,7 @@ from ray_tpu._private.ids import ActorID, NodeID
 from ray_tpu._private.lock_sanitizer import tracked_lock
 from ray_tpu._private.object_store import LocalObjectStore
 from ray_tpu._private.task_spec import TaskKind, TaskSpec
+from ray_tpu.util import metrics as _metrics
 
 _DISPATCH_POLL_S = 5.0
 
@@ -597,6 +598,8 @@ class Node:
                             lag_ms = (t0 - spec.enqueued_at) * 1000
                             if lag_ms > self.loop_stats["max_queue_lag_ms"]:
                                 self.loop_stats["max_queue_lag_ms"] = lag_ms
+                            _metrics.note_queue_dwell(
+                                "node.dispatch", lag_ms / 1000.0)
                             if getattr(spec, "trace_sampled", False):
                                 # queue phase: backlog enqueue ->
                                 # dispatch-loop admission. t0 is reused

@@ -426,7 +426,13 @@ class Server:
                 if not conn._lane:
                     conn._lane_busy = False
                     return
-                handler, rid, msg = conn._lane.popleft()
+                handler, rid, msg, t_enq = conn._lane.popleft()
+            try:    # lane dwell: time queued behind same-peer requests
+                from ray_tpu.util.metrics import note_queue_dwell
+                note_queue_dwell("rpc.lane",
+                                 time.perf_counter() - t_enq)
+            except Exception:
+                pass
             try:
                 self._run_handler(conn, handler, rid, msg)
             except BaseException:   # never wedge the lane
@@ -479,7 +485,8 @@ class Server:
                         name=f"rpc-conc-{method}").start()
                     continue
                 with conn._lane_lock:
-                    conn._lane.append((handler, rid, msg))
+                    conn._lane.append((handler, rid, msg,
+                                       time.perf_counter()))
                     if conn._lane_busy:
                         continue
                     conn._lane_busy = True

@@ -617,6 +617,23 @@ class _WorkerState:
                 for p in msg.get("paths", []):
                     if p not in _sys.path:
                         _sys.path.append(p)
+            elif op == "profile_burst":
+                # on-demand stack sampling; a thread so the burst never
+                # blocks the op loop (results keep flowing while it runs)
+                def _burst(msg=msg):
+                    try:
+                        from ray_tpu.util import profiling as _prof
+                        rec = _prof.burst_record(
+                            f"worker:{os.getpid()}",
+                            duration_s=float(msg.get("duration") or 2.0))
+                        self.send({"id": msg["id"], "op": "result",
+                                   "ok": True,
+                                   "blob": cloudpickle.dumps(rec)})
+                    except BaseException as e:  # noqa: BLE001 — shipped
+                        self.send({"id": msg["id"], "op": "result",
+                                   "ok": False, "blob": _dump_exc(e)})
+                threading.Thread(target=_burst, daemon=True,
+                                 name="profile-burst").start()
             elif op == "join_fast_lane":
                 # dedicate this worker to the native daemon core's task
                 # lane (fast_lane.py); the mp channel stays open for
@@ -896,12 +913,14 @@ class _WorkerState:
             self._flush_metrics()
             self.send({"id": rid, "op": "result", "ok": True,
                        "span": exec_span(),
+                       "profile": _result_profile(),
                        "blob": _safe_dumps(result)})
         except BaseException as e:  # noqa: BLE001 — shipped to host
             try:
                 self._flush_metrics()
                 self.send({"id": rid, "op": "result", "ok": False,
                            "span": exec_span(),
+                           "profile": _result_profile(),
                            "blob": _dump_exc(e)})
             except (BrokenPipeError, OSError):
                 os._exit(1)
@@ -918,6 +937,30 @@ class _WorkerState:
                 self.call_host("metrics_push", entries=deltas)
         except Exception:
             pass
+
+
+# Worker profile piggyback (the span discipline): the CUMULATIVE
+# continuous-sampler record rides at most one result frame per second;
+# the host ingests it into profiling's remote store and the daemon's
+# heartbeat ships it to the head. None (the common case) costs one
+# cloudpickle'd NoneType on the frame.
+_PROFILE_RESULT_S = 1.0
+_last_profile_sent = [0.0]
+
+
+def _result_profile():
+    try:
+        from ray_tpu.util import profiling as _prof
+        rec = _prof.process_profile()
+        if rec is None:
+            return None
+        now = time.monotonic()
+        if now - _last_profile_sent[0] < _PROFILE_RESULT_S:
+            return None
+        _last_profile_sent[0] = now
+        return rec
+    except Exception:
+        return None
 
 
 def _post_mortem_on_error():
@@ -967,6 +1010,13 @@ def _child_main(conn) -> None:
         pin_cpu_env(boot.get("cpu_devices"))
     from ray_tpu._private import worker as worker_mod
 
+    # continuous profiler (profiling_hz via the env the host shipped in
+    # boot["env"] / inherited from the forkserver template; default off)
+    try:
+        from ray_tpu.util import profiling as _prof
+        _prof.maybe_start_from_config(f"worker:{os.getpid()}")
+    except Exception:
+        pass
     state = _WorkerState(conn, boot)
     worker_mod._global_runtime = state.proxy  # type: ignore[assignment]
     state.serve_forever()
@@ -1369,6 +1419,15 @@ class WorkerClient:
                             [msg["span"]])
                     except Exception:
                         pass
+                if op == "result" and msg.get("profile") is not None:
+                    # worker profile piggyback (the span discipline):
+                    # into this process's store; the daemon heartbeat
+                    # (or a driver-side cluster_profile) federates it
+                    try:
+                        from ray_tpu.util import profiling as _prof
+                        _prof.ingest_profile(msg["profile"])
+                    except Exception:
+                        pass
                 with self._pending_lock:
                     pend = self._pending.get(msg["id"])
                 if pend is not None:
@@ -1519,6 +1578,27 @@ class WorkerClient:
         with self._pending_lock:
             self._pending.pop(rid, None)
         self._holds.pop(rid, None)
+
+    def profile_burst(self, duration: float = 2.0):
+        """Sample this worker's stacks for ``duration`` seconds; returns
+        the profile record, or None if the worker died mid-burst."""
+        rid, pend = self._request({"op": "profile_burst",
+                                   "duration": float(duration)})
+        try:
+            msg = pend.q.get(timeout=duration + 10.0)
+        except queue.Empty:
+            self._finish(rid)
+            return None
+        if msg is _DEAD:
+            self._finish(rid)
+            return None
+        ok = msg.get("ok")
+        blob = msg.get("blob")
+        self._finish(rid)
+        if not ok or blob is None:
+            return None
+        rec = cloudpickle.loads(blob)
+        return rec if isinstance(rec, dict) else None
 
     # Daemons run no user code: with raw_outcomes they hand result blobs
     # through without unpickling (the owner deserializes at the edge).

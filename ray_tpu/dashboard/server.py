@@ -12,6 +12,9 @@ Endpoints (reference: dashboard modules `node`, `state`, `metrics`,
                               and worker lanes (head-store spans with
                               clock correction applied)
   GET /api/config             resolved flag table + provenance
+  GET /api/profile            cluster-wide stack profile as speedscope
+                              JSON (burst fan-out + head aggregates;
+                              ?duration=N seconds, clamped to 30)
   GET /api/metrics            cluster-wide metric samples as JSON
   GET /metrics                CLUSTER-WIDE Prometheus exposition: this
                               process's registry merged with every
@@ -68,6 +71,13 @@ class _DashboardHandler(BaseHTTPRequestHandler):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif path == "/api/profile":
+                # cluster-wide stack profile: burst fan-out to every
+                # process + the head's federated continuous aggregates,
+                # as a speedscope document (one lane per process)
+                out = state_api.cluster_profile(
+                    duration_s=min(float(query.get("duration", 2)), 30))
+                self._json(out["speedscope"])
             elif path == "/api/profile/cpu":
                 from ray_tpu.util.profiling import sample_cpu_profile
                 self._json(sample_cpu_profile(
@@ -145,7 +155,8 @@ class _DashboardHandler(BaseHTTPRequestHandler):
                     "/api/placement_groups", "/api/objects",
                     "/api/cluster_status", "/api/timeline", "/api/config",
                     "/api/serve", "/api/train", "/api/data",
-                    "/api/profile/cpu", "/api/profile/memory",
+                    "/api/profile", "/api/profile/cpu",
+                    "/api/profile/memory",
                     "/api/metrics", "/metrics", "/"]})
             else:
                 self._json({"error": f"unknown path {path}"}, 404)

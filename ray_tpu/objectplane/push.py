@@ -72,6 +72,11 @@ class PushManager:
             threading.Thread(target=self._loop, daemon=True,
                              name=f"push-worker-{i}").start()
 
+    def inflight_count(self) -> int:
+        """Pushes currently queued or transferring (dedupe-table size)."""
+        with self._cv:
+            return len(self._inflight)
+
     def request(self, oid: bytes, to_addr, ref: bytes = b"") -> _Push:
         """Enqueue (or join) a push; callers may wait on the returned
         event or fire-and-forget."""

@@ -72,6 +72,25 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """Cluster-wide stack profile: on-demand burst fan-out to every
+    process (driver / daemons / workers) merged with the head's
+    federated continuous aggregates, written as speedscope JSON (one
+    lane per process — the profiling counterpart of `ray-tpu
+    timeline`)."""
+    _init_runtime(args)
+    from ray_tpu.util import state as st
+    node = args.node if not args.all else None
+    out = st.cluster_profile(duration_s=args.duration, node=node,
+                             path=args.output, fmt=args.format)
+    for rec in out["records"]:
+        print(f"  {rec['proc']:<24} {rec.get('mode', '?'):<10} "
+              f"{rec.get('samples', 0):>6} samples")
+    print(f"wrote {args.format} profile ({len(out['records'])} "
+          f"processes) to {args.output}")
+    return 0
+
+
 def cmd_microbenchmark(args) -> int:
     from ray_tpu._private.perf import run_microbenchmarks
     for row in run_microbenchmarks(duration_s=args.duration):
@@ -429,6 +448,19 @@ def main(argv=None) -> int:
     sub.add_parser("memory")
     p = sub.add_parser("timeline")
     p.add_argument("--output", default="/tmp/ray_tpu_timeline.json")
+    p = sub.add_parser("profile")
+    p.add_argument("--node", default="",
+                   help="profile only the daemon whose node id (hex) "
+                        "starts with this prefix")
+    p.add_argument("--all", action="store_true",
+                   help="whole cluster: driver + every daemon/worker + "
+                        "head aggregates (the default when --node is "
+                        "not given)")
+    p.add_argument("--duration", type=float, default=2.0,
+                   help="burst sampling window in seconds")
+    p.add_argument("--output", default="/tmp/ray_tpu_profile.json")
+    p.add_argument("--format", choices=["speedscope", "collapsed"],
+                   default="speedscope")
     p = sub.add_parser("microbenchmark")
     p.add_argument("--duration", type=float, default=2.0)
     p = sub.add_parser("dashboard")
@@ -479,6 +511,7 @@ def main(argv=None) -> int:
         "cluster-status": cmd_cluster_status, "drain": cmd_drain,
         "status": cmd_status, "summary": cmd_summary,
         "memory": cmd_memory, "timeline": cmd_timeline,
+        "profile": cmd_profile,
         "microbenchmark": cmd_microbenchmark, "dashboard": cmd_dashboard,
         "serve-deploy": cmd_serve_deploy, "job-submit": cmd_job_submit,
         "up": cmd_up, "down": cmd_down, "attach": cmd_attach,

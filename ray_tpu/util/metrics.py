@@ -253,6 +253,36 @@ def _fmt_labels(key: Tuple) -> str:
 
 
 # ---------------------------------------------------------------------------
+# queue-dwell gauges (observability for the control-plane hot loops:
+# node dispatch, daemon reply pump, rpc server lane). Like the rpc wire
+# counters, updates are PLAIN dict stores — single writer per queue
+# name, last-value-wins gauge semantics, so a rare lost store under a
+# race is acceptable and the hot path pays no lock.
+# ---------------------------------------------------------------------------
+
+_QUEUE_DWELL: Dict[str, float] = {}
+
+
+def note_queue_dwell(queue: str, seconds: float) -> None:
+    """Record how long the most recent item sat queued before service
+    (``ray_tpu_queue_dwell_seconds{queue}``)."""
+    _QUEUE_DWELL[queue] = seconds
+
+
+def queue_dwell_entries() -> List[Dict]:
+    """Dwell gauges in the export_snapshot wire-entry format."""
+    if not _QUEUE_DWELL:
+        return []
+    return [{
+        "name": "ray_tpu_queue_dwell_seconds", "kind": "gauge",
+        "description": "seconds the most recently serviced item waited "
+                       "in a control-plane queue",
+        "samples": [[[["queue", q]], v]
+                    for q, v in sorted(_QUEUE_DWELL.items())],
+    }]
+
+
+# ---------------------------------------------------------------------------
 # cluster federation (reference: per-process OpenCensus registries merged
 # into ONE Prometheus view by the metrics agent). Each process exports a
 # wire-plain snapshot of its registry; daemons ship theirs to the head on
@@ -288,6 +318,12 @@ def export_snapshot() -> List[Dict]:
         out.extend(_rpc.wire_metric_entries())
     except Exception:
         pass
+    try:    # lock wait/hold meters (lock_sanitizer's metering mode)
+        from ray_tpu._private import lock_sanitizer as _ls
+        out.extend(_ls.lock_metric_entries())
+    except Exception:
+        pass
+    out.extend(queue_dwell_entries())
     return out
 
 

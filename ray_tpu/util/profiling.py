@@ -1,4 +1,4 @@
-"""On-demand profiling: stack sampler (py-spy role) + tracemalloc.
+"""Cluster profiling: stack sampler (py-spy role) + tracemalloc.
 
 Reference: ``dashboard/modules/reporter/profile_manager.py:82`` shells
 out to py-spy (CPU flamegraph) and memray (heap). Neither tool is in
@@ -11,15 +11,36 @@ this image, so both capabilities are in-process and stdlib-only:
   Unlike cProfile it sees ALL threads and adds no per-call overhead.
 - :func:`memory_snapshot` — tracemalloc top allocations (started lazily
   on first use), the memray-lite view.
+
+Cluster-wide layer (docs/observability.md "Profiling & contention"):
+
+- :class:`ContinuousSampler` — an opt-in low-rate daemon thread
+  (``profiling_hz`` knob, default off) aggregating every thread's stack
+  into CUMULATIVE collapsed-stack counters. Cumulative + monotonic by
+  design: pruning folds excess stacks into a ``<pruned>`` bucket, so a
+  snapshot always supersedes every earlier one and the transport can
+  use replace semantics (a dropped flush is healed by the next send —
+  the same retry discipline as ``trace.flush``).
+- :func:`ingest_profile` / :func:`node_profile` — the node-local store:
+  workers piggyback their profile records on result frames (next to
+  spans); the host ingests them here and the daemon heartbeat ships
+  ``node_profile()`` to the head.
+- :func:`burst_record` — on-demand high-rate burst in record form, the
+  ``ray-tpu profile`` / ``profile_burst`` RPC payload.
+- :func:`merged_collapsed` / :func:`speedscope_document` — render a set
+  of per-process records as one collapsed-stack text or one speedscope
+  JSON document with a lane per process (mirroring
+  ``merged_chrome_trace``).
 """
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 import time
 from collections import Counter
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 
 def _collapse(frame, thread_name: str) -> str:
@@ -108,3 +129,264 @@ def stop_memory_tracing() -> None:
 
     if tracemalloc.is_tracing():
         tracemalloc.stop()
+
+
+# ---------------------------------------------------------------------------
+# continuous sampling (cluster-wide layer)
+# ---------------------------------------------------------------------------
+
+# Collapsed-stack cap per record. Pruning keeps the TOP stacks and folds
+# the tail's weight into one synthetic "<pruned>" stack so totals stay
+# monotonic (replace-semantics transport depends on it).
+MAX_STACKS = 2000
+PRUNED_STACK = "<pruned>"
+
+
+def _sample_once(counts: Counter, skip_ident: int) -> int:
+    """One tick over ``sys._current_frames()`` into ``counts``; returns
+    the number of thread stacks recorded."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    n = 0
+    for ident, frame in sys._current_frames().items():
+        if ident == skip_ident:
+            continue
+        counts[_collapse(frame, names.get(ident, f"thread-{ident}"))] += 1
+        n += 1
+    return n
+
+
+def _prune(counts: Counter) -> None:
+    if len(counts) <= MAX_STACKS:
+        return
+    keep = counts.most_common(MAX_STACKS - 1)
+    folded = sum(counts.values()) - sum(n for _, n in keep)
+    counts.clear()
+    counts.update(dict(keep))
+    counts[PRUNED_STACK] += folded
+
+
+class ContinuousSampler:
+    """Low-rate background stack sampler with cumulative counters.
+
+    ``snapshot()`` is safe from any thread and always returns a record
+    that supersedes every earlier one (counts only grow; see
+    :data:`PRUNED_STACK`)."""
+
+    def __init__(self, proc: str, hz: float):
+        self.proc = proc
+        self.hz = float(hz)
+        self._lock = threading.Lock()
+        #: guarded by self._lock
+        self._counts: Counter = Counter()
+        #: guarded by self._lock
+        self._samples = 0
+        self._started = time.time()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ContinuousSampler":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"profiler-{self.proc}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=1.0)
+
+    def _run(self) -> None:
+        me = threading.get_ident()
+        interval = 1.0 / max(self.hz, 0.1)
+        local: Counter = Counter()
+        while not self._stop.wait(interval):
+            local.clear()
+            n = _sample_once(local, me)
+            with self._lock:
+                self._samples += 1
+                self._counts.update(local)
+                if n:
+                    _prune(self._counts)
+
+    def snapshot(self) -> Optional[Dict[str, Any]]:
+        """Cumulative record, or None before the first non-empty tick."""
+        with self._lock:
+            if not self._counts:
+                return None
+            counts = dict(self._counts)
+            samples = self._samples
+        return {"proc": self.proc, "pid": os.getpid(),
+                "mode": "continuous", "hz": self.hz,
+                "samples": samples, "since": self._started,
+                "counts": counts}
+
+
+_SAMPLER_LOCK = threading.Lock()
+#: guarded by _SAMPLER_LOCK (the process-wide continuous sampler slot)
+_SAMPLER: Optional[ContinuousSampler] = None
+
+
+def start_process_sampler(proc: str,
+                          hz: Optional[float] = None
+                          ) -> Optional[ContinuousSampler]:
+    """Start (or return) this process's continuous sampler. ``hz=None``
+    reads the ``profiling_hz`` config knob; <= 0 leaves sampling off."""
+    global _SAMPLER
+    if hz is None:
+        try:
+            from ray_tpu._private.config import cfg
+            hz = float(cfg().profiling_hz)
+        except Exception:
+            hz = 0.0
+    if hz <= 0:
+        return None
+    with _SAMPLER_LOCK:
+        if _SAMPLER is not None:
+            return _SAMPLER
+        _SAMPLER = ContinuousSampler(proc, hz).start()
+        return _SAMPLER
+
+
+def maybe_start_from_config(proc: str) -> Optional[ContinuousSampler]:
+    """Config-gated start; never raises (runtime boot path)."""
+    try:
+        return start_process_sampler(proc, hz=None)
+    except Exception:
+        return None
+
+
+def stop_process_sampler() -> None:
+    global _SAMPLER
+    with _SAMPLER_LOCK:
+        s, _SAMPLER = _SAMPLER, None
+    if s is not None:
+        s.stop()
+
+
+def process_profile() -> Optional[Dict[str, Any]]:
+    """This process's cumulative continuous-sampler record (or None)."""
+    with _SAMPLER_LOCK:
+        s = _SAMPLER
+    return s.snapshot() if s is not None else None
+
+
+# Records pushed from child processes (workers piggyback them on result
+# frames the way spans ride; the host _read_loop ingests here). Keyed by
+# proc name; a later record replaces the earlier one (cumulative).
+_REMOTE_LOCK = threading.Lock()
+#: guarded by _REMOTE_LOCK
+_REMOTE: Dict[str, Dict[str, Any]] = {}
+
+
+def ingest_profile(record: Any) -> None:
+    """Store a child process's profile record (tolerant: bad payloads
+    are dropped, never raised — this sits on the result hot path)."""
+    if not isinstance(record, dict) or not record.get("proc"):
+        return
+    if not isinstance(record.get("counts"), dict):
+        return
+    with _REMOTE_LOCK:
+        _REMOTE[str(record["proc"])] = record
+
+
+def remote_profiles() -> List[Dict[str, Any]]:
+    with _REMOTE_LOCK:
+        return list(_REMOTE.values())
+
+
+def node_profile() -> Optional[Dict[str, Any]]:
+    """Everything this process knows: its own continuous record plus
+    ingested child records — the daemon's heartbeat payload. None when
+    there is nothing to ship (keeps heartbeats lean with profiling
+    off)."""
+    procs: List[Dict[str, Any]] = []
+    own = process_profile()
+    if own is not None:
+        procs.append(own)
+    procs.extend(remote_profiles())
+    if not procs:
+        return None
+    return {"procs": procs, "ts": time.time()}
+
+
+def burst_record(proc: str, duration_s: float = 2.0,
+                 hz: float = 100.0) -> Dict[str, Any]:
+    """On-demand burst in record form (same shape as a continuous
+    snapshot) — the ``profile_burst`` RPC / ``ray-tpu profile``
+    payload. Runs inline in the calling thread."""
+    counts: Counter = Counter()
+    me = threading.get_ident()
+    interval = 1.0 / max(hz, 1.0)
+    samples = 0
+    deadline = time.monotonic() + max(duration_s, interval)
+    while time.monotonic() < deadline:
+        _sample_once(counts, me)
+        samples += 1
+        _prune(counts)
+        time.sleep(interval)
+    return {"proc": proc, "pid": os.getpid(), "mode": "burst",
+            "hz": hz, "samples": samples, "wall_s": duration_s,
+            "counts": dict(counts)}
+
+
+# ---------------------------------------------------------------------------
+# rendering: merged collapsed text + speedscope JSON (lane per process)
+# ---------------------------------------------------------------------------
+
+def merged_collapsed(records: List[Dict[str, Any]]) -> str:
+    """flamegraph.pl input over many process records: each line is
+    ``proc;thread;frame;... count``, heaviest first."""
+    lines: List[str] = []
+    for rec in records:
+        proc = rec.get("proc", "?")
+        counts = rec.get("counts") or {}
+        for stack, n in sorted(counts.items(),
+                               key=lambda kv: (-kv[1], kv[0])):
+            lines.append(f"{proc};{stack} {n}")
+    return "\n".join(lines)
+
+
+def speedscope_document(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """One speedscope file, one "sampled" profile lane per process
+    record (mirroring merged_chrome_trace's one-lane-per-process
+    layout). Weights are sample counts (unit "none")."""
+    frames: List[Dict[str, str]] = []
+    index: Dict[str, int] = {}
+
+    def frame_idx(name: str) -> int:
+        i = index.get(name)
+        if i is None:
+            i = index[name] = len(frames)
+            frames.append({"name": name})
+        return i
+
+    profiles: List[Dict[str, Any]] = []
+    for rec in records:
+        samples: List[List[int]] = []
+        weights: List[int] = []
+        counts = rec.get("counts") or {}
+        for stack, n in sorted(counts.items(),
+                               key=lambda kv: (-kv[1], kv[0])):
+            samples.append([frame_idx(tok)
+                            for tok in stack.split(";") if tok])
+            weights.append(int(n))
+        total = sum(weights)
+        profiles.append({
+            "type": "sampled",
+            "name": f"{rec.get('proc', '?')} "
+                    f"({rec.get('mode', '?')}, pid {rec.get('pid', 0)})",
+            "unit": "none",
+            "startValue": 0,
+            "endValue": total,
+            "samples": samples,
+            "weights": weights,
+        })
+    return {
+        "$schema": "https://www.speedscope.app/file-format-schema.json",
+        "shared": {"frames": frames},
+        "profiles": profiles,
+        "name": "ray_tpu cluster profile",
+        "exporter": "ray_tpu.util.profiling",
+    }

@@ -1,8 +1,8 @@
 """State API (reference: `python/ray/util/state/api.py` + `state_cli.py`
 — programmatic cluster introspection over GCS/dashboard)."""
 
-from ray_tpu.util.state.api import (cluster_timeline, list_actors,
-                                    list_nodes, list_objects,
+from ray_tpu.util.state.api import (cluster_profile, cluster_timeline,
+                                    list_actors, list_nodes, list_objects,
                                     list_placement_groups, list_tasks,
                                     list_tasks_from_head, summarize_tasks,
                                     task_breakdown, timeline,
@@ -10,5 +10,5 @@ from ray_tpu.util.state.api import (cluster_timeline, list_actors,
 
 __all__ = ["list_tasks", "list_actors", "list_objects", "list_nodes",
            "list_placement_groups", "summarize_tasks", "timeline",
-           "cluster_timeline", "task_breakdown", "list_tasks_from_head",
-           "timeline_from_head"]
+           "cluster_timeline", "cluster_profile", "task_breakdown",
+           "list_tasks_from_head", "timeline_from_head"]

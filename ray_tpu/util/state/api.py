@@ -215,6 +215,92 @@ def cluster_timeline(path: Optional[str] = None) -> Any:
     return trace
 
 
+def cluster_profile(duration_s: float = 1.0, *,
+                    node: Optional[str] = None,
+                    path: Optional[str] = None,
+                    fmt: str = "speedscope") -> Dict[str, Any]:
+    """Cluster-wide stack profile, one record per process (the
+    ``ray-tpu profile`` backend): a burst on the driver, every
+    in-process pool worker, and (daemon topology) a ``profile_burst``
+    fan-out to each daemon + its workers, merged with the head's
+    federated continuous aggregates. Returns ``{"records", "speedscope",
+    "collapsed"}``; with ``path`` the chosen ``fmt`` ("speedscope" JSON
+    or "collapsed" text) is also written there."""
+    import threading as _threading
+
+    from ray_tpu.util import profiling as _profiling
+    rt = _rt()
+    records: Dict[str, Dict[str, Any]] = {}
+
+    def add(rec, replace=False):
+        if isinstance(rec, dict) and rec.get("proc"):
+            if replace or rec["proc"] not in records:
+                records[rec["proc"]] = rec
+
+    # daemon fan-out first (concurrent with the driver's own burst, so
+    # the wall clock stays ~duration_s instead of 2x)
+    backend = getattr(rt, "cluster_backend", None)
+    daemons = dict(getattr(backend, "daemons", None) or {})
+    if node:
+        daemons = {nid: h for nid, h in daemons.items()
+                   if nid.hex().startswith(node)}
+    threads = []
+    fanned: List[List[Dict[str, Any]]] = []
+    for handle in daemons.values():
+        def burst_one(handle=handle):
+            try:
+                fanned.append(handle.profile_burst(duration_s))
+            except Exception:
+                pass    # a dead daemon must not fail the profile
+        t = _threading.Thread(target=burst_one, daemon=True)
+        t.start()
+        threads.append(t)
+    # in-process pool workers (empty in the daemon topology)
+    from ray_tpu._private import worker_process as _wp
+    wthreads = []
+    if not node:
+        for w in _wp.live_workers():
+            def wburst(w=w):
+                add(w.profile_burst(duration_s), replace=True)
+            t = _threading.Thread(target=wburst, daemon=True)
+            t.start()
+            wthreads.append(t)
+        add(_profiling.burst_record("driver", duration_s=duration_s),
+            replace=True)
+    for t in threads + wthreads:
+        t.join(timeout=duration_s + 15.0)
+    for recs in fanned:
+        for rec in recs:
+            add(rec, replace=True)
+    # continuous-mode leftovers: the driver's sampler, result-frame
+    # worker ingests, and the head's federated per-node aggregates
+    for rec in (_profiling.node_profile() or {}).get("procs", []):
+        add(rec)
+    head = getattr(backend, "head", None)
+    if head is not None and not node:
+        try:
+            fed = head.profile_get()
+            add(fed.get("head"))
+            for payload in (fed.get("nodes") or {}).values():
+                for rec in (payload or {}).get("procs", []):
+                    add(rec)
+        except Exception:
+            pass
+    recs = sorted(records.values(), key=lambda r: r.get("proc", ""))
+    out = {"records": recs,
+           "speedscope": _profiling.speedscope_document(recs),
+           "collapsed": _profiling.merged_collapsed(recs)}
+    if path is not None:
+        import json as _json
+        with open(path, "w") as f:
+            if fmt == "collapsed":
+                f.write(out["collapsed"] + "\n")
+            else:
+                _json.dump(out["speedscope"], f)
+        out["path"] = path
+    return out
+
+
 def task_breakdown(task_id: str, *, address: Optional[str] = None
                    ) -> Dict[str, float]:
     """Per-phase latency vector for one task:

@@ -446,6 +446,7 @@ WIRED_SEAMS = [
     "batch.free_flush",
     "batch.result_flush",
     "trace.flush",
+    "profile.flush",
 ]
 
 

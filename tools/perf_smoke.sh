@@ -128,11 +128,13 @@ n_pg, dt_pg = ray_tpu.get(_put_get_1mib.remote(), timeout=60.0)
 results["put_get_1MiB_mbps"] = round(n_pg * 2 / dt_pg, 1)
 
 # probe 4: tracing overhead — the same burst with spans ON vs OFF.
-# Methodology: PAIRED bursts in one cluster with BALANCED ordering
+# Methodology: 3 PAIRED bursts in one cluster with BALANCED ordering
 # (on-first on even rounds, off-first on odd) and the MEDIAN of the
-# per-pair ratios. Anything weaker is a noise lottery on shared
-# hardware: single-burst scatter here is +-25%, the real overhead ~1%
-# (docs/observability.md). Budget: <= 5% on burst_submit_batched.
+# per-pair ratios; the raw per-pair ratios are printed with the verdict
+# so a trip is diagnosable from the CI log. Anything weaker is a noise
+# lottery on shared hardware: single-burst scatter here is +-25%, the
+# real overhead ~1% (docs/observability.md). Budget: <= 5% on
+# burst_submit_batched.
 import statistics  # noqa: E402
 
 from ray_tpu._private.config import apply_system_config  # noqa: E402
@@ -144,7 +146,7 @@ def traced_burst(on: bool) -> float:
 
 
 ratios = []
-for i in range(7):
+for i in range(3):
     if i % 2 == 0:
         r_on = traced_burst(True)
         r_off = traced_burst(False)
@@ -153,6 +155,32 @@ for i in range(7):
         r_on = traced_burst(True)
     ratios.append(r_on / r_off)
 apply_system_config(None)   # restore env/default flag resolution
+
+# probe 7: continuous-sampler overhead — the same burst with the
+# driver-process stack sampler ON (25 Hz, well above the suggested
+# production 5-10 Hz) vs OFF, same interleaved-median methodology as
+# the tracing row. Budget: <= 3% (docs/observability.md).
+from ray_tpu.util import profiling as _profiling  # noqa: E402
+
+
+def profiled_burst(on: bool) -> float:
+    if on:
+        _profiling.start_process_sampler("driver", hz=25.0)
+    else:
+        _profiling.stop_process_sampler()
+    return burst_batched(200)
+
+
+p_ratios = []
+for i in range(3):
+    if i % 2 == 0:
+        p_on = profiled_burst(True)
+        p_off = profiled_burst(False)
+    else:
+        p_off = profiled_burst(False)
+        p_on = profiled_burst(True)
+    p_ratios.append(p_on / p_off)
+_profiling.stop_process_sampler()
 
 # probe 5: serving data plane — a small OPEN-LOOP burst through a
 # 2-replica deployment via ray_tpu.loadgen (handle -> depth-aware P2C
@@ -191,17 +219,25 @@ slower = sum(1 for r in ratios if r < 1.0)
 consistent = slower >= len(ratios) - 1
 results["tracing_overhead_pct"] = round(overhead, 1)
 results["tracing_overhead_consistent"] = bool(consistent)
+p_overhead = max(0.0, (1.0 - statistics.median(p_ratios)) * 100.0)
+p_slower = sum(1 for r in p_ratios if r < 1.0)
+p_consistent = p_slower >= len(p_ratios) - 1
+results["profiling_overhead_pct"] = round(p_overhead, 1)
+results["profiling_overhead_consistent"] = bool(p_consistent)
 
 ray_tpu.shutdown()
 print(json.dumps(results, indent=2))
 
-# tracing_overhead_pct is a BUDGET row (lower is better), checked
-# against its fixed 5% ceiling below — never against the rate floors.
+# tracing_overhead_pct / profiling_overhead_pct are BUDGET rows (lower
+# is better), checked against fixed ceilings below — never against the
+# rate floors.
 TRACING_OVERHEAD_MAX = 5.0
+PROFILING_OVERHEAD_MAX = 3.0
 
 if rebaseline:
     floors = {k: v for k, v in results.items()
-              if not k.startswith("tracing_overhead")}
+              if not k.startswith(("tracing_overhead",
+                                   "profiling_overhead"))}
     with open(FLOOR_PATH, "w") as fh:
         json.dump(floors, fh, indent=2)
         fh.write("\n")
@@ -231,7 +267,7 @@ if not _have_native and "put_get_1MiB_mbps" in floors:
 
 failed = False
 for name, floor in floors.items():
-    if name.startswith("tracing_overhead"):
+    if name.startswith(("tracing_overhead", "profiling_overhead")):
         continue    # legacy floor entry: budget-checked below instead
     got = results.get(name, 0.0)
     limit = floor * (1.0 - TOLERANCE)
@@ -248,10 +284,23 @@ trip = overhead > TRACING_OVERHEAD_MAX and consistent
 verdict = ("REGRESSION" if trip else
            "ok" if overhead <= TRACING_OVERHEAD_MAX else
            "ok (noise: mixed-sign pairs)")
+raw = "[" + ", ".join(f"{r:.3f}" for r in ratios) + "]"
 print(f"tracing_overhead_pct: {overhead:.1f}% vs budget "
       f"{TRACING_OVERHEAD_MAX:.0f}% "
-      f"({slower}/{len(ratios)} pairs slower) {verdict}")
+      f"({slower}/{len(ratios)} pairs slower, on/off ratios {raw}) "
+      f"{verdict}")
 if trip:
+    failed = True
+p_trip = p_overhead > PROFILING_OVERHEAD_MAX and p_consistent
+p_verdict = ("REGRESSION" if p_trip else
+             "ok" if p_overhead <= PROFILING_OVERHEAD_MAX else
+             "ok (noise: mixed-sign pairs)")
+p_raw = "[" + ", ".join(f"{r:.3f}" for r in p_ratios) + "]"
+print(f"profiling_overhead_pct: {p_overhead:.1f}% vs budget "
+      f"{PROFILING_OVERHEAD_MAX:.0f}% "
+      f"({p_slower}/{len(p_ratios)} pairs slower, on/off ratios "
+      f"{p_raw}) {p_verdict}")
+if p_trip:
     failed = True
 sys.exit(1 if failed else 0)
 EOF

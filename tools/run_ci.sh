@@ -13,10 +13,10 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "=== stage 0: observability (dashboard endpoints + task tracing) ==="
-# cheap fail-fast pass over the dashboard/trace/federation tests (they
-# also run inside stages 1-2; this surfaces observability breakage in
-# seconds instead of after the full sweep)
-python -m pytest tests/test_observability.py -x -q
+# cheap fail-fast pass over the dashboard/trace/federation/profiling
+# tests (they also run inside stages 1-2; this surfaces observability
+# breakage in seconds instead of after the full sweep)
+python -m pytest tests/test_observability.py tests/test_profiling.py -x -q
 
 echo "=== stage 0.5: raylint (static concurrency/protocol analysis) ==="
 # fail-fast AST passes: guarded-by, lock-order, blocking-under-lock,
