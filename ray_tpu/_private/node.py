@@ -313,6 +313,15 @@ class ActorExecutor:
         sems = {name: asyncio.Semaphore(g["limit"])
                 for name, g in self._groups.items()}
 
+        # asyncio holds only weak references to tasks: an unretained
+        # handle() task can be garbage-collected mid-await, silently
+        # dropping the actor call — keep strong refs until done
+        inflight: set = set()
+
+        def track(task):  #: loop-only
+            inflight.add(task)
+            task.add_done_callback(inflight.discard)
+
         async def handle(spec):
             async with sems[self._group_of(spec)]:
                 await self._run_task_async(spec, self.instance)
@@ -323,9 +332,11 @@ class ActorExecutor:
                 if spec is None:
                     loop.stop()
                     return
-                loop.create_task(handle(spec))
+                track(loop.create_task(handle(spec)))
 
-        loop.create_task(pump())
+        # the local binding retains the pump task for the whole
+        # run_forever below (track() is loop-only; this thread isn't)
+        pump_task = loop.create_task(pump())
         try:
             loop.run_forever()
         finally:

@@ -212,9 +212,9 @@ class Client:
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._wlock = threading.Lock()
-        self._id = 0
+        self._id = 0                        #: guarded by self._id_lock
         self._id_lock = threading.Lock()
-        self._pending: Dict[int, list] = {}
+        self._pending: Dict[int, list] = {}  #: guarded by self._plock
         self._plock = threading.Lock()
         self._timeout = timeout
         self._on_push = on_push

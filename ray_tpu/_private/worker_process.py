@@ -537,7 +537,7 @@ class _WorkerState:
                 pass    # plane unavailable: classic RPC path
         self._send_lock = threading.Lock()
         self._ids = itertools.count()
-        self._pending: Dict[str, list] = {}
+        self._pending: Dict[str, list] = {}  #: guarded by self._pending_lock
         self._pending_lock = threading.Lock()
         self._task_threads: Dict[str, threading.Thread] = {}
         self.actor_instance: Any = None
@@ -1369,7 +1369,7 @@ class WorkerClient:
         self.conn.send_bytes(cloudpickle.dumps(boot))
         self._send_lock = threading.Lock()
         self._ids = itertools.count()
-        self._pending: Dict[str, _Pending] = {}
+        self._pending: Dict[str, _Pending] = {}  #: guarded by self._pending_lock
         self._pending_lock = threading.Lock()
         # Objects created on behalf of the worker (refs from put/submit),
         # pinned until the creating task — or the whole actor — ends.

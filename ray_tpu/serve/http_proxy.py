@@ -89,7 +89,7 @@ class AsyncHTTPProxy:
         if loop is None:
             return
 
-        def shutdown():
+        def shutdown():  #: loop-only
             if self._server is not None:
                 self._server.close()
             loop.stop()
@@ -220,8 +220,14 @@ class AsyncHTTPProxy:
         """SSE: chunks flush as the replica yields them (proxy.py:1009)."""
         self.stats["streams"] += 1
         loop = asyncio.get_running_loop()
+
+        def submit():
+            # .remote() is a full rpc round trip (lease + push): run it
+            # on the stream pool, never on the event loop
+            return handle.options(stream=True).remote(payload)
+
         try:
-            gen = handle.options(stream=True).remote(payload)
+            gen = await loop.run_in_executor(self._pool, submit)
         except Exception as e:  # noqa: BLE001
             await self._plain(writer, 500, {"error": repr(e)})
             return

@@ -19,10 +19,12 @@ def _module(source: str, name: str = "mod.py") -> Module:
     return Module(name, name, textwrap.dedent(source))
 
 
-def _ctx(*sources, docs: str = "", tests: dict = None) -> Context:
+def _ctx(*sources, docs: str = "", tests: dict = None,
+         obs: str = "") -> Context:
     modules = [_module(src, f"m{i}.py") for i, src in enumerate(sources)]
     return Context(modules=modules, repo_root=os.getcwd(),
                    docs_fault_tolerance=docs,
+                   docs_observability=obs,
                    tests_sources=tests if tests is not None else {})
 
 
@@ -683,3 +685,549 @@ def test_baseline_single_space_comment_parses(tmp_path):
     baseline = Baseline.load(str(bl))
     findings = _run("guarded-by", _ctx(GUARDED_BAD))
     assert len(findings) == 1 and baseline.covers(findings[0])
+
+
+# ---------------------------------------------------------------------------
+# async-discipline
+# ---------------------------------------------------------------------------
+
+def test_async_blocking_call_true_positives():
+    src = """
+        import time
+
+        class Plane:
+            async def tick(self):
+                time.sleep(0.1)
+
+            async def submit(self, handle, payload):
+                return handle.remote(payload)
+    """
+    keys = sorted(f.key for f in _run("async-discipline", _ctx(src)))
+    assert keys == ["blocking:submit:task submission .remote() on handle",
+                    "blocking:tick:time.sleep()"]
+
+
+def test_async_unawaited_coroutine_true_positive():
+    src = """
+        async def pump():
+            return 1
+
+        async def main():
+            pump()
+    """
+    keys = [f.key for f in _run("async-discipline", _ctx(src))]
+    assert keys == ["unawaited:main:pump"]
+
+
+def test_async_await_under_sync_lock_true_positive():
+    src = """
+        import threading
+
+        class Plane:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def drain(self, fut):
+                with self._lock:
+                    await fut
+    """
+    keys = [f.key for f in _run("async-discipline", _ctx(src))]
+    assert keys == ["await-under-lock:drain:self._lock"]
+
+
+def test_async_fire_and_forget_true_positive():
+    src = """
+        async def pump():
+            pass
+
+        def boot(loop):
+            loop.create_task(pump())
+    """
+    keys = [f.key for f in _run("async-discipline", _ctx(src))]
+    assert keys == ["fire-and-forget:create_task(pump)"]
+
+
+def test_async_awaited_rpc_and_executor_not_flagged():
+    """FP guard: the await-wrapped flavors of otherwise-blocking
+    shapes are the DESIGN (run_in_executor offload, awaited call)."""
+    src = """
+        import time
+
+        class Plane:
+            async def ok(self, loop, sock):
+                await loop.run_in_executor(None, time.sleep, 1)
+                data = await self._client.call("ping")
+                return data
+    """
+    assert _run("async-discipline", _ctx(src)) == []
+
+
+def test_async_task_stored_then_gathered_not_flagged():
+    """FP guard (the retained-handle idiom): a task assigned to a
+    name or appended to a set is NOT fire-and-forget."""
+    src = """
+        import asyncio
+
+        async def pump():
+            pass
+
+        async def main(loop):
+            inflight = set()
+            t = loop.create_task(pump())
+            inflight.add(loop.create_task(pump()))
+            await asyncio.gather(t, *inflight)
+    """
+    assert _run("async-discipline", _ctx(src)) == []
+
+
+def test_async_nested_sync_def_and_async_with_not_flagged():
+    """FP guard: a nested sync def runs in an executor (its blocking
+    body is the point), and `async with lock:` is the async flavor."""
+    src = """
+        import time
+        import asyncio
+
+        class Plane:
+            def __init__(self):
+                self._alock = asyncio.Lock()
+
+            async def stream(self, loop):
+                def next_chunk():
+                    time.sleep(0.1)
+                    return self._client.call("pull")
+                async with self._alock:
+                    return await loop.run_in_executor(None, next_chunk)
+    """
+    assert _run("async-discipline", _ctx(src)) == []
+
+
+def test_async_mixed_sync_async_name_not_flagged():
+    """FP guard: a name with BOTH sync and async definitions anywhere
+    in the package is skipped by unawaited-coroutine (conservative)."""
+    src_a = """
+        async def flush():
+            pass
+    """
+    src_b = """
+        def flush():
+            pass
+
+        async def main():
+            flush()
+    """
+    assert _run("async-discipline", _ctx(src_a, src_b)) == []
+
+
+# ---------------------------------------------------------------------------
+# loop-affinity
+# ---------------------------------------------------------------------------
+
+def test_loop_affinity_direct_call_from_thread_context():
+    src = """
+        class Node:
+            def _wake(self):   #: loop-only
+                pass
+
+            def from_thread(self):
+                self._wake()
+    """
+    findings = _run("loop-affinity", _ctx(src))
+    assert [f.key for f in findings] == ["Node.from_thread->_wake"]
+    assert "call_soon_threadsafe" in findings[0].message
+
+
+def test_loop_affinity_annotation_on_line_above():
+    src = """
+        class Node:
+            #: loop-only
+            def _wake(self):
+                pass
+
+            def from_thread(self):
+                self._wake()
+    """
+    keys = [f.key for f in _run("loop-affinity", _ctx(src))]
+    assert keys == ["Node.from_thread->_wake"]
+
+
+def test_loop_affinity_reference_handoff_not_flagged():
+    """FP guard: passing the loop-only function BY REFERENCE to
+    call_soon_threadsafe is the prescribed fix, not a violation."""
+    src = """
+        class Node:
+            def _wake(self):   #: loop-only
+                pass
+
+            def from_thread(self, loop):
+                loop.call_soon_threadsafe(self._wake)
+    """
+    assert _run("loop-affinity", _ctx(src)) == []
+
+
+def test_loop_affinity_loop_spawned_callback_not_flagged():
+    """FP guard: a nested def whose NAME is handed to a loop-scheduling
+    API runs on the loop — its direct call of a loop-only def is fine."""
+    src = """
+        class Node:
+            def _wake(self):   #: loop-only
+                pass
+
+            def start(self, loop):
+                def cb():
+                    self._wake()
+                loop.call_soon_threadsafe(cb)
+    """
+    assert _run("loop-affinity", _ctx(src)) == []
+
+
+def test_loop_affinity_async_and_loop_only_callers_not_flagged():
+    """FP guard: async defs and loop-only defs are already on the
+    loop; their direct calls are the normal case."""
+    src = """
+        class Node:
+            def _wake(self):   #: loop-only
+                pass
+
+            async def handler(self):
+                self._wake()
+
+            def _pump(self):   #: loop-only
+                self._wake()
+    """
+    assert _run("loop-affinity", _ctx(src)) == []
+
+
+def test_loop_affinity_unrelated_attribute_same_name_not_flagged():
+    """Regression (http_proxy.stop): `self._pool.shutdown()` must not
+    match a nested loop-only `def shutdown()` — call shape (bare name
+    vs attribute) disambiguates."""
+    src = """
+        class Proxy:
+            def stop(self, loop):
+                def shutdown():   #: loop-only
+                    loop.stop()
+                loop.call_soon_threadsafe(shutdown)
+                self._pool.shutdown(wait=False)
+    """
+    assert _run("loop-affinity", _ctx(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# capability-drift
+# ---------------------------------------------------------------------------
+
+CAP_REGISTRY = """
+    CAPABILITY_FLAGS = {
+        "batch": {"kind": "hello", "guard": "_batch_ok"},
+        "via_pump": {"kind": "frame", "requires": ["_batch_ok"]},
+    }
+"""
+
+
+def _cap_ctx(*sources) -> Context:
+    modules = [_module(CAP_REGISTRY, "capabilities.py")]
+    modules += [_module(src, f"m{i}.py")
+                for i, src in enumerate(sources)]
+    return Context(modules=modules, repo_root=os.getcwd(),
+                   docs_fault_tolerance="", docs_observability="",
+                   tests_sources={})
+
+
+CAP_PEER = """
+    class Daemon:
+        def handle_hello_driver(self, conn, rid, msg):
+            return {"batch": True}
+
+        def handle_submit(self, conn, rid, msg):
+            if msg.get("via_pump"):
+                pass
+"""
+
+
+def test_capability_unguarded_send_true_positive():
+    src = """
+        class Driver:
+            def check(self):
+                return self._batch_ok
+
+            def execute(self, spec):
+                self._client.call("submit", via_pump=True)
+    """
+    keys = [f.key for f in _run("capability-drift",
+                                _cap_ctx(CAP_PEER, src))]
+    assert keys == ["unguarded-send:via_pump:Driver.execute"]
+
+
+def test_capability_dead_and_unadvertised_flags():
+    """A registry entry nothing advertises, gates, or sends drifts in
+    both directions at once."""
+    keys = sorted(f.key for f in _run("capability-drift",
+                                      _cap_ctx("x = 1\n")))
+    assert keys == ["dead-flag:batch", "dead-flag:via_pump",
+                    "no-advertiser:batch", "no-advertiser:via_pump"]
+
+
+def test_capability_guard_in_direct_caller_not_flagged():
+    """FP guard: the guard checked by the CALLER dominates the send."""
+    src = """
+        class Driver:
+            def execute(self, spec):
+                if self._batch_ok:
+                    self._send(spec)
+
+            def _send(self, spec):
+                self._client.call("submit", via_pump=True)
+    """
+    assert _run("capability-drift", _cap_ctx(CAP_PEER, src)) == []
+
+
+def test_capability_check_hoisted_into_helper_not_flagged():
+    """FP guard (the _submit_coalescer idiom): the caller consults a
+    HELPER that reads the guard, then calls the send function."""
+    src = """
+        class Driver:
+            def execute(self, spec):
+                if self._coalescer():
+                    self._send(spec)
+
+            def _coalescer(self):
+                return self._batch_ok
+
+            def _send(self, spec):
+                self._client.call("submit", via_pump=True)
+    """
+    assert _run("capability-drift", _cap_ctx(CAP_PEER, src)) == []
+
+
+def test_capability_pass_inert_without_registry():
+    assert _run("capability-drift", _ctx(CAP_PEER)) == []
+
+
+# ---------------------------------------------------------------------------
+# frame-schema
+# ---------------------------------------------------------------------------
+
+def test_frame_schema_dead_key_true_positive():
+    src = """
+        from ray_tpu._private import rpc
+
+        declare("submit", "fn")
+
+        class Server:
+            def handle_submit(self, conn, rid, msg):
+                return msg["fn"]
+
+        class Client:
+            def send(self):
+                self._client.call("submit", fn=1, extra=2)
+    """
+    findings = _run("frame-schema", _ctx(src))
+    assert [f.key for f in findings] == ["dead-key:submit:extra"]
+    assert "no consumer" in findings[0].message
+
+
+def test_frame_schema_missing_key_true_positive():
+    src = """
+        from ray_tpu._private import rpc
+
+        declare("submit", "fn")
+
+        class Server:
+            def handle_submit(self, conn, rid, msg):
+                return msg["fn"], msg["deadline"]
+
+        class Client:
+            def send(self):
+                self._client.call("submit", fn=1)
+    """
+    keys = [f.key for f in _run("frame-schema", _ctx(src))]
+    assert keys == ["missing-key:submit:deadline"]
+
+
+def test_frame_schema_push_demux_dead_key():
+    src = """
+        from ray_tpu._private import rpc
+
+        class Driver:
+            def _on_push(self, method, msg):
+                if method == "report":
+                    return msg["x"]
+
+        class Worker:
+            def emit(self, conn):
+                conn.push("report", x=1, y=2)
+    """
+    keys = [f.key for f in _run("frame-schema", _ctx(src))]
+    assert keys == ["dead-key:report:y"]
+
+
+def test_frame_schema_resolved_splat_not_flagged():
+    """FP guard: a **kw splat built from a same-function dict literal
+    plus kw["k"] = ... stores resolves to its keys."""
+    src = """
+        from ray_tpu._private import rpc
+
+        declare("submit", "fn")
+
+        class Server:
+            def handle_submit(self, conn, rid, msg):
+                return msg["fn"], msg["deadline"]
+
+        class Client:
+            def send(self):
+                kw = {"fn": 1}
+                kw["deadline"] = 5
+                self._client.call("submit", **kw)
+    """
+    assert _run("frame-schema", _ctx(src)) == []
+
+
+def test_frame_schema_forwarding_handler_suppresses_dead_key():
+    """FP guard: a handler that hands msg onward whole may read any
+    key downstream — dead-key must stay quiet."""
+    src = """
+        from ray_tpu._private import rpc
+
+        declare("submit", "fn")
+
+        class Server:
+            def handle_submit(self, conn, rid, msg):
+                self._exec(msg)
+
+        class Client:
+            def send(self):
+                self._client.call("submit", fn=1, extra=2)
+    """
+    assert _run("frame-schema", _ctx(src)) == []
+
+
+def test_frame_schema_opaque_splat_blocks_missing_key():
+    """FP guard: an unresolvable **kw means absence cannot be proven —
+    no missing-key. Transport-level kwargs (timeout) are not payload."""
+    src = """
+        from ray_tpu._private import rpc
+
+        declare("submit", "fn")
+
+        class Server:
+            def handle_submit(self, conn, rid, msg):
+                return msg["fn"]
+
+        class Client:
+            def send(self, kw):
+                self._client.call("submit", timeout=5.0, **kw)
+    """
+    assert _run("frame-schema", _ctx(src)) == []
+
+
+def test_frame_schema_local_store_not_a_wire_key():
+    """FP guard: msg["k"] = ... inside the handler materializes the
+    key locally — later msg["k"] loads are not wire requirements."""
+    src = """
+        from ray_tpu._private import rpc
+
+        declare("submit", "fn")
+
+        class Server:
+            def handle_submit(self, conn, rid, msg):
+                msg["_t0"] = 1
+                return msg["fn"], msg["_t0"]
+
+        class Client:
+            def send(self):
+                self._client.call("submit", fn=1)
+    """
+    assert _run("frame-schema", _ctx(src)) == []
+
+
+# ---------------------------------------------------------------------------
+# metric-registry
+# ---------------------------------------------------------------------------
+
+def test_metric_registry_undocumented_true_positive():
+    src = """
+        from ray_tpu._private.metrics import Counter
+
+        _hits = Counter("ray_tpu_cache_hits_total", "cache hits")
+    """
+    findings = _run("metric-registry",
+                    _ctx(src, obs="## Metrics\\n- `ray_tpu_other`\\n"))
+    assert [f.key for f in findings] == [
+        "undocumented:ray_tpu_cache_hits_total"]
+
+
+def test_metric_registry_documented_not_flagged():
+    src = """
+        from ray_tpu._private.metrics import Counter
+
+        _hits = Counter("ray_tpu_cache_hits_total", "cache hits")
+    """
+    obs = "- `ray_tpu_cache_hits_total` cache hits by tier\\n"
+    assert _run("metric-registry", _ctx(src, obs=obs)) == []
+
+
+def test_metric_registry_ignores_foreign_names_and_plain_dicts():
+    """FP guard: non-prefix metric names belong to other systems, and
+    a dict with a name key but no kind key is not a wire entry."""
+    src = """
+        from ray_tpu._private.metrics import Counter
+
+        _x = Counter("process_cpu_seconds_total", "not ours")
+        spec = {"name": "ray_tpu_not_a_metric"}
+    """
+    assert _run("metric-registry", _ctx(src, obs="")) == []
+
+
+def test_metric_registry_wire_entry_dict_collected():
+    src = """
+        def delta():
+            return {"name": "ray_tpu_queue_depth", "kind": "gauge",
+                    "value": 3}
+    """
+    keys = [f.key for f in _run("metric-registry", _ctx(src, obs=""))]
+    assert keys == ["undocumented:ray_tpu_queue_depth"]
+    ok = "| `ray_tpu_queue_depth` | gauge |"
+    assert _run("metric-registry", _ctx(src, obs=ok)) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI surface + perf budget
+# ---------------------------------------------------------------------------
+
+def test_list_passes_catalogue_complete():
+    expected = {"guarded-by", "blocking-under-lock", "lock-order",
+                "rpc-drift", "failpoint-registry", "async-discipline",
+                "loop-affinity", "capability-drift", "frame-schema",
+                "metric-registry"}
+    assert expected <= set(REGISTRY)
+
+
+def test_full_run_meets_time_budget():
+    """CI stage-0.5 contract: all passes over the whole package in
+    <5s (the budget that keeps raylint in the default CI path).
+    Measured in per-thread CPU time: the budget gates raylint's own
+    work — not other load on the CI box, and not background threads
+    earlier tests in the same process left running."""
+    import time as _time
+    from tools.raylint.__main__ import main
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    t0 = _time.thread_time()
+    rc = main([os.path.join(repo, "ray_tpu")])
+    elapsed = _time.thread_time() - t0
+    assert rc == 0
+    assert elapsed < 5.0, f"full raylint run took {elapsed:.2f}s CPU"
+
+
+def test_changed_run_meets_time_budget():
+    """Pre-commit contract: --changed stays under ~2s of CPU (whole-
+    program passes still run; the per-module-only passes scan just the
+    changed files, and reporting filters to the git diff). Per-thread
+    CPU time, for the same reason as the full-run budget above."""
+    import time as _time
+    from tools.raylint.__main__ import main
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    t0 = _time.thread_time()
+    rc = main([os.path.join(repo, "ray_tpu"), "--changed"])
+    elapsed = _time.thread_time() - t0
+    assert rc == 0
+    assert elapsed < 2.0, f"--changed raylint run took {elapsed:.2f}s CPU"

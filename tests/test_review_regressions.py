@@ -594,6 +594,63 @@ def test_send_stage_large_frame_keeps_fifo_order():
         srv.close()
 
 
+# ---------------------------------------------------------------------------
+# raylint v2 findings on the real runtime (ISSUE 14): each fix below is
+# pinned by running the flagging pass over the REAL module — a revert
+# reintroduces the exact finding the pass was built to catch.
+# ---------------------------------------------------------------------------
+
+def _raylint_ctx(*relpaths):
+    import os
+
+    from tools.raylint.core import Context, load_modules
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [os.path.join(root, p) for p in relpaths]
+    return Context(modules=load_modules(paths, root), repo_root=root)
+
+
+def test_actor_executor_retains_loop_tasks():
+    """node.py ActorExecutor loop: create_task() results must be
+    retained (asyncio keeps only weak refs — an unretained handle()
+    task can be GC'd mid-await, dropping the actor call). The fix
+    routes every spawn through an inflight set; reverting it brings
+    back the pass's fire-and-forget finding."""
+    from tools.raylint import async_discipline
+
+    findings = async_discipline.run(_raylint_ctx("ray_tpu/_private/node.py"))
+    assert [f.key for f in findings
+            if f.key.startswith("fire-and-forget")] == []
+
+
+def test_http_proxy_stream_submits_off_loop():
+    """http_proxy._stream: handle.options(...).remote() is a full rpc
+    round trip (lease + push) and must run on the stream pool, never
+    directly on the event loop. Reverting the run_in_executor offload
+    brings back the blocking-call finding."""
+    from tools.raylint import async_discipline
+
+    findings = async_discipline.run(
+        _raylint_ctx("ray_tpu/serve/http_proxy.py"))
+    assert [f.key for f in findings
+            if f.key.startswith("blocking:_stream")] == []
+
+
+def test_retry_metrics_documented():
+    """Every ray_tpu_retry* series emitted by _private/retry.py must
+    appear in docs/observability.md (the doc IS the operator contract;
+    the drift was three undocumented retry counters)."""
+    from tools.raylint import metric_registry
+
+    ctx = _raylint_ctx("ray_tpu/_private/retry.py")
+    assert metric_registry.run(ctx) == []
+    doc = ctx.observability_doc()
+    for name in ("ray_tpu_retries_total",
+                 "ray_tpu_retry_backoff_seconds_total",
+                 "ray_tpu_retry_exhausted_total"):
+        assert name in doc
+
+
 def test_exec_pool_handback_gate_skips_bounced():
     """The drain pass runs only while the pool queue holds specs it
     could still hand back: bounced-back specs (nowhere else fits) must

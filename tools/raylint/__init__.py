@@ -11,14 +11,21 @@ recurring bug classes this codebase has actually shipped —
 - ``blocking-under-lock``  wire I/O / sleeps / RPC inside a held lock
 - ``rpc-drift``         client method literals vs server dispatch tables
 - ``failpoint-registry``  fire() names unique + documented + tested
+- ``async-discipline``  no blocking calls / dropped coroutines on the loop
+- ``loop-affinity``     ``#: loop-only`` defs reached only from the loop
+- ``capability-drift``  hello capability flags advertised, gated, honored
+- ``frame-schema``      payload keys at send sites match consumer reads
+- ``metric-registry``   ray_tpu_* metric names documented in the catalogue
 
 Run: ``python -m tools.raylint ray_tpu/`` (CI stage 0.5, fail-fast).
 Docs: ``docs/static_analysis.md``. No ``--fix`` by design: every fix is
 a semantic change a human (or a baseline justification) must own.
 """
 
-from tools.raylint import (blocking, failpoints_pass,  # noqa: F401
-                           guarded_by, lock_order, rpc_drift)
+from tools.raylint import (async_discipline, blocking,  # noqa: F401
+                           capability_drift, failpoints_pass,
+                           frame_schema, guarded_by, lock_order,
+                           loop_affinity, metric_registry, rpc_drift)
 from tools.raylint.core import (Baseline, Context, Finding,  # noqa: F401
                                 Module, REGISTRY, collect_py_files,
                                 load_modules)
@@ -26,14 +33,36 @@ from tools.raylint.core import (Baseline, Context, Finding,  # noqa: F401
 __all__ = ["Baseline", "Context", "Finding", "Module", "REGISTRY",
            "collect_py_files", "load_modules", "run_passes"]
 
+# passes whose findings for module M depend ONLY on module M (the
+# whole-program context adds nothing): under --changed these scan just
+# the changed modules, keeping the pre-commit path inside its ~2s
+# budget. Everything else is whole-program (call graphs, registries,
+# send/consume matching) and always sees the full module set.
+LOCAL_PASSES = {"guarded-by", "blocking-under-lock", "loop-affinity"}
 
-def run_passes(ctx: Context, only=None):
+
+def run_passes(ctx: Context, only=None, changed=None):
     """Run registered passes (all, or the ids in ``only``) and return
-    the combined findings sorted by location."""
+    the combined findings sorted by location. ``changed`` (a set of
+    repo-relative paths, or None) restricts the per-module-only passes
+    in ``LOCAL_PASSES`` to those modules — their findings cannot land
+    anywhere else, so the skipped work is pure waste."""
     findings = []
+    local_ctx = None
     for pass_id, fn in sorted(REGISTRY.items()):
         if only and pass_id not in only:
             continue
-        findings.extend(fn(ctx))
+        use = ctx
+        if changed is not None and pass_id in LOCAL_PASSES:
+            if local_ctx is None:
+                local_ctx = Context(
+                    modules=[m for m in ctx.modules
+                             if m.relpath in changed],
+                    repo_root=ctx.repo_root,
+                    docs_fault_tolerance=ctx.docs_fault_tolerance,
+                    docs_observability=ctx.docs_observability,
+                    tests_sources=ctx.tests_sources)
+            use = local_ctx
+        findings.extend(fn(use))
     findings.sort(key=lambda f: (f.path, f.line, f.pass_id))
     return findings

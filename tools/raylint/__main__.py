@@ -34,15 +34,11 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.txt")
 
 def _changed_files(repo_root: str) -> set:
     try:
+        # one invocation: diff vs HEAD covers staged AND unstaged edits
         out = subprocess.run(
             ["git", "diff", "--name-only", "HEAD"],
             cwd=repo_root, capture_output=True, text=True, timeout=10)
-        names = set(out.stdout.split())
-        out = subprocess.run(
-            ["git", "diff", "--name-only", "--cached"],
-            cwd=repo_root, capture_output=True, text=True, timeout=10)
-        names |= set(out.stdout.split())
-        return names
+        return set(out.stdout.split())
     except (OSError, subprocess.SubprocessError):
         return set()
 
@@ -77,6 +73,15 @@ def main(argv=None) -> int:
     if not files:
         print("raylint: no python files found", file=sys.stderr)
         return 2
+    changed = _changed_files(repo_root) if args.changed else None
+    if changed is not None:
+        linted = {os.path.relpath(os.path.abspath(f), repo_root)
+                  for f in files}
+        if not (linted & changed):
+            # nothing reportable can surface: skip parsing entirely
+            print("raylint: clean (no linted files changed)",
+                  file=sys.stderr)
+            return 0
     modules = load_modules(files, repo_root)
     ctx = Context(modules=modules, repo_root=repo_root)
     only = ({p.strip() for p in args.passes.split(",") if p.strip()}
@@ -85,10 +90,9 @@ def main(argv=None) -> int:
         print(f"raylint: unknown passes {sorted(only - set(REGISTRY))}"
               f" (known: {sorted(REGISTRY)})", file=sys.stderr)
         return 2
-    findings = run_passes(ctx, only=only)
+    findings = run_passes(ctx, only=only, changed=changed)
 
-    if args.changed:
-        changed = _changed_files(repo_root)
+    if changed is not None:
         findings = [f for f in findings if f.path in changed]
 
     baseline = (Baseline() if args.no_baseline

@@ -1,0 +1,273 @@
+"""async-discipline pass: no blocking work / lost coroutines on the loop.
+
+The asyncio control plane (ROADMAP item 4) is only safe if the event
+loop never runs blocking code and never drops a coroutine. Flags:
+
+- **blocking-call**: inside any ``async def`` body (nested sync defs
+  and lambdas excluded — they run in executors or threads), a call
+  that blocks the loop: ``time.sleep``, ``subprocess.*``, socket
+  send/recv attributes, rpc round trips (``.call``/``._call``/
+  ``.notify``/``.notify_driver``) and data-plane submissions
+  (``.remote``), and ``.acquire()``/``.wait()`` on a threading
+  lock/cv/event. A call directly wrapped in ``await`` is the async
+  flavor (``await loop.run_in_executor(...)``) and is exempt.
+- **unawaited-coroutine**: a bare expression statement calling a
+  function every linted definition of which is ``async def`` — the
+  coroutine object is created and garbage-collected unrun. Names that
+  have both sync and async definitions anywhere in the package are
+  skipped (conservative).
+- **await-under-lock**: ``await`` while lexically inside a *sync*
+  ``with <lock>:`` block (or a manual ``.acquire()`` region). The loop
+  parks on the await with the threading lock held — every other
+  thread contending the lock stalls the whole control plane.
+  ``async with`` is the correct form and is not flagged.
+- **fire-and-forget**: ``create_task(...)``/``ensure_future(...)`` as
+  a bare expression statement. asyncio holds only a weak reference to
+  tasks, so an unretained task can be garbage-collected mid-flight;
+  assign it, append it to a collection, or pass it onward.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from tools.raylint.core import (Context, Finding, expr_name, is_locky,
+                                register)
+from tools.raylint.blocking import (RPC_ATTRS, SOCKET_ATTRS,
+                                    SUBPROCESS_ATTRS)
+
+PASS_ID = "async-discipline"
+
+SPAWN_NAMES = {"create_task", "ensure_future"}
+# threading primitives whose acquire/wait park the calling THREAD —
+# fatal on the loop thread (asyncio's own Lock/Event are awaited, so
+# the un-awaited call shape below never matches them)
+THREAD_WAIT_ATTRS = {"acquire", "wait"}
+
+
+_DEF_RE = re.compile(r"\bdef[ \t]+(\w+)")
+
+
+def _async_defs(ctx: Context) -> Dict[str, Set[bool]]:
+    """name -> set of is-async flags across every linted definition.
+
+    Regex-collected rather than AST-walked: this is the only part of
+    the pass that must see EVERY module (a sync def anywhere vetoes
+    the unawaited-coroutine check for that name), and walking 240k
+    nodes to find def statements is the wrong tool. Over-matching a
+    ``def`` inside a string skews toward the mixed-kinds veto, i.e.
+    toward silence — the conservative direction for this check."""
+    kinds: Dict[str, Set[bool]] = {}
+    for module in ctx.modules:
+        src = module.source
+        for m in _DEF_RE.finditer(src):
+            start = m.start()
+            is_async = src[max(0, start - 6):start].rstrip() \
+                .endswith("async")
+            kinds.setdefault(m.group(1), set()).add(is_async)
+    return kinds
+
+
+def _blocking_in_async(node: ast.Call) -> Optional[str]:
+    """Describe why this call blocks the event loop, or None."""
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    recv = expr_name(func.value)
+    attr = func.attr
+    if recv == "time" and attr == "sleep":
+        return "time.sleep()"
+    if recv == "subprocess" and attr in SUBPROCESS_ATTRS:
+        return f"subprocess.{attr}()"
+    if attr in THREAD_WAIT_ATTRS and recv is not None and is_locky(recv):
+        return f"threading {attr}() on {recv}"
+    if attr in SOCKET_ATTRS:
+        return f"socket {attr}() on {recv or '<expr>'}"
+    if attr in RPC_ATTRS:
+        return f"RPC {attr}() on {recv or '<expr>'}"
+    if attr == "remote":
+        # data-plane submission: a full rpc round trip under the hood
+        return f"task submission .remote() on {recv or '<expr>'}"
+    return None
+
+
+def _is_spawn_call(node: ast.Call) -> bool:
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id in SPAWN_NAMES
+    if isinstance(func, ast.Attribute):
+        return func.attr in SPAWN_NAMES
+    return False
+
+
+class _AsyncBodyScan:
+    """One async def body: blocking calls + awaits under sync locks.
+
+    Recursive statement walk tracking (a) whether the current Call is
+    directly under an ``await`` (exempt from blocking-call) and (b)
+    the sync-``with``-held lock names (await-under-lock). Nested defs
+    and lambdas are pruned — they execute in their own context and are
+    scanned (or deliberately skipped) on their own.
+    """
+
+    def __init__(self, module, where: str, findings: List[Finding],
+                 async_names: Dict[str, Set[bool]]):
+        self.module = module
+        self.where = where
+        self.findings = findings
+        self.async_names = async_names
+        self.reported: Set[str] = set()
+
+    def _emit(self, line: int, kind: str, detail: str, msg: str) -> None:
+        if self.module.suppressed(PASS_ID, line):
+            return
+        key = f"{kind}:{self.where}:{detail}"
+        if key in self.reported:
+            return
+        self.reported.add(key)
+        self.findings.append(Finding(
+            PASS_ID, self.module.relpath, line, key, msg))
+
+    def scan(self, fn: ast.AsyncFunctionDef) -> None:
+        self._block(fn.body, [])
+
+    def _block(self, stmts: List[ast.stmt], held: List[str]) -> None:
+        for stmt in stmts:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt: ast.stmt, held: List[str]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return      # runs in its own context (executor, later task)
+        if isinstance(stmt, ast.With):
+            acquired = []
+            for item in stmt.items:
+                self._expr(item.context_expr, held)
+                name = expr_name(item.context_expr)
+                if name and is_locky(name):
+                    acquired.append(name)
+            self._block(stmt.body, held + acquired)
+            return
+        if isinstance(stmt, ast.AsyncWith):
+            # async with asyncio.Lock(): awaits are the design
+            for item in stmt.items:
+                self._expr(item.context_expr, held)
+            self._block(stmt.body, held)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._expr(stmt.test, held)
+            self._block(stmt.body, held)
+            self._block(stmt.orelse, held)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter, held)
+            self._block(stmt.body, held)
+            self._block(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.Try):
+            self._block(stmt.body, held)
+            for handler in stmt.handlers:
+                self._block(handler.body, held)
+            self._block(stmt.orelse, held)
+            self._block(stmt.finalbody, held)
+            return
+        # bare-expression call: the one statement shape that can drop
+        # a coroutine unrun
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)):
+            callee = _callee_name(stmt.value)
+            if (callee is not None and callee not in SPAWN_NAMES
+                    and self.async_names.get(callee) == {True}):
+                self._emit(
+                    stmt.value.lineno, "unawaited", callee,
+                    f"{callee}() is async everywhere it is defined but "
+                    f"called without await in {self.where}() — the "
+                    f"coroutine is created and dropped unrun")
+        # leaf statement: scan every expression inside it
+        for child in ast.iter_child_nodes(stmt):
+            self._expr(child, held)
+
+    def _expr(self, expr: ast.AST, held: List[str],
+              under_await: bool = False) -> None:
+        if isinstance(expr, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            return      # deferred body: runs elsewhere
+        if isinstance(expr, ast.Await):
+            if held:
+                self._emit(
+                    expr.lineno, "await-under-lock",
+                    ",".join(sorted(set(held))),
+                    f"await while holding sync lock "
+                    f"{', '.join(sorted(set(held)))} in {self.where}() "
+                    f"— the loop parks with the lock held (use async "
+                    f"with / release before awaiting)")
+            self._expr(expr.value, held, under_await=True)
+            return
+        if isinstance(expr, ast.Call):
+            if not under_await:
+                why = _blocking_in_async(expr)
+                if why is not None:
+                    self._emit(
+                        expr.lineno, "blocking", why,
+                        f"{why} inside async def {self.where}() blocks "
+                        f"the event loop (offload via run_in_executor)")
+            for child in ast.iter_child_nodes(expr):
+                self._expr(child, held)
+            return
+        for child in ast.iter_child_nodes(expr):
+            self._expr(child, held)
+
+
+@register(PASS_ID)
+def run(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    async_names = _async_defs(ctx)
+    for module in ctx.modules:
+        # substring gate: skip the ~90% of modules with no async code
+        # (create_task/ensure_future always arrive via an asyncio or
+        # loop attribute, but check the names anyway for safety)
+        if ("async" not in module.source
+                and "create_task" not in module.source
+                and "ensure_future" not in module.source):
+            continue
+        for node in module.walk():
+            # fire-and-forget spawn: anywhere, any function kind — the
+            # weak-reference hazard does not care who called it
+            if (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)
+                    and _is_spawn_call(node.value)):
+                line = node.value.lineno
+                if not module.suppressed(PASS_ID, line):
+                    findings.append(Finding(
+                        PASS_ID, module.relpath, line,
+                        f"fire-and-forget:{_spawn_detail(node.value)}",
+                        f"fire-and-forget {_spawn_detail(node.value)} — "
+                        f"asyncio keeps only a weak reference to tasks; "
+                        f"retain the task or it can be GC'd mid-flight"))
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            _AsyncBodyScan(module, node.name, findings,
+                           async_names).scan(node)
+    return findings
+
+
+def _spawn_detail(call: ast.Call) -> str:
+    """``create_task(pump())`` -> "create_task(pump)" — the argument
+    callee keeps two same-file spawn sites apart in the stable key."""
+    fname = (call.func.attr if isinstance(call.func, ast.Attribute)
+             else getattr(call.func, "id", "?"))
+    arg = ""
+    if call.args:
+        arg = (_callee_name(call.args[0])
+               if isinstance(call.args[0], ast.Call)
+               else expr_name(call.args[0])) or ""
+    return f"{fname}({arg})"
+
+
+def _callee_name(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None

@@ -1,0 +1,274 @@
+"""capability-drift pass: hello flags advertised, gated, and honored.
+
+The registry is ``ray_tpu/_private/capabilities.py`` (a pure-literal
+``CAPABILITY_FLAGS`` dict; see its docstring for the protocol). Three
+legs per flag, all whole-program:
+
+- ``no-advertiser``: a ``hello`` flag missing from every
+  ``handle_hello*`` reply dict, or a ``frame`` flag no wire send site
+  ever writes;
+- ``dead-flag``: a ``hello`` flag whose guard attribute is never read
+  (nothing consults the advertisement), or a ``frame`` flag no
+  receiver ever gates on (``msg.get("flag")`` / ``msg["flag"]``);
+- ``unguarded-send``: a send site writing a ``frame`` flag whose
+  ``requires`` guards are referenced neither by the sending function,
+  nor by a direct same-class caller, nor by a same-class helper that
+  caller consults — the hoisted-check idiom (``execute_task`` asks
+  ``_submit_coalescer``, which reads ``_batch_supported``, before
+  calling ``_submit_batched``) is recognized one hop deep.
+
+Send sites are: keywords on rpc wire calls (``.call``/``._call``/
+``.notify``/``.notify_driver``/``.push``), string-literal dict keys,
+and ``kw["flag"] = ...`` subscript stores — the three shapes frame
+payloads are built from. Constructor keywords and ``declare()`` field
+lists are NOT send sites.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.raylint.core import Context, Finding, Module, register
+
+PASS_ID = "capability-drift"
+
+WIRE_SEND_ATTRS = {"call", "_call", "notify", "notify_driver", "push"}
+
+
+def _load_registry(ctx: Context) -> Optional[Dict[str, dict]]:
+    for module in ctx.modules:
+        if module.name != "capabilities":
+            continue
+        for node in module.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if any(isinstance(t, ast.Name)
+                   and t.id == "CAPABILITY_FLAGS"
+                   for t in node.targets):
+                try:
+                    reg = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    return None
+                return reg if isinstance(reg, dict) else None
+    return None
+
+
+class _ClassIndex:
+    """Per (module, class): attr reads, self-call edges, per-method."""
+
+    def __init__(self) -> None:
+        self.refs: Dict[str, Set[str]] = {}      # method -> attrs read
+        self.calls: Dict[str, Set[str]] = {}     # method -> self.X()
+
+
+def _index_class(cls: ast.ClassDef) -> _ClassIndex:
+    idx = _ClassIndex()
+    for fn in cls.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        refs: Set[str] = set()
+        calls: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute):
+                if isinstance(node.ctx, ast.Load):
+                    refs.add(node.attr)
+                if (isinstance(node.value, ast.Name)
+                        and node.value.id == "self"):
+                    # self.X(...) call edge (parent Call not needed:
+                    # reading self.X at all implies consulting it)
+                    calls.add(node.attr)
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Name)
+                  and node.func.id == "getattr"
+                  and len(node.args) >= 2
+                  and isinstance(node.args[1], ast.Constant)
+                  and isinstance(node.args[1].value, str)):
+                refs.add(node.args[1].value)
+        idx.refs[fn.name] = refs
+        idx.calls[fn.name] = calls
+    return idx
+
+
+def _guard_dominates(idx: _ClassIndex, fn_name: str,
+                     requires: List[str]) -> bool:
+    req = set(requires)
+    if idx.refs.get(fn_name, set()) & req:
+        return True
+    for caller, callees in idx.calls.items():
+        if fn_name not in callees:
+            continue
+        if idx.refs.get(caller, set()) & req:
+            return True
+        # one hop into the caller's helpers: the hoisted-check idiom
+        for helper in callees:
+            if helper != fn_name and idx.refs.get(helper, set()) & req:
+                return True
+    return False
+
+
+@register(PASS_ID)
+def run(ctx: Context) -> List[Finding]:
+    registry = _load_registry(ctx)
+    if not registry:
+        return []
+    hello = {n: s for n, s in registry.items()
+             if s.get("kind") == "hello"}
+    frame = {n: s for n, s in registry.items()
+             if s.get("kind") == "frame"}
+    reg_path = next((m.relpath for m in ctx.modules
+                     if m.name == "capabilities"), "capabilities.py")
+
+    advertised: Set[str] = set()        # hello keys seen in a reply
+    guard_reads: Set[str] = set()       # attribute names read anywhere
+    frame_sends: Dict[str, List[Tuple[Module, Optional[str],
+                                      Optional[str], int]]] = {}
+    frame_gates: Set[str] = set()       # flags read via .get()/[...]
+    frame_names = set(frame)
+
+    class_idx: Dict[Tuple[str, str], _ClassIndex] = {}
+
+    # every leg keys on a SPECIFIC textual form of a registry string:
+    # flags appear quoted (dict key, .get arg, subscript) or as a
+    # kwarg (`flag=`); guards appear as an attribute (`.guard`) or a
+    # getattr string. Bare-word matches ("batch" inside "batching")
+    # would drag half the package through the scoped walk below.
+    tokens: Set[str] = set()
+    for flag in registry:
+        tokens.update((f'"{flag}"', f"'{flag}'", f"{flag}="))
+    guards = ({s.get("guard", "") for s in hello.values()} - {""})
+    for spec in frame.values():
+        guards.update(spec.get("requires", []))
+    for g in guards:
+        tokens.update((f".{g}", f'"{g}"', f"'{g}'"))
+
+    def _site_scope(module: Module,
+                    line: int) -> Tuple[Optional[str], Optional[str]]:
+        """(class, function) names for one send site, resolved by line
+        range — scope is only needed for the handful of sites found,
+        so threading it through the whole traversal is wasted work."""
+        cls_node = module.enclosing_class_node(line)
+        fn_node = module.enclosing_def(line)
+        cls = cls_node.name if cls_node is not None else None
+        if cls_node is not None:
+            key = (module.relpath, cls)
+            if key not in class_idx:
+                class_idx[key] = _index_class(cls_node)
+        return cls, (fn_node.name if fn_node is not None else None)
+
+    for module in ctx.modules:
+        if module.name == "capabilities":
+            continue
+        src = module.source
+        if ("handle_hello" not in src
+                and not any(t in src for t in tokens)):
+            continue
+        # hello advertisers: dict keys inside handle_hello* bodies
+        # (the innermost def must BE the advertiser — a dict built in
+        # a nested helper def is that helper's, not the reply's)
+        for fn_node in module.defs():
+            if not fn_node.name.startswith("handle_hello"):
+                continue
+            for node in ast.walk(fn_node):
+                if node.__class__ is ast.Dict:
+                    fn = module.enclosing_def(node.lineno)
+                    if (fn is not None
+                            and not fn.name.startswith("handle_hello")):
+                        continue
+                    for k in node.keys:
+                        if (isinstance(k, ast.Constant)
+                                and isinstance(k.value, str)):
+                            advertised.add(k.value)
+        # one flat dispatch over the shared node cache
+        for node in module.walk():
+            kind = node.__class__
+            if kind is ast.Attribute:
+                if isinstance(node.ctx, ast.Load):
+                    guard_reads.add(node.attr)      # hello gate leg
+            elif kind is ast.Call:
+                func = node.func
+                if (isinstance(func, ast.Name)
+                        and func.id == "getattr"
+                        and len(node.args) >= 2
+                        and isinstance(node.args[1], ast.Constant)
+                        and isinstance(node.args[1].value, str)):
+                    guard_reads.add(node.args[1].value)
+                elif isinstance(func, ast.Attribute):
+                    # frame-flag receive gate: x.get("flag")
+                    if (func.attr == "get" and node.args
+                            and isinstance(node.args[0], ast.Constant)
+                            and node.args[0].value in frame_names):
+                        frame_gates.add(node.args[0].value)
+                    # frame-flag send site: wire-call keyword
+                    elif func.attr in WIRE_SEND_ATTRS:
+                        for kwarg in node.keywords:
+                            if kwarg.arg in frame_names:
+                                cls, fn = _site_scope(
+                                    module, node.lineno)
+                                frame_sends.setdefault(
+                                    kwarg.arg, []).append(
+                                    (module, cls, fn, node.lineno))
+            elif kind is ast.Subscript:
+                slc = node.slice
+                if (isinstance(slc, ast.Constant)
+                        and slc.value in frame_names):
+                    if isinstance(node.ctx, ast.Load):
+                        frame_gates.add(slc.value)  # x["flag"] gate
+                    elif isinstance(node.ctx, ast.Store):
+                        cls, fn = _site_scope(module, node.lineno)
+                        frame_sends.setdefault(slc.value, []).append(
+                            (module, cls, fn, node.lineno))
+            elif kind is ast.Dict:
+                for k in node.keys:
+                    if (isinstance(k, ast.Constant)
+                            and k.value in frame_names):
+                        cls, fn = _site_scope(module, node.lineno)
+                        frame_sends.setdefault(k.value, []).append(
+                            (module, cls, fn, node.lineno))
+
+    findings: List[Finding] = []
+    for flag, spec in sorted(hello.items()):
+        guard = spec.get("guard", "")
+        if flag not in advertised:
+            findings.append(Finding(
+                PASS_ID, reg_path, 1, f"no-advertiser:{flag}",
+                f"hello capability {flag!r} is registered but no "
+                f"handle_hello* reply advertises it"))
+        if guard and guard not in guard_reads:
+            findings.append(Finding(
+                PASS_ID, reg_path, 1, f"dead-flag:{flag}",
+                f"hello capability {flag!r} guard .{guard} is never "
+                f"read — the advertisement gates nothing"))
+    for flag, spec in sorted(frame.items()):
+        sends = frame_sends.get(flag, [])
+        if not sends:
+            findings.append(Finding(
+                PASS_ID, reg_path, 1, f"no-advertiser:{flag}",
+                f"frame capability flag {flag!r} is registered but no "
+                f"wire send site ever writes it"))
+        if flag not in frame_gates:
+            findings.append(Finding(
+                PASS_ID, reg_path, 1, f"dead-flag:{flag}",
+                f"frame capability flag {flag!r} is never gated on by "
+                f"any receiver (msg.get/msg[...])"))
+        requires = list(spec.get("requires", []))
+        if not requires:
+            continue
+        for module, cls, fn, line in sends:
+            if module.suppressed(PASS_ID, line):
+                continue
+            idx = (class_idx.get((module.relpath, cls))
+                   if cls is not None else None)
+            ok = (idx is not None and fn is not None
+                  and _guard_dominates(idx, fn, requires))
+            if ok:
+                continue
+            where = (f"{cls}.{fn}" if cls and fn else fn or "<module>")
+            findings.append(Finding(
+                PASS_ID, module.relpath, line,
+                f"unguarded-send:{flag}:{where}",
+                f"{where}() writes capability-gated key {flag!r} "
+                f"without a dominating check of "
+                f"{' or '.join('.' + r for r in requires)} — peer may "
+                f"not have advertised it"))
+    return findings

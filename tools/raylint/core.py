@@ -27,6 +27,9 @@ from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 # annotation comment marking an attribute as lock-guarded, e.g.
 #   self._streams = {}   #: guarded by self._slock
 GUARDED_RE = re.compile(r"#:\s*guarded by\s+(?P<lock>[A-Za-z_][\w.]*)")
+# annotation comment marking a function as event-loop-affine, e.g.
+#   def _wake(self):   #: loop-only
+LOOP_ONLY_RE = re.compile(r"#:\s*loop-only\b")
 # inline suppression:  # raylint: disable=guarded-by,blocking-under-lock
 DISABLE_RE = re.compile(r"#\s*raylint:\s*disable=(?P<ids>[\w,-]+)")
 # with <expr> acquiring a lock whose attribute/name looks lock-like
@@ -63,15 +66,50 @@ class Module:
         self.tree = ast.parse(source, filename=path)
         self.name = os.path.splitext(os.path.basename(path))[0]
         # line -> lock expression text from "#: guarded by <lock>"
-        self.guarded_lines: Dict[int, str] = {}
+        self._guarded_lines: Dict[int, str] = {}
+        # lines carrying a "#: loop-only" affinity annotation
+        self._loop_only_lines: Set[int] = set()
         # line -> set of disabled pass ids
-        self.suppressions: Dict[int, Set[str]] = {}
-        self._scan_comments()
+        self._suppressions: Dict[int, Set[str]] = {}
+        # comment metadata is tokenize-extracted LAZILY: tokenizing is
+        # the second-largest per-file cost after ast.parse, and under
+        # --changed most modules' annotations are never consulted
+        self._comments_scanned = False
+        # flat node list from ONE ast.walk, shared by every pass that
+        # scans whole modules (nine passes re-walking 170+ trees is the
+        # difference between the <5s budget and blowing it)
+        self._nodes: Optional[List[ast.AST]] = None
+        self._functions: Optional[
+            List[Tuple[Optional[str], ast.AST]]] = None
+        self._calls: Optional[List[ast.Call]] = None
+        self._defs: Optional[List[ast.AST]] = None
+        self._cls_ranges: Optional[
+            List[Tuple[int, int, ast.ClassDef]]] = None
+        self._def_ranges: Optional[
+            List[Tuple[int, int, ast.AST]]] = None
+
+    @property
+    def guarded_lines(self) -> Dict[int, str]:
+        if not self._comments_scanned:
+            self._scan_comments()
+        return self._guarded_lines
+
+    @property
+    def loop_only_lines(self) -> Set[int]:
+        if not self._comments_scanned:
+            self._scan_comments()
+        return self._loop_only_lines
+
+    @property
+    def suppressions(self) -> Dict[int, Set[str]]:
+        if not self._comments_scanned:
+            self._scan_comments()
+        return self._suppressions
 
     def _scan_comments(self) -> None:
-        # tokenizing is the dominant per-file cost; most files carry
-        # neither annotation — the substring gate keeps the pre-commit
-        # --changed path under the ~2s budget
+        self._comments_scanned = True
+        # most files carry neither annotation: the substring gate skips
+        # the tokenizer for them outright
         if "#:" not in self.source and "raylint:" not in self.source:
             return
         try:
@@ -81,17 +119,108 @@ class Module:
                     continue
                 m = GUARDED_RE.search(tok.string)
                 if m:
-                    self.guarded_lines[tok.start[0]] = m.group("lock")
+                    self._guarded_lines[tok.start[0]] = m.group("lock")
+                if LOOP_ONLY_RE.search(tok.string):
+                    self._loop_only_lines.add(tok.start[0])
                 m = DISABLE_RE.search(tok.string)
                 if m:
                     ids = {s.strip() for s in m.group("ids").split(",")}
-                    self.suppressions.setdefault(
+                    self._suppressions.setdefault(
                         tok.start[0], set()).update(ids)
         except tokenize.TokenError:
             pass    # unterminated string etc.: annotations best-effort
 
     def suppressed(self, pass_id: str, line: int) -> bool:
         return pass_id in self.suppressions.get(line, ())
+
+    def walk(self) -> List[ast.AST]:
+        """Every node in the tree, cached. Hand-rolled traversal —
+        ``list(ast.walk(...))`` pays a generator frame plus an
+        ``iter_fields`` generator per node, which at 240k+ nodes is a
+        measurable slice of the --changed budget."""
+        if self._nodes is None:
+            nodes = [self.tree]
+            append = nodes.append
+            AST, lst = ast.AST, list
+            i = 0
+            while i < len(nodes):
+                node = nodes[i]
+                i += 1
+                for name in node._fields:
+                    value = getattr(node, name, None)
+                    if isinstance(value, AST):
+                        append(value)
+                    elif isinstance(value, lst):
+                        for item in value:
+                            if isinstance(item, AST):
+                                append(item)
+            self._nodes = nodes
+        return self._nodes
+
+    def functions(self) -> List[Tuple[Optional[str], ast.AST]]:
+        """Cached ``iter_functions`` result (top-level + method defs)."""
+        if self._functions is None:
+            self._functions = list(iter_functions(self.tree))
+        return self._functions
+
+    def calls(self) -> List[ast.Call]:
+        """Every Call node, cached. Most whole-program passes key on
+        call shapes — iterating this list beats re-filtering the full
+        node list (Calls are ~10% of nodes) in every one of them."""
+        if self._calls is None:
+            self._calls = [n for n in self.walk()
+                           if n.__class__ is ast.Call]
+        return self._calls
+
+    def defs(self) -> List[ast.AST]:
+        """Every (async) function def node at any nesting, cached."""
+        if self._defs is None:
+            self._defs = [n for n in self.walk()
+                          if isinstance(n, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))]
+        return self._defs
+
+    def enclosing_class(self, line: int) -> Optional[str]:
+        """Name of the innermost class whose body spans ``line``."""
+        node = self.enclosing_class_node(line)
+        return node.name if node is not None else None
+
+    def enclosing_class_node(self, line: int) -> Optional[ast.ClassDef]:
+        """The innermost ClassDef whose body spans ``line``."""
+        if self._cls_ranges is None:
+            self._cls_ranges = [
+                (n.lineno, n.end_lineno or n.lineno, n)
+                for n in self.walk() if n.__class__ is ast.ClassDef]
+        best = None
+        for lo, hi, node in self._cls_ranges:
+            if lo <= line <= hi and (best is None or lo > best[0]):
+                best = (lo, node)
+        return best[1] if best else None
+
+    def enclosing_def(self, line: int) -> Optional[ast.AST]:
+        """The innermost (async) def whose body spans ``line``, or
+        None at module level. Range-based — resolving scope for the
+        handful of interesting nodes a pass finds is far cheaper than
+        threading scope through a full traversal."""
+        if self._def_ranges is None:
+            self._def_ranges = [
+                (n.lineno, n.end_lineno or n.lineno, n)
+                for n in self.defs()]
+        best = None
+        for lo, hi, node in self._def_ranges:
+            if lo <= line <= hi and (best is None or lo > best[0]):
+                best = (lo, node)
+        return best[1] if best else None
+
+    def loop_only(self, fn: ast.AST) -> bool:
+        """Is this def annotated ``#: loop-only``? The annotation sits
+        on the ``def`` line itself or on the line directly above it
+        (mirroring guarded-by's own-line binding rule)."""
+        line = getattr(fn, "lineno", None)
+        if line is None:
+            return False
+        return (line in self.loop_only_lines
+                or (line - 1) in self.loop_only_lines)
 
 
 @dataclass
@@ -102,17 +231,27 @@ class Context:
     # docs/tests content for cross-artifact passes; None -> read from
     # repo_root lazily (tests inject synthetic content here)
     docs_fault_tolerance: Optional[str] = None
+    docs_observability: Optional[str] = None
     tests_sources: Optional[Dict[str, str]] = None
 
     def fault_tolerance_doc(self) -> str:
         if self.docs_fault_tolerance is None:
-            p = os.path.join(self.repo_root, "docs", "fault_tolerance.md")
-            try:
-                with open(p, "r", encoding="utf-8") as f:
-                    self.docs_fault_tolerance = f.read()
-            except OSError:
-                self.docs_fault_tolerance = ""
+            self.docs_fault_tolerance = self._read_doc(
+                "fault_tolerance.md")
         return self.docs_fault_tolerance
+
+    def observability_doc(self) -> str:
+        if self.docs_observability is None:
+            self.docs_observability = self._read_doc("observability.md")
+        return self.docs_observability
+
+    def _read_doc(self, name: str) -> str:
+        p = os.path.join(self.repo_root, "docs", name)
+        try:
+            with open(p, "r", encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return ""
 
     def test_sources(self) -> Dict[str, str]:
         if self.tests_sources is None:
@@ -350,11 +489,21 @@ def tracked_lock_name(value: ast.AST) -> Optional[str]:
     return None
 
 
+def has_locky_source(module: Module) -> bool:
+    """Cheap substring gate: can this module mention a lock-like name
+    at all? ("ock" covers Lock/RLock/_lock/wlock; cv/cond/mutex the
+    rest.) Conservative — matching is cheap, missing is not allowed."""
+    s = module.source
+    return ("ock" in s or "cv" in s or "cond" in s or "mutex" in s)
+
+
 def class_lock_names(module: Module) -> Dict[Tuple[str, str], str]:
     """(ClassName, attr) -> stable lock-class name for every lock-like
     attribute assigned in a class body. tracked_lock("x") names win;
     plain locks fall back to ``module.Class.attr``."""
     out: Dict[Tuple[str, str], str] = {}
+    if not has_locky_source(module):
+        return out
     for node in module.tree.body:
         if not isinstance(node, ast.ClassDef):
             continue

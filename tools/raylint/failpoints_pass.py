@@ -31,9 +31,9 @@ def _fire_sites(ctx: Context) -> Dict[str, List[Tuple[str, int]]]:
     for module in ctx.modules:
         if module.name == "failpoints":
             continue        # the registry itself, not a seam
-        for node in ast.walk(module.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        if "fire(" not in module.source:
+            continue        # no call site can match
+        for node in module.calls():
             fname = None
             if isinstance(node.func, ast.Attribute):
                 fname = node.func.attr

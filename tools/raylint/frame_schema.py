@@ -1,0 +1,330 @@
+"""frame-schema pass: payload keys written at send sites match reads.
+
+rpc-drift checks that method NAMES line up; this pass extends the
+check to payload SHAPE. For every declared method, the string-literal
+keys written at each send site (``client.call("x", k=...)`` keywords,
+plus ``**kw`` splats resolved against a same-function ``kw = {...}``
+dict literal and ``kw["k"] = ...`` stores) are diffed against the keys
+the matching consumer reads — ``handle_x(self, conn, rid, msg)`` for
+calls, the ``method == "x"`` branch of an ``_on_push`` demux for
+pushes. Two drift directions:
+
+- ``missing-key``: the consumer does ``msg["k"]`` (a REQUIRED read —
+  ``msg.get("k")`` is optional by construction) but no send site ever
+  writes ``k``. Flagged at the handler, only when every send site's
+  key set resolved fully (an opaque splat means we cannot prove
+  absence).
+- ``dead-key``: a send site writes ``k`` but no consumer ever reads it
+  and the consumer does not forward ``msg`` onward (a handler that
+  hands ``msg`` to a helper — or aliases/splats/iterates it — may
+  read anything, so dead-key is skipped for it). Flagged at the send
+  site.
+
+Keys the consumer itself stores into ``msg`` (``msg["_t0"] = ...``)
+are locally materialized, not wire keys, and are excluded from both
+directions.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.raylint.core import Context, Finding, Module, register
+from tools.raylint.rpc_drift import _first_str_arg, _imports_rpc
+
+PASS_ID = "frame-schema"
+
+CALL_ATTRS = {"call", "_call", "notify"}
+PUSH_ATTRS = {"push", "notify_driver"}
+# msg methods that read a single string-keyed entry
+READ_METHODS = {"get", "pop"}
+# named parameters of the wire API itself (Client.call(method,
+# timeout=None, **kw)): consumed by the transport, never in the payload
+TRANSPORT_KWARGS = {"timeout"}
+
+
+class _SendSite:
+    def __init__(self, module: Module, line: int, where: str,
+                 kind: str) -> None:
+        self.module = module
+        self.line = line
+        self.where = where
+        self.kind = kind            # "call" | "push"
+        self.keys: Set[str] = set()
+        self.resolved = True        # False when a splat defeats us
+
+
+class _Consumer:
+    def __init__(self) -> None:
+        self.required: Set[str] = set()     # msg["k"] loads
+        self.optional: Set[str] = set()     # msg.get/pop/"k" in msg
+        self.local: Set[str] = set()        # msg["k"] = ... stores
+        self.forwards = False               # msg escapes whole
+
+    def merge(self, other: "_Consumer") -> None:
+        self.required |= other.required
+        self.optional |= other.optional
+        self.local |= other.local
+        self.forwards = self.forwards or other.forwards
+
+    def reads(self) -> Set[str]:
+        return self.required | self.optional
+
+
+def _scan_msg_uses(body: List[ast.stmt], msg_name: str) -> _Consumer:
+    """Collect how ``msg_name`` is used in a statement list. Any use
+    that is not a recognized per-key read marks the consumer as
+    forwarding (conservative)."""
+    out = _Consumer()
+    consumed: Set[int] = set()      # id() of Name nodes used structurally
+    names: List[ast.Name] = []
+    todo: List[ast.AST] = list(body)
+    while todo:
+        node = todo.pop()
+        todo.extend(ast.iter_child_nodes(node))
+        if isinstance(node, ast.Name) and node.id == msg_name:
+            names.append(node)
+            continue
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == msg_name
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, str)):
+            consumed.add(id(node.value))
+            key = node.slice.value
+            if isinstance(node.ctx, ast.Load):
+                out.required.add(key)
+            else:
+                out.local.add(key)
+            continue
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == msg_name
+                and node.func.attr in READ_METHODS
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            consumed.add(id(node.func.value))
+            out.optional.add(node.args[0].value)
+            continue
+        if (isinstance(node, ast.Compare)
+                and len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                and isinstance(node.left, ast.Constant)
+                and isinstance(node.left.value, str)
+                and len(node.comparators) == 1
+                and isinstance(node.comparators[0], ast.Name)
+                and node.comparators[0].id == msg_name):
+            consumed.add(id(node.comparators[0]))
+            out.optional.add(node.left.value)
+            continue
+    if any(id(n) not in consumed for n in names):
+        out.forwards = True         # bare msg escaped somewhere
+    return out
+
+
+def _arg_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [x.arg for x in a.posonlyargs + a.args]
+
+
+def _handler_msg_param(fn: ast.AST) -> Optional[str]:
+    names = _arg_names(fn)
+    if "msg" in names:
+        return "msg"
+    # handle_x(self, conn, rid, msg): the 4th positional
+    return names[3] if len(names) >= 4 else None
+
+
+def _demux_params(fn: ast.AST) -> Tuple[Optional[str], Optional[str]]:
+    names = _arg_names(fn)
+    method = "method" if "method" in names else None
+    msg = "msg" if "msg" in names else None
+    if method is None and len(names) >= 2:
+        method = names[1] if names[0] == "self" else names[0]
+    if msg is None and len(names) >= 3:
+        msg = names[2] if names[0] == "self" else names[1]
+    return method, msg
+
+
+def _branch_methods(test: ast.AST, method_param: str) -> Set[str]:
+    """``method == "x"`` / ``method in ("x", "y")`` -> {"x", "y"}."""
+    out: Set[str] = set()
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.left, ast.Name)
+            and test.left.id == method_param):
+        return out
+    comp = test.comparators[0]
+    if isinstance(test.ops[0], ast.Eq):
+        if (isinstance(comp, ast.Constant)
+                and isinstance(comp.value, str)):
+            out.add(comp.value)
+    elif isinstance(test.ops[0], ast.In):
+        if isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+            for el in comp.elts:
+                if (isinstance(el, ast.Constant)
+                        and isinstance(el.value, str)):
+                    out.add(el.value)
+    return out
+
+
+def _resolve_splat(fn: ast.AST, splat: ast.AST) -> Optional[Set[str]]:
+    """Keys of a ``**kw`` splat when ``kw`` is a same-function dict
+    literal (all-string keys) plus ``kw["k"] = ...`` stores."""
+    if not isinstance(splat, ast.Name):
+        return None
+    keys: Optional[Set[str]] = None
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == splat.id
+                        for t in node.targets)):
+            if not isinstance(node.value, ast.Dict):
+                return None
+            ks: Set[str] = set()
+            for k in node.value.keys:
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    return None     # computed key / nested splat
+                ks.add(k.value)
+            keys = ks if keys is None else keys | ks
+        elif (isinstance(node, ast.Subscript)
+              and isinstance(node.ctx, ast.Store)
+              and isinstance(node.value, ast.Name)
+              and node.value.id == splat.id
+              and isinstance(node.slice, ast.Constant)
+              and isinstance(node.slice.value, str)):
+            if keys is not None:
+                keys.add(node.slice.value)
+    return keys
+
+
+@register(PASS_ID)
+def run(ctx: Context) -> List[Finding]:
+    sends: Dict[Tuple[str, str], List[_SendSite]] = {}
+    consumers: Dict[Tuple[str, str], _Consumer] = {}
+    declared: Set[str] = set()
+
+    for module in ctx.modules:
+        if not _imports_rpc(module):
+            continue
+        for node in module.calls():
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id == "declare"):
+                name = _first_str_arg(node)
+                if name:
+                    declared.add(name)
+        # consumers: handlers and push-demux branches
+        for fn_node in module.defs():
+            if fn_node.name.startswith("handle_"):
+                msg_p = _handler_msg_param(fn_node)
+                if msg_p is not None:
+                    key = ("call", fn_node.name[len("handle_"):])
+                    consumers.setdefault(key, _Consumer()).merge(
+                        _scan_msg_uses(fn_node.body, msg_p))
+            if fn_node.name in ("_on_push", "on_push"):
+                _scan_demux(fn_node, consumers)
+        _scan_sends(module, declared, sends)
+
+    findings: List[Finding] = []
+    for (kind, name), sites in sorted(sends.items()):
+        consumer = consumers.get((kind, name))
+        if consumer is None:
+            continue        # rpc-drift already owns missing handlers
+        sent_union: Set[str] = set()
+        all_resolved = True
+        for site in sites:
+            sent_union |= site.keys
+            all_resolved = all_resolved and site.resolved
+        # dead keys: per site, skippable when the consumer forwards
+        if not consumer.forwards:
+            reads = consumer.reads()
+            for site in sites:
+                if site.module.suppressed(PASS_ID, site.line):
+                    continue
+                for k in sorted(site.keys - reads):
+                    findings.append(Finding(
+                        PASS_ID, site.module.relpath, site.line,
+                        f"dead-key:{name}:{k}",
+                        f"{site.where} sends {name!r} key {k!r} that "
+                        f"no consumer of {name!r} ever reads"))
+        # missing keys: only when every site resolved fully
+        if all_resolved and sites:
+            missing = (consumer.required - sent_union
+                       - consumer.local)
+            site = sites[0]
+            for k in sorted(missing):
+                findings.append(Finding(
+                    PASS_ID, site.module.relpath, site.line,
+                    f"missing-key:{name}:{k}",
+                    f"consumer of {name!r} requires msg[{k!r}] but no "
+                    f"send site ever writes it"))
+    return findings
+
+
+def _scan_demux(fn: ast.AST, consumers: Dict[Tuple[str, str],
+                                             _Consumer]) -> None:
+    method_p, msg_p = _demux_params(fn)
+    if method_p is None or msg_p is None:
+        return
+    # pre-branch reads apply to every method this demux consumes
+    shared = _Consumer()
+
+    def walk_branches(stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                methods = _branch_methods(stmt.test, method_p)
+                if methods:
+                    branch = _scan_msg_uses(stmt.body, msg_p)
+                    for m in methods:
+                        c = consumers.setdefault(("push", m),
+                                                 _Consumer())
+                        c.merge(branch)
+                        c.merge(shared)
+                    walk_branches(stmt.orelse)
+                    continue
+                walk_branches(stmt.body)
+                walk_branches(stmt.orelse)
+                continue
+            # non-demux statement at demux level: its reads are shared
+            shared.merge(_scan_msg_uses([stmt], msg_p))
+
+    # shared reads must not mark every branch as forwarding just
+    # because a helper takes msg at the top level — track it separately
+    walk_branches(fn.body)
+    if shared.required or shared.optional or shared.forwards:
+        for (kind, _m), c in consumers.items():
+            if kind == "push":
+                c.merge(shared)
+
+
+def _scan_sends(module: Module, declared: Set[str],
+                sends: Dict[Tuple[str, str], List[_SendSite]]) -> None:
+    for node in module.calls():
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr in CALL_ATTRS | PUSH_ATTRS):
+            continue
+        name = _first_str_arg(node)
+        if name is None:
+            continue
+        kind = ("push" if node.func.attr in PUSH_ATTRS else "call")
+        if kind == "call" and name not in declared:
+            continue        # rpc-drift owns undeclared methods
+        # splat resolution and the ``where`` label both need the
+        # innermost enclosing def; module-level sends are out of scope
+        fn = module.enclosing_def(node.lineno)
+        if fn is None:
+            continue
+        site = _SendSite(module, node.lineno, fn.name, kind)
+        for kwarg in node.keywords:
+            if kwarg.arg is not None:
+                if kwarg.arg not in TRANSPORT_KWARGS:
+                    site.keys.add(kwarg.arg)
+                continue
+            resolved = _resolve_splat(fn, kwarg.value)
+            if resolved is None:
+                site.resolved = False
+            else:
+                site.keys |= resolved
+        sends.setdefault((kind, name), []).append(site)

@@ -32,6 +32,8 @@ PASS_ID = "guarded-by"
 def _annotations(module: Module) -> Dict[str, Dict[str, Tuple[str, int]]]:
     """ClassName -> {attr: (lock_expr, line)} from annotated assigns."""
     out: Dict[str, Dict[str, Tuple[str, int]]] = {}
+    if not module.guarded_lines:
+        return out      # nothing annotated: skip the per-class walks
     for node in module.tree.body:
         if not isinstance(node, ast.ClassDef):
             continue

@@ -20,11 +20,12 @@ so the baseline survives line churn.
 from __future__ import annotations
 
 import ast
+from bisect import bisect_left
 from typing import Dict, List, Optional, Set, Tuple
 
 from tools.raylint.core import (Context, Finding, FuncScanner, Module,
-                                class_lock_names, expr_name, is_locky,
-                                iter_functions, register,
+                                class_lock_names, expr_name,
+                                has_locky_source, is_locky, register,
                                 tracked_lock_name)
 
 PASS_ID = "lock-order"
@@ -69,8 +70,29 @@ def run(ctx: Context) -> List[Finding]:
         all_class_names.update(class_lock_names(module))
 
     for module in ctx.modules:
+        if not has_locky_source(module):
+            continue        # no lock-like name can appear: no edges
+        # an edge needs a with-block or a manual .acquire(); skip the
+        # (many) functions containing neither, found by line range
+        # against one sorted lineno list from the shared node cache
+        lock_lines: List[int] = []
+        for node in module.walk():
+            k = node.__class__
+            if k is ast.With or k is ast.AsyncWith:
+                lock_lines.append(node.lineno)
+            elif (k is ast.Call
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "acquire"):
+                lock_lines.append(node.lineno)
+        if not lock_lines:
+            continue
+        lock_lines.sort()
         mod_names = _module_lock_names(module)
-        for cls, fn in iter_functions(module.tree):
+        for cls, fn in module.functions():
+            lo, hi = fn.lineno, fn.end_lineno or fn.lineno
+            i = bisect_left(lock_lines, lo)
+            if i >= len(lock_lines) or lock_lines[i] > hi:
+                continue    # no acquisition site anywhere in this def
             _record_edges(module, cls, fn, all_class_names, mod_names,
                           edges)
 

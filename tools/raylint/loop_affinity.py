@@ -1,0 +1,160 @@
+"""loop-affinity pass: ``#: loop-only`` functions stay on the loop.
+
+Annotation syntax — a comment on the ``def`` line (or the line above)::
+
+    def _wake(self):   #: loop-only
+        ...
+
+A loop-only function mutates event-loop state (handles, transports,
+pending-task sets) and must ONLY execute on the loop thread. Callers
+in thread context reach it via ``loop.call_soon_threadsafe(self._wake)``
+— a *reference* hand-off, never a direct call.
+
+A direct call site is fine when the calling context is itself
+loop-affine:
+
+- the caller is an ``async def`` (coroutines only run on the loop);
+- the caller is itself annotated ``#: loop-only``;
+- the caller is a nested def whose NAME is handed to a loop-scheduling
+  API (``call_soon_threadsafe``/``call_soon``/``call_later``/
+  ``call_at``/``run_coroutine_threadsafe``/``create_task``/
+  ``ensure_future``/``add_done_callback``) somewhere in its enclosing
+  function — the loop-spawned-callback idiom.
+
+Everything else is a violation: a plain sync function (thread context
+until proven otherwise) calling a loop-only function directly races
+the loop. Lambda bodies are skipped entirely (deferred, context
+unknowable — prefer a named nested def, which IS checked).
+
+Scope: per module. Loop-only helpers are private by convention, so
+cross-module direct calls do not arise; matching bare attribute names
+package-wide would trade that for name-collision false positives.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.raylint.core import Context, Finding, Module, register
+
+PASS_ID = "loop-affinity"
+
+LOOP_SCHEDULE_ATTRS = {"call_soon_threadsafe", "call_soon", "call_later",
+                       "call_at", "run_coroutine_threadsafe",
+                       "create_task", "ensure_future",
+                       "add_done_callback"}
+
+
+def _loop_only_names(module: Module) -> Dict[str, str]:
+    """name -> "method" (defined in a class body, reached via
+    ``obj.name()``) or "function" (plain/nested def, reached via a bare
+    ``name()``). The call-shape split keeps an UNRELATED attribute that
+    happens to share a nested def's name (``self._pool.shutdown()`` vs
+    a loop-only ``def shutdown()``) from matching."""
+    names: Dict[str, str] = {}
+    methods: Set[str] = set()
+    for node in module.tree.body:
+        if isinstance(node, ast.ClassDef):
+            methods.update(sub.name for sub in node.body
+                           if isinstance(sub, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef)))
+    for node in module.walk():
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and module.loop_only(node)):
+            names[node.name] = ("method" if node.name in methods
+                                else "function")
+    return names
+
+
+def _scheduled_names(fn: ast.AST) -> Set[str]:
+    """Names passed by reference to a loop-scheduling API anywhere in
+    this function (NOT descending into nested defs — a hand-off in a
+    sibling scope proves nothing about this one)."""
+    out: Set[str] = set()
+    for node in _walk_same_scope(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in LOOP_SCHEDULE_ATTRS):
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Name):
+                out.add(arg.id)
+            elif isinstance(arg, ast.Attribute):
+                out.add(arg.attr)
+    return out
+
+
+def _walk_same_scope(fn: ast.AST):
+    """Walk a function body without entering nested function bodies
+    (the nested def NODE itself is yielded so callers can recurse)."""
+    todo = list(ast.iter_child_nodes(fn))
+    while todo:
+        node = todo.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        todo.extend(ast.iter_child_nodes(node))
+
+
+def _check_fn(module: Module, fn: ast.AST, loop_ctx: bool,
+              loop_names: Dict[str, str], where: str,
+              findings: List[Finding]) -> None:
+    scheduled = _scheduled_names(fn)
+    for node in _walk_same_scope(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            nested_ctx = (loop_ctx
+                          or isinstance(node, ast.AsyncFunctionDef)
+                          or module.loop_only(node)
+                          or node.name in scheduled)
+            _check_fn(module, node, nested_ctx, loop_names,
+                      f"{where}.{node.name}", findings)
+            continue
+        if loop_ctx or not isinstance(node, ast.Call):
+            continue
+        callee = None
+        if isinstance(node.func, ast.Name):
+            callee = node.func.id
+            if loop_names.get(callee) != "function":
+                continue
+        elif isinstance(node.func, ast.Attribute):
+            callee = node.func.attr
+            if loop_names.get(callee) != "method":
+                continue
+        else:
+            continue
+        if module.suppressed(PASS_ID, node.lineno):
+            continue
+        findings.append(Finding(
+            PASS_ID, module.relpath, node.lineno,
+            f"{where}->{callee}",
+            f"{callee}() is '#: loop-only' but {where}() calls it from "
+            f"thread context — hand it to the loop via "
+            f"call_soon_threadsafe instead"))
+
+
+@register(PASS_ID)
+def run(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in ctx.modules:
+        if not module.loop_only_lines:
+            continue
+        loop_names = _loop_only_names(module)
+        if not loop_names:
+            continue
+        for node in module.tree.body:
+            tops: List[Tuple[Optional[str], ast.AST]] = []
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                tops.append((None, node))
+            elif isinstance(node, ast.ClassDef):
+                tops.extend((node.name, sub) for sub in node.body
+                            if isinstance(sub, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef)))
+            for cls, fn in tops:
+                loop_ctx = (isinstance(fn, ast.AsyncFunctionDef)
+                            or module.loop_only(fn))
+                where = f"{cls}.{fn.name}" if cls else fn.name
+                _check_fn(module, fn, loop_ctx, loop_names, where,
+                          findings)
+    return findings

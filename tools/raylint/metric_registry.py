@@ -1,0 +1,81 @@
+"""metric-registry pass: every ray_tpu_* metric is in the catalogue.
+
+Same machine-check discipline as failpoint-registry, applied to the
+metrics surface: any ``ray_tpu_*`` series name the runtime can emit
+must appear in ``docs/observability.md``'s metric catalogue — an
+operator alerting off the docs must never meet an undocumented series
+(or grep for one that was renamed). Collected creation shapes:
+
+- registry constructors: ``Counter("ray_tpu_x", ...)`` /
+  ``Gauge(...)`` / ``Histogram(...)`` and the module-local
+  ``_counter``/``_gauge``/``_histogram`` helpers;
+- wire-entry dict literals: ``{"name": "ray_tpu_x", "kind": ...}``
+  (the cross-process delta format merged by ``merge_deltas``).
+
+Dynamically formatted names (f-strings) are not string constants and
+are skipped — document the PREFIX family in the catalogue and keep a
+``# raylint: disable=metric-registry`` near deliberate dynamic names
+if one ever needs the reminder.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from tools.raylint.core import Context, Finding, register
+
+PASS_ID = "metric-registry"
+
+CTOR_NAMES = {"Counter", "Gauge", "Histogram",
+              "_counter", "_gauge", "_histogram"}
+PREFIX = "ray_tpu_"
+
+
+def _metric_sites(ctx: Context) -> Dict[str, Tuple[str, int]]:
+    sites: Dict[str, Tuple[str, int]] = {}
+    for module in ctx.modules:
+        if PREFIX not in module.source:
+            continue
+        for node in module.walk():
+            name = None
+            if isinstance(node, ast.Call):
+                fname = None
+                if isinstance(node.func, ast.Name):
+                    fname = node.func.id
+                elif isinstance(node.func, ast.Attribute):
+                    fname = node.func.attr
+                if (fname in CTOR_NAMES and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)
+                        and node.args[0].value.startswith(PREFIX)):
+                    name = node.args[0].value
+            elif isinstance(node, ast.Dict):
+                keys = {k.value: v for k, v in zip(node.keys,
+                                                   node.values)
+                        if isinstance(k, ast.Constant)}
+                v = keys.get("name")
+                if ("kind" in keys and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)
+                        and v.value.startswith(PREFIX)):
+                    name = v.value
+            if name is None:
+                continue
+            if module.suppressed(PASS_ID, node.lineno):
+                continue
+            sites.setdefault(name, (module.relpath, node.lineno))
+    return sites
+
+
+@register(PASS_ID)
+def run(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    doc = ctx.observability_doc()
+    for name, (path, line) in sorted(_metric_sites(ctx).items()):
+        if f"`{name}`" in doc or name in doc:
+            continue
+        findings.append(Finding(
+            PASS_ID, path, line, f"undocumented:{name}",
+            f"metric {name!r} missing from docs/observability.md's "
+            f"metric catalogue"))
+    return findings

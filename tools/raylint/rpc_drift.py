@@ -38,7 +38,9 @@ PUSH_ATTRS = {"push", "notify_driver"}
 
 
 def _imports_rpc(module: Module) -> bool:
-    for node in ast.walk(module.tree):
+    if "rpc" not in module.source:
+        return False    # no import statement can name it
+    for node in module.walk():
         if isinstance(node, ast.ImportFrom):
             mod = node.module or ""
             if mod.endswith("rpc") or any(
@@ -82,19 +84,16 @@ def run(ctx: Context) -> List[Finding]:
             continue
         methods = _class_methods(module)
         # handler tables + push-demux literals
-        for node in ast.walk(module.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                if node.name.startswith("handle_"):
-                    handlers.add(node.name[len("handle_"):])
-                if node.name in ("_on_push", "on_push"):
-                    for sub in ast.walk(node):
-                        if (isinstance(sub, ast.Constant)
-                                and isinstance(sub.value, str)):
-                            push_consumers.add(sub.value)
+        for node in module.defs():
+            if node.name.startswith("handle_"):
+                handlers.add(node.name[len("handle_"):])
+            if node.name in ("_on_push", "on_push"):
+                for sub in ast.walk(node):
+                    if (isinstance(sub, ast.Constant)
+                            and isinstance(sub.value, str)):
+                        push_consumers.add(sub.value)
         # declare() schema table + client/push sites, with class context
-        for cls, scope in _walk_with_class(module.tree):
-            if not isinstance(scope, ast.Call):
-                continue
+        for scope in module.calls():
             fname = None
             if isinstance(scope.func, ast.Name):
                 fname = scope.func.id
@@ -107,6 +106,7 @@ def run(ctx: Context) -> List[Finding]:
                 declared.setdefault(name, (module.relpath, scope.lineno))
                 continue
             if fname in CALL_ATTRS | PUSH_ATTRS:
+                cls = module.enclosing_class(scope.lineno)
                 if (isinstance(scope.func, ast.Attribute)
                         and isinstance(scope.func.value, ast.Name)
                         and scope.func.value.id == "self"
@@ -149,15 +149,3 @@ def run(ctx: Context) -> List[Finding]:
                 f"declare({name!r}) has no handle_{name} on any linted "
                 f"service class"))
     return findings
-
-
-def _walk_with_class(tree: ast.Module):
-    """Yield (enclosing ClassDef name or None, node) for every node."""
-    def walk(node: ast.AST, cls: Optional[str]):
-        for child in ast.iter_child_nodes(node):
-            yield cls, child
-            child_cls = (child.name if isinstance(child, ast.ClassDef)
-                         else cls)
-            yield from walk(child, child_cls)
-
-    yield from walk(tree, None)

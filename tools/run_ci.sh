@@ -20,9 +20,11 @@ python -m pytest tests/test_observability.py tests/test_profiling.py -x -q
 
 echo "=== stage 0.5: raylint (static concurrency/protocol analysis) ==="
 # fail-fast AST passes: guarded-by, lock-order, blocking-under-lock,
-# rpc-drift, failpoint-registry (docs/static_analysis.md). Exit 1 =
-# NEW findings (baseline-covered ones pass); runs in ~2s so protocol
-# or lock-discipline drift surfaces before any suite boots a cluster.
+# rpc-drift, failpoint-registry, async-discipline, loop-affinity,
+# capability-drift, frame-schema (+ the metric-registry mini-pass) —
+# see docs/static_analysis.md. Exit 1 = NEW findings (baseline-covered
+# ones pass); runs in ~3s so protocol, lock-discipline, or asyncio-
+# readiness drift surfaces before any suite boots a cluster.
 python -m tools.raylint ray_tpu/
 
 echo "=== stage 1: full suite (in-process topology) ==="
