@@ -312,6 +312,63 @@ def _objects_probe(seconds_per_size: float = 1.5) -> dict:
                 pass
 
 
+def _multitenancy_probe(duration_s: float = 1.2) -> dict:
+    """Fair-share sample: two equal-weight tenant jobs drive the
+    control-plane loop concurrently through ``run_multi_job_load``
+    (fairshare admission on), reported as Jain's fairness index over
+    weight-normalized goodput plus the cross-job E2E p99 ratio
+    (docs/multitenancy.md). Best-effort and bounded: a failure must
+    never cost the benchmark its tokens/s line."""
+    out = {"fairness_index": 0.0, "isolation_p99_ratio": 0.0,
+           "fairshare_enabled": False}
+    own = False
+    try:
+        import ray_tpu
+        from ray_tpu.loadgen import SLO, LoadSpec, run_multi_job_load
+
+        own = not ray_tpu.is_initialized()
+        if own:
+            ray_tpu.init(num_nodes=1, resources={"CPU": 4},
+                         _system_config={"fairshare": True})
+
+        @ray_tpu.remote
+        def _unit():
+            return None
+
+        def target(payload, rec, t0):
+            ray_tpu.get(_unit.remote(), timeout=30.0)
+            now = time.perf_counter() - t0
+            rec.first_token_at = now
+            rec.finished_at = now
+            rec.output_tokens = 1
+
+        ray_tpu.get([_unit.remote() for _ in range(20)])    # warm
+        spec = LoadSpec(rate=120.0, duration_s=duration_s, clients=6,
+                        prompt_len=1, output_len=1, stream=False,
+                        timeout_s=30.0, drain_timeout_s=60.0,
+                        slo=SLO(ttft_s=10.0, e2e_s=10.0))
+        rep = run_multi_job_load(target, spec, jobs=2,
+                                 weights=[1.0, 1.0],
+                                 job_prefix="bench-tenant")
+        mt = rep["multitenancy"]
+        out["fairness_index"] = round(float(mt["fairness_index"]), 4)
+        out["isolation_p99_ratio"] = round(
+            float(mt["isolation_p99_ratio"]), 3)
+        from ray_tpu._private import worker as _worker
+        rt = _worker.global_runtime()
+        ten = getattr(rt, "tenancy", None)
+        out["fairshare_enabled"] = bool(ten is not None and ten.enabled)
+        return out
+    except Exception:
+        return out
+    finally:
+        if own:
+            try:
+                ray_tpu.shutdown()
+            except Exception:
+                pass
+
+
 def _tracing_overhead_probe() -> float:
     """Tracing overhead on the control-plane loop: balanced-order
     spans-on/spans-off pairs in one cluster, median of per-pair ratios
@@ -411,6 +468,10 @@ def _child() -> int:
             "tpu_fallback": result.get("tpu_fallback", True)}
         result["objects"] = {
             **_objects_probe(),
+            "platform": result.get("platform", "unknown"),
+            "tpu_fallback": result.get("tpu_fallback", True)}
+        result["multitenancy"] = {
+            **_multitenancy_probe(),
             "platform": result.get("platform", "unknown"),
             "tpu_fallback": result.get("tpu_fallback", True)}
     print(json.dumps(result))

@@ -45,6 +45,13 @@ CAPABILITY_FLAGS = {
         "guard": "objectplane",
         "doc": "daemon exposes the shm object arena (zero-copy gets)",
     },
+    "tenancy": {
+        "kind": "hello",
+        "guard": "_tenancy_supported",
+        "doc": "daemon accepts tenancy_sync job-table frames "
+               "(per-job quota/weight federation); drivers that never "
+               "see the bit fall back to unconditional admission",
+    },
     # driver -> daemon per-frame flags on capability-gated frames;
     # "requires" lists the hello guards that must dominate the send.
     "via_pump": {

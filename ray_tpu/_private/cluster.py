@@ -640,6 +640,10 @@ class DaemonHandle:
         # the task_batch_done pump — independent of submit batching, so
         # a submit_batch=False driver still drains coalesced
         self._result_batch = bool(out.get("result_batch"))
+        # fair-share federation: only daemons that advertised the
+        # tenancy capability receive tenancy_sync job tables (old
+        # daemons simply keep unconditional admission)
+        self._tenancy_supported = bool(out.get("tenancy"))
         self._job_id = job_id
         return out
 
@@ -747,9 +751,10 @@ class DaemonHandle:
     def _execute_fast(self, fl, spec, fid: str, args_blob: bytes):
         """Plain-task lane call; the daemon's Python never sees it."""
         from ray_tpu._private import fast_lane as _fle
-        payload = _fle.build_payload(spec, fid, args_blob,
-                                     getattr(self, "_job_id", None),
-                                     self.node_id)
+        payload = _fle.build_payload(
+            spec, fid, args_blob,
+            getattr(spec, "job_id", None) or getattr(self, "_job_id", None),
+            self.node_id)
 
         def on_gen(kind, blob):
             if kind == _fle.KIND_GEN_LIST:
@@ -982,7 +987,8 @@ class DaemonHandle:
         ran here)."""
         from ray_tpu._private import fast_lane as _fle
         payload = _fle.build_actor_payload(
-            spec, args_blob, getattr(self, "_job_id", None),
+            spec, args_blob,
+            getattr(spec, "job_id", None) or getattr(self, "_job_id", None),
             self.node_id)
 
         def on_gen(kind, blob):
@@ -1659,6 +1665,15 @@ class ClusterBackend:
                         continue  # lost report: retry next tick
                     last = loads  # only after a successful send
                     last_sent = now
+                # fair-share federation rides the same tick: dirty
+                # quota records to the head (persisted) + capable
+                # daemons, and the throttled per-job usage report
+                ten = getattr(self.runtime, "tenancy", None)
+                if ten is not None and ten.enabled:
+                    try:
+                        ten.maybe_sync(self)
+                    except Exception:
+                        pass  # dirty records retry next tick
 
         threading.Thread(target=loop, daemon=True,
                          name="resource-reporter").start()

@@ -190,6 +190,16 @@ FLAG_DEFS = [
          "first-backoff cap (exponential, full jitter)"),
     Flag("retry_max_backoff_s", float, 2.0, "RetryPolicy.default "
          "backoff cap ceiling"),
+    Flag("fairshare", bool, False, "multi-tenant fair share: DRF "
+         "admission verdicts at submit, per-job quota gates and "
+         "deficit-ordered batch admission in node dispatch; off keeps "
+         "the dispatch hot path byte-identical (Node.tenancy is None)"),
+    Flag("job_default_weight", float, 1.0, "fair-share weight assigned "
+         "to jobs that never declared one; deficit quanta are split "
+         "proportionally to weight among jobs with pending work"),
+    Flag("admission_queue_max", int, 4096, "bounded per-job pending "
+         "queue: tasks over quota beyond this many outstanding get a "
+         "REJECTED verdict (AdmissionRejectedError) instead of QUEUED"),
 ]
 
 FLAGS: Dict[str, Flag] = {f.name: f for f in FLAG_DEFS}

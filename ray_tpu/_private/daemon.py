@@ -87,6 +87,9 @@ declare("seal_object", "oid", "ref", "raw", "nbytes")
 declare("push_object", "oid", "to_addr", "ref")
 declare("push_chunk", "oid", "off", "total", "blob", "ref", "raw")
 declare("daemon_ping")
+# fair-share federation: the driver mirrors its per-job quota/weight
+# table here (capability-gated on the "tenancy" hello bit)
+declare("tenancy_sync", "jobs")
 # cross-language tier (C++ clients): names resolve through the head KV,
 # args/results are plain msgpack values — no Python pickles cross the
 # language boundary (reference: ray cross_language function descriptors)
@@ -1079,6 +1082,10 @@ class DaemonService:
                 # classic submit_task calls (via_pump)
                 "batch": True,
                 "result_batch": True,
+                # fair-share federation: this daemon accepts
+                # tenancy_sync job tables (old drivers never send
+                # them and keep unconditional admission)
+                "tenancy": True,
                 # zero-copy object plane: same-host clients attach this
                 # arena by name for direct puts / slot-ref'd gets
                 "objectplane": self.objects._shm is not None,
@@ -2251,6 +2258,24 @@ class DaemonService:
 
     def handle_daemon_ping(self, conn, rid, msg):
         return {"pid": os.getpid(), "node_id": self.node_id.hex()}
+
+    def handle_tenancy_sync(self, conn, rid, msg):
+        """Adopt the driver's per-job quota/weight table. The daemon is
+        not the admission authority (dispatch gating runs driver-side,
+        single-controller placement) — it mirrors the table so its own
+        /metrics lane exports the cluster's quota configuration even
+        when the driver is gone, and daemon_stats can show it."""
+        jobs = msg.get("jobs") or {}
+        self._tenancy_jobs = {str(j): dict(r) for j, r in jobs.items()}
+        for job, rec in self._tenancy_jobs.items():
+            for res, cap in (rec.get("quota") or {}).get(
+                    "hard", {}).items():
+                _metrics.Gauge(
+                    "ray_tpu_job_quota_bytes",
+                    "configured hard quota caps per job and resource "
+                    "axis", ("job_id", "resource")).set(
+                    float(cap), tags={"job_id": job, "resource": res})
+        return {"ok": True, "count": len(jobs)}
 
     def handle_daemon_stats(self, conn, rid, msg):
         with self._lock:

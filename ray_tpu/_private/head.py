@@ -89,6 +89,11 @@ declare("report_resources", "loads")
 declare("report_loads_gossip", "view")
 declare("task_events_push", "events")
 declare("task_events_get", "job_id", "name", "limit")
+# tenancy: persisted per-job quota/weight records (the admission
+# authority's durable store) + per-job accounting federation
+declare("tenancy_set", "job_id", "record")
+declare("tenancy_get")
+declare("tenancy_report", "jobs")
 declare("head_stop")
 
 # High-frequency gossip channels: never persisted, log trimmed to a
@@ -227,6 +232,10 @@ class _HeadStore:
 # they ALWAYS contain a colon — so a colon-free prefix can never collide
 # with (or leak into) any namespace's kv_get/kv_keys view.
 _DRAIN_KEY = b"\x00drain\x00"
+# Per-job tenancy records (quota/weight) persist under the same
+# colon-free raw-prefix scheme: ``--state-path`` survives head respawn,
+# so quotas outlive both the head process and the submitting driver.
+_TENANCY_KEY = b"\x00tenancy\x00"
 
 
 class HeadService:
@@ -265,6 +274,11 @@ class HeadService:
         # head restart (membership does not, so the record re-attaches
         # when the draining daemon re-registers after the respawn).
         self._drains: Dict[str, Tuple[float, str]] = {}  #: guarded by self._lock
+        # tenancy: job -> {"weight": .., "quota": {"hard": .., "soft": ..}}
+        # (persisted) and job -> latest reported usage row (replace
+        # semantics — each driver report supersedes its previous one).
+        self._tenancy: Dict[str, Dict[str, Any]] = {}  #: guarded by self._lock
+        self._tenancy_usage: Dict[str, Dict[str, Any]] = {}  #: guarded by self._lock
         if state_path:
             self._store = _HeadStore(state_path)
             self._kv, self._events = self._store.load()
@@ -276,6 +290,14 @@ class HeadService:
                         float(rec["deadline_wall"]), str(rec["reason"]))
                 except Exception:
                     # a malformed record must not keep the head down
+                    self._store.delete(key)
+            for key in [k for k in self._kv
+                        if k.startswith(_TENANCY_KEY)]:
+                blob = self._kv.pop(key)
+                try:
+                    self._tenancy[key[len(_TENANCY_KEY):].decode()] = (
+                        msgpack.unpackb(blob, raw=False))
+                except Exception:
                     self._store.delete(key)
         self._stop = threading.Event()
         self._monitor = threading.Thread(target=self._health_loop,
@@ -490,6 +512,34 @@ class HeadService:
                 parked[:] = [p for p in parked if p[0] is not conn]
 
     # -- internal KV -----------------------------------------------------
+    # -- tenancy: quota store + per-job accounting federation -----------
+    def handle_tenancy_set(self, conn, rid, msg):
+        """Upsert one job's quota/weight record (persisted)."""
+        job = str(msg["job_id"])
+        record = msg.get("record") or {}
+        with self._lock:
+            self._tenancy[job] = record
+            if self._store is not None:
+                self._store.put(_TENANCY_KEY + job.encode(),
+                                msgpack.packb(record, use_bin_type=True))
+        return {"ok": True}
+
+    def handle_tenancy_get(self, conn, rid, msg):
+        """All job records, with the latest federated usage merged in."""
+        with self._lock:
+            jobs = {j: dict(r) for j, r in self._tenancy.items()}
+            for j, usage in self._tenancy_usage.items():
+                jobs.setdefault(j, {})["usage"] = usage
+        return {"jobs": jobs}
+
+    def handle_tenancy_report(self, conn, rid, msg):
+        """Per-job accounting federation (replace semantics per job)."""
+        jobs = msg.get("jobs") or {}
+        with self._lock:
+            for j, row in jobs.items():
+                self._tenancy_usage[str(j)] = row
+        return {"ok": True, "count": len(jobs)}
+
     def handle_kv_put(self, conn, rid, msg):
         if _fp.ENABLED:
             # crash arm = head dies mid-put (the respawn/redial drill);
@@ -744,6 +794,17 @@ class HeadClient:
     def report_resources(self, loads: Dict[str, Dict[str, float]]) -> None:
         """Push per-node availability views (syncer gossip)."""
         self._call("report_resources", loads=loads, timeout=5.0)
+
+    # tenancy (fair-share quota store + accounting federation)
+    def tenancy_set(self, job_id: str, record: Dict[str, Any]) -> None:
+        self._call("tenancy_set", job_id=job_id, record=record,
+                   timeout=5.0)
+
+    def tenancy_get(self) -> Dict[str, Dict[str, Any]]:
+        return self._call("tenancy_get", timeout=5.0)["jobs"]
+
+    def tenancy_report(self, jobs: Dict[str, Any]) -> None:
+        self._call("tenancy_report", jobs=jobs, timeout=5.0)
 
     # kv
     def kv_put(self, key: bytes, value: bytes, overwrite: bool = True,

@@ -437,6 +437,14 @@ class _ExecPool:
                 self._spawned -= 1
 
 
+def _bucket_job(key: tuple) -> str:
+    """Job hex of a backlog bucket key. Tenancy-keyed buckets are
+    ``(job_hex, shape_tuple)``; plain ones are the shape tuple itself
+    (possible transiently around an enablement toggle) and attribute
+    to the anonymous driver job."""
+    return key[0] if (len(key) == 2 and isinstance(key[0], str)) else ""
+
+
 class Node:
     """One (virtual) node: resources + store + dispatch loop + actors."""
 
@@ -454,6 +462,15 @@ class Node:
         # enqueue so a proactive object push overlaps the task's queue
         # wait (reference: ObjectManager::Push ahead of task-arg pulls).
         self.prefetch: Optional[Callable[[TaskSpec], None]] = None
+        # Multi-tenant fair share (set by the runtime when the
+        # ``fairshare`` flag is on): backlog buckets become
+        # (job, shape)-keyed, admission runs in deficit order under
+        # per-job quota gates. None keeps this dispatch path identical
+        # to the single-tenant one.
+        self.tenancy = None
+        # last per-job backlog counts pushed to the tenancy ledger —
+        # dispatch-loop only; lets unchanged rounds skip the call
+        self._tenancy_qcounts: Dict[str, int] = {}
         # Graceful drain: alive + draining = finish running work, take
         # no new placements; the dispatch loop hands queued-but-
         # unstarted tasks back to the runtime for resubmission elsewhere.
@@ -558,7 +575,14 @@ class Node:
                     if spec is _WAKE:
                         timeout = 0.0
                         continue
+                    # re-read per spec: the runtime attaches the manager
+                    # right after construction, but this thread may have
+                    # captured a stale None before the first enqueue
+                    ten = self.tenancy
                     key = tuple(sorted(spec.resources.items()))
+                    if ten is not None:
+                        key = (spec.job_id.hex()
+                               if spec.job_id is not None else "", key)
                     bucket = self._backlog.get(key)
                     if bucket is None:
                         bucket = self._backlog[key] = deque()
@@ -567,6 +591,7 @@ class Node:
                     timeout = 0.0
             except queue.Empty:
                 pass
+            ten = self.tenancy
             if not self.alive:
                 self._fail_backlog()
                 continue
@@ -582,17 +607,38 @@ class Node:
                 self._resubmit_backlog()
             progressed = False
             self.loop_stats["dispatch_iterations"] += 1
-            for key in list(self._backlog):
+            if ten is not None and self._backlog:
+                # Deficit-ordered batch admission: a job's same-shape
+                # ready group is considered whole, highest fair-share
+                # deficit first (batch-DAG dispatch per 2002.07062) —
+                # a light job's small groups cut ahead of a saturating
+                # job's backlog instead of interleaving arbitrarily.
+                keys = ten.order_buckets(
+                    [((_bucket_job(k), k), len(b))
+                     for k, b in self._backlog.items()])
+                keys = [k for _job, k in keys]
+            else:
+                keys = list(self._backlog)
+            for key in keys:
                 bucket = self._backlog.get(key)
                 if bucket is None:
                     continue
                 while bucket:
+                    demand = bucket[0].resources
+                    want = len(bucket)
+                    if ten is not None:
+                        # per-job hard-cap gate: a clamped group stays
+                        # QUEUED in the backlog (never lost) until the
+                        # job's own completions free quota headroom
+                        want = ten.admit_cap(_bucket_job(key), demand,
+                                             want)
+                        if want <= 0:
+                            break
                     # Batch admission: every task in a bucket shares one
                     # resource shape, so ONE ledger lock round-trip
                     # admits as many as currently fit (per-task
                     # try_acquire paid a lock + dict scan per task).
-                    n = self.ledger.try_acquire_many(bucket[0].resources,
-                                                     len(bucket))
+                    n = self.ledger.try_acquire_many(demand, want)
                     if n <= 0:
                         break
                     admitted = [bucket.popleft() for _ in range(n)]
@@ -627,6 +673,8 @@ class Node:
                     # finish (and a get() observe it) before control
                     # returns here
                     self.loop_stats["tasks_launched"] += n
+                    if ten is not None:
+                        ten.note_admitted(_bucket_job(key), demand, n)
                     with self._running_lock:
                         self._running.update(s.task_id for s in admitted)
                     # ONE handoff for the whole admitted batch; the
@@ -638,6 +686,17 @@ class Node:
                     progressed = True
                 if not bucket:
                     self._backlog.pop(key, None)
+            if ten is not None:
+                counts: Dict[str, int] = {}
+                for k, b in self._backlog.items():
+                    job = _bucket_job(k)
+                    counts[job] = counts.get(job, 0) + len(b)
+                # unchanged since last round ⇒ the ledger already saw
+                # this state (idle deficit reset included) — skip the
+                # per-round lock round-trip
+                if counts != self._tenancy_qcounts:
+                    self._tenancy_qcounts = counts
+                    ten.observe_queued(self.node_id.hex(), counts)
             if self._backlog_n and not progressed:
                 self.ledger.wait_for_change(0.05)
 
@@ -654,6 +713,14 @@ class Node:
                 # the runtime releases them on actor death.
                 spec._resources_released = True
                 self.stage_release(spec.resources)
+            ten = self.tenancy
+            if ten is not None and spec.kind != TaskKind.ACTOR_CREATION:
+                # per-job usage attribution (lock-free append); actor
+                # creations are settled when the runtime releases the
+                # actor's lifetime hold
+                ten.note_done(spec.job_id.hex()
+                              if spec.job_id is not None else "",
+                              spec.resources)
 
     # -- coalesced ledger release (flat combining) -----------------------
     def stage_release(self, resources: Dict[str, float]) -> None:
@@ -783,6 +850,11 @@ class Node:
             if not getattr(spec, "_resources_released", True):
                 spec._resources_released = True
                 self.stage_release(spec.resources)
+                if self.tenancy is not None:
+                    self.tenancy.note_done(
+                        spec.job_id.hex()
+                        if spec.job_id is not None else "",
+                        spec.resources)
         for spec in handback:
             rt.on_node_task_drained(spec, self)
 

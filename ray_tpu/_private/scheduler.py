@@ -68,6 +68,11 @@ class ClusterScheduler:
         # (resource-shape, cluster-epoch) -> feasible candidate nodes
         self._feas_cache: Dict[tuple, Any] = {}  #: guarded by self._lock
         self._feas_epoch = -1                    #: guarded by self._lock
+        # Fair-share consult (set by the runtime when `fairshare` is
+        # on): over-cap jobs spread their queued work instead of
+        # packing, so per-node quota gates free uniformly and one
+        # node's backlog never pins a throttled job's whole deficit.
+        self.tenancy = None
 
     def pick_node(self, spec: TaskSpec, nodes: List[Node],
                   preferred: Optional[Node] = None) -> Optional[Node]:
@@ -76,6 +81,14 @@ class ClusterScheduler:
         Raises SchedulingError if no node can ever fit the demand.
         """
         strategy = spec.scheduling_strategy
+        if (strategy == "DEFAULT" and self.tenancy is not None
+                and self.tenancy.prefers_spread(
+                    spec.job_id.hex() if spec.job_id is not None
+                    else "")):
+            # feasibility caching below is keyed on resource shape
+            # only, so demoting pack->spread here cannot pollute the
+            # cached candidate sets
+            strategy = "SPREAD"
         if strategy == "DEFAULT" or strategy == "SPREAD":
             # hot path: plain strategies share one feasibility scan per
             # (resource shape, cluster epoch) — a burst of identical

@@ -184,6 +184,16 @@ class Runtime:
 
         self.gcs = GCS()
         self.scheduler = ClusterScheduler()
+        # Multi-tenant fair share (ray_tpu/tenancy): submit-time
+        # admission verdicts + deficit-ordered dispatch. The manager is
+        # always constructed (job records and /api/jobs work either
+        # way); enforcement only hooks into scheduler/node dispatch
+        # when the `fairshare` flag is on, so the single-tenant hot
+        # path stays untouched.
+        from ray_tpu.tenancy import TenancyManager
+        self.tenancy = TenancyManager(runtime=self)
+        if self.tenancy.enabled:
+            self.scheduler.tenancy = self.tenancy
         self.futures = FutureTable()
         self.lineage = LineageTable()
         self.refcounter = ReferenceCounter(on_zero=self._free_object)
@@ -305,6 +315,10 @@ class Runtime:
             for _ in range(num_nodes):
                 self.add_node(dict(resources_per_node),
                               object_store_memory=object_store_memory)
+        if self.cluster_backend is not None and self.tenancy.enabled:
+            # adopt quota records persisted at the head (other drivers
+            # or a previous incarnation of this one may have set them)
+            self.tenancy.load_from_head(self.cluster_backend.head)
 
     # ------------------------------------------------------------------
     # cluster topology
@@ -331,6 +345,8 @@ class Runtime:
                                    node_id.hex()[:8]))
         node = Node(node_id, resources, labels or {}, store,
                     execute_task=self._execute_on_node)
+        if self.tenancy.enabled:
+            node.tenancy = self.tenancy
         with self._nodes_lock:
             self._nodes[node_id] = node
         self.gcs.register_node(node.info())
@@ -347,6 +363,8 @@ class Runtime:
         store = RemoteStore(handle)
         node = Node(handle.node_id, resources, {}, store,
                     execute_task=self._execute_on_remote_node)
+        if self.tenancy.enabled:
+            node.tenancy = self.tenancy
         node.daemon = handle
         # proactive dep staging: enqueue-time pushes overlap the
         # transfer with the task's queue wait (PushManager dedupes)
@@ -947,6 +965,12 @@ class Runtime:
             raise RuntimeError(f"no daemon store on node {node_hex!r}")
         register(oid, bytes(key), int(nbytes),
                  raw=tuple(raw) if raw else None)
+        if self.tenancy.enabled:
+            from ray_tpu.tenancy import current_job_id
+            jid = current_job_id(self)
+            self.tenancy.note_put(
+                oid.hex(), jid.hex() if jid is not None else "",
+                int(nbytes))
         with self._loc_lock:
             self._locations.setdefault(oid, set()).add(node.node_id)
         ref = ObjectRef(oid, owner_hex=self.worker_id.hex(),
@@ -960,6 +984,11 @@ class Runtime:
         if nested:
             self.refcounter.add_nested_refs(oid, [r.id for r in nested])
         size = _nbytes_of(value)
+        if self.tenancy.enabled:
+            from ray_tpu.tenancy import current_job_id
+            jid = current_job_id(self)
+            self.tenancy.note_put(
+                oid.hex(), jid.hex() if jid is not None else "", size)
         if size <= INLINE_OBJECT_SIZE or prefer_node is None:
             self.memory_store.put(oid, value, nbytes=size)
             return
@@ -969,6 +998,8 @@ class Runtime:
 
     def _free_object(self, oid: ObjectID) -> None:
         """Refcount hit zero: drop the value everywhere + its lineage."""
+        if self.tenancy.enabled:
+            self.tenancy.note_free(oid.hex())
         self.memory_store.delete(oid)
         with self._loc_lock:
             locs = self._locations.pop(oid, set())
@@ -1080,6 +1111,11 @@ class Runtime:
     # ------------------------------------------------------------------
     def submit_task(self, spec: TaskSpec,
                     record_lineage: bool = True) -> List[ObjectRef]:
+        if self.tenancy.enabled:
+            # fair-share admission verdict; REJECTED raises
+            # AdmissionRejectedError here, before any future/lineage
+            # state exists (the backpressure contract)
+            self.tenancy.admit(spec)
         self.stats["tasks_submitted"] += 1
         trace_events.stamp_trace(spec)
         refs = [ObjectRef(oid, owner_hex=self.worker_id.hex(),
@@ -1316,7 +1352,8 @@ class Runtime:
         on the node's (driver-side) dispatch thread — the mesh-owning
         process, with XLA releasing the GIL."""
         token = runtime_context._set_context(
-            job_id=self.job_id, task_id=spec.task_id, node_id=node.node_id,
+            job_id=spec.job_id or self.job_id, task_id=spec.task_id,
+            node_id=node.node_id,
             actor_id=None, resources=spec.resources, task_name=spec.name,
             placement_group_id=spec.placement_group_id,
             pg_capture=spec.pg_capture)
@@ -1788,7 +1825,7 @@ class Runtime:
                 return
         if instance is None:
             token = runtime_context._set_context(
-                job_id=self.job_id, task_id=spec.task_id,
+                job_id=spec.job_id or self.job_id, task_id=spec.task_id,
                 node_id=node.node_id, actor_id=actor_id,
                 resources=spec.resources, task_name=spec.name,
                 placement_group_id=spec.placement_group_id,
@@ -1961,7 +1998,8 @@ class Runtime:
             self._finish_task(spec, node, error=te)
             return
         token = runtime_context._set_context(
-            job_id=self.job_id, task_id=spec.task_id, node_id=node.node_id,
+            job_id=spec.job_id or self.job_id, task_id=spec.task_id,
+            node_id=node.node_id,
             actor_id=spec.actor_id, resources=spec.resources,
             task_name=spec.name,
             placement_group_id=spec.placement_group_id,
@@ -2059,7 +2097,8 @@ class Runtime:
             self._finish_task(spec, node, error=te)
             return
         token = runtime_context._set_context(
-            job_id=self.job_id, task_id=spec.task_id, node_id=node.node_id,
+            job_id=spec.job_id or self.job_id, task_id=spec.task_id,
+            node_id=node.node_id,
             actor_id=spec.actor_id, resources=spec.resources,
             task_name=spec.name,
             placement_group_id=spec.placement_group_id,
@@ -2143,6 +2182,13 @@ class Runtime:
             host = self.get_node(info.node_id)
             if host is not None and host.alive:
                 host.ledger.release(info.creation_spec.resources)
+                if host.tenancy is not None:
+                    # settle the creation's per-job usage (held for the
+                    # actor's whole lifetime, see node._run_spec)
+                    host.tenancy.note_done(
+                        info.creation_spec.job_id.hex()
+                        if info.creation_spec.job_id is not None else "",
+                        info.creation_spec.resources)
             info.node_id = None
         can_restart = (may_restart and info.creation_spec is not None
                        and (graceful or info.max_restarts == -1

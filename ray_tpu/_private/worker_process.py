@@ -1665,7 +1665,7 @@ class WorkerClient:
     @staticmethod
     def _ctx_fields(spec: TaskSpec, node, runtime) -> Dict[str, Any]:
         return {
-            "job_id": runtime.job_id,
+            "job_id": getattr(spec, "job_id", None) or runtime.job_id,
             "task_id": spec.task_id,
             "node_id": node.node_id if node is not None else None,
             "actor_id": spec.actor_id,
@@ -2216,7 +2216,8 @@ class ProcessRouter:
         if fl is None:
             return None
         payload = _fle.build_payload(
-            spec, fid, args_blob, self.runtime.job_id,
+            spec, fid, args_blob,
+            getattr(spec, "job_id", None) or self.runtime.job_id,
             node.node_id if node is not None else None)
         try:
             rid, slot = fl.submit(payload)

@@ -9,6 +9,7 @@ from ray_tpu import exceptions as exc
 from ray_tpu._private import worker
 from ray_tpu._private.gcs import ActorState
 from ray_tpu._private.ids import ActorID, ObjectID, TaskID, next_seqno
+from ray_tpu.tenancy import context as _tenancy_ctx
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.runtime_env_packaging import \
     prepare_runtime_env as _prepare_runtime_env
@@ -111,7 +112,7 @@ class ActorHandle:
             return_ids=[ObjectID.from_random() for _ in range(n_ids)],
             max_retries=info.max_task_retries,
             scheduling_strategy="DEFAULT",
-            job_id=rt.job_id,
+            job_id=_tenancy_ctx.current_job_id(rt),
             actor_id=self._actor_id,
             method_name=method_name,
             seqno=next_seqno(),
@@ -174,7 +175,7 @@ class ActorClass:
             return_ids=[ObjectID.from_random()],
             scheduling_strategy=worker.capture_parent_pg_strategy(
                 options.get("scheduling_strategy", "DEFAULT")),
-            job_id=rt.job_id,
+            job_id=_tenancy_ctx.current_job_id(rt),
             actor_id=actor_id,
             max_restarts=options.get("max_restarts", 0),
             max_task_retries=options.get("max_task_retries", 0),

@@ -7,6 +7,9 @@ Endpoints (reference: dashboard modules `node`, `state`, `metrics`,
   GET /api/actors             actor table
   GET /api/placement_groups   placement groups
   GET /api/objects            object table
+  GET /api/jobs               per-tenant fair-share state (weights,
+                              quotas, usage, deficits) merged with the
+                              head's persisted quota records
   GET /api/cluster_status     resources + runtime stats summary
   GET /api/timeline           MERGED chrome-trace JSON: driver, daemon,
                               and worker lanes (head-store spans with
@@ -106,6 +109,29 @@ class _DashboardHandler(BaseHTTPRequestHandler):
                 # ray_config_def.h surface, observable)
                 from ray_tpu._private.config import cfg
                 self._json(cfg().describe())
+            elif path == "/api/jobs":
+                # per-tenant fair-share state: this driver's live
+                # ledger view, overlaid with quota/weight records
+                # persisted at the head (other drivers' jobs appear
+                # through the head federation)
+                rt = _worker.global_runtime()
+                ten = getattr(rt, "tenancy", None)
+                jobs = ten.jobs_view() if ten is not None else {}
+                backend = getattr(rt, "cluster_backend", None)
+                head = getattr(backend, "head", None)
+                if head is not None:
+                    try:
+                        for job, rec in (head.tenancy_get() or {}).items():
+                            row = jobs.setdefault(str(job), {})
+                            for k, v in dict(rec).items():
+                                row.setdefault(k, v)
+                    except Exception:
+                        pass  # head unreachable: local view only
+                self._json({
+                    "fairshare_enabled": bool(
+                        ten is not None and ten.enabled),
+                    "jobs": jobs,
+                })
             elif path == "/api/cluster_status":
                 rt = _worker.global_runtime()
                 import ray_tpu
@@ -152,7 +178,7 @@ class _DashboardHandler(BaseHTTPRequestHandler):
             elif path == "/api":
                 self._json({"endpoints": [
                     "/api/nodes", "/api/tasks", "/api/actors",
-                    "/api/placement_groups", "/api/objects",
+                    "/api/placement_groups", "/api/objects", "/api/jobs",
                     "/api/cluster_status", "/api/timeline", "/api/config",
                     "/api/serve", "/api/train", "/api/data",
                     "/api/profile", "/api/profile/cpu",

@@ -124,3 +124,10 @@ class OutOfMemoryError(RayTpuError):
 
 class PlacementGroupUnschedulableError(RayTpuError):
     """The placement group cannot fit in the cluster."""
+
+
+class AdmissionRejectedError(RayTpuError):
+    """Admission control rejected the submit: the job's bounded pending
+    queue (``admission_queue_max``) is full while the job is over its
+    quota. Backpressure signal — retry after completions free capacity,
+    or raise the job's quota/weight."""

@@ -111,9 +111,15 @@ class JobSubmissionClient:
                    metadata: Optional[Dict] = None,
                    submission_id: Optional[str] = None) -> str:
         job_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        # the supervisor actor is spawned inside the job's tenancy
+        # scope: the actor-creation spec and every task the entrypoint
+        # fans out inherit this job_id, so fair-share accounting and
+        # /api/jobs attribute the whole job tree to its tenant
+        from ray_tpu.tenancy import job_context
         sup_cls = ray_tpu.remote(_JobSupervisor)
-        sup = sup_cls.options(max_concurrency=4).remote(
-            job_id, entrypoint, runtime_env, metadata)
+        with job_context(job_id):
+            sup = sup_cls.options(max_concurrency=4).remote(
+                job_id, entrypoint, runtime_env, metadata)
         self._supervisors[job_id] = sup
         return job_id
 

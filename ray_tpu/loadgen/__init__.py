@@ -22,12 +22,14 @@ from ray_tpu.loadgen.arrival import (ARRIVAL_KINDS, LengthSampler,
 from ray_tpu.loadgen.recorder import (SLO, LatencyRecorder,
                                       RequestRecord, percentile)
 from ray_tpu.loadgen.runner import (HTTPTarget, HandleTarget, LoadSpec,
-                                    build_payloads, format_report,
-                                    run_load)
+                                    build_payloads, format_multi_report,
+                                    format_report, jain_fairness,
+                                    run_load, run_multi_job_load)
 
 __all__ = [
     "ARRIVAL_KINDS", "arrival_times", "LengthSampler",
     "SLO", "LatencyRecorder", "RequestRecord", "percentile",
     "LoadSpec", "HandleTarget", "HTTPTarget", "build_payloads",
     "run_load", "format_report",
+    "run_multi_job_load", "format_multi_report", "jain_fairness",
 ]

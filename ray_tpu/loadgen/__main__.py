@@ -59,6 +59,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="engine slots per replica (self-hosted)")
     p.add_argument("--max-seq", type=int, default=128,
                    help="engine max sequence length (self-hosted)")
+    p.add_argument("--jobs", type=int, default=1,
+                   help="run N concurrent tenant jobs (offered rate "
+                        "split evenly); reports per-job goodput plus "
+                        "Jain fairness + isolation p99 ratio")
+    p.add_argument("--job-weights", default="", metavar="W1,W2,...",
+                   help="per-job fair-share weights for --jobs "
+                        "(default: all 1.0)")
     p.add_argument("--json", default="", metavar="PATH",
                    help="also write the full JSON report to PATH")
     return p
@@ -109,7 +116,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     from ray_tpu.loadgen.recorder import SLO
     from ray_tpu.loadgen.runner import (HTTPTarget, LoadSpec,
-                                        format_report, run_load)
+                                        format_multi_report,
+                                        format_report, run_load,
+                                        run_multi_job_load)
 
     spec = LoadSpec(
         rate=args.rate, duration_s=args.duration, clients=args.clients,
@@ -125,20 +134,35 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         target, cleanup = _self_hosted_target(args, spec)
     try:
-        report = run_load(target, spec)
+        if args.jobs > 1:
+            weights = [float(w) for w in args.job_weights.split(",")
+                       if w.strip()]
+            report = run_multi_job_load(target, spec, jobs=args.jobs,
+                                        weights=weights)
+        else:
+            report = run_load(target, spec)
     finally:
         if cleanup is not None:
             cleanup()
 
-    print(format_report(report))
+    if args.jobs > 1:
+        print(format_multi_report(report))
+    else:
+        print(format_report(report))
     if args.json:
         with open(args.json, "w") as f:
             json.dump(report, f, indent=2)
             f.write("\n")
         print(f"report written to {args.json}")
     print(json.dumps(report))
-    errs = report["requests"]["errors"]
-    return 0 if report["requests"]["completed"] > 0 and not errs else 1
+    if args.jobs > 1:
+        reqs = [r["requests"] for r in report["jobs"].values()]
+        done = sum(r["completed"] for r in reqs)
+        errs = sum(r["errors"] for r in reqs)
+    else:
+        done = report["requests"]["completed"]
+        errs = report["requests"]["errors"]
+    return 0 if done > 0 and not errs else 1
 
 
 if __name__ == "__main__":

@@ -8,6 +8,7 @@ from typing import Any, Dict, List, Optional, Union
 
 from ray_tpu._private import worker
 from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu.tenancy import context as _tenancy_ctx
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.runtime_env_packaging import \
     prepare_runtime_env as _prepare_runtime_env
@@ -148,7 +149,7 @@ class RemoteFunction:
                 options.get("runtime_env")),
             scheduling_strategy=worker.capture_parent_pg_strategy(
                 options.get("scheduling_strategy", "DEFAULT")),
-            job_id=rt.job_id,
+            job_id=_tenancy_ctx.current_job_id(rt),
             backpressure_num_objects=options.get(
                 "_generator_backpressure_num_objects", -1),
             label_selector=options.get("label_selector"),

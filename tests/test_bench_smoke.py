@@ -63,3 +63,14 @@ def test_train_bench_child_cpu_smoke():
         for k in ("put_get_64KiB_mbps", "put_get_1MiB_mbps",
                   "put_get_16MiB_mbps"):
             assert k in obj
+        # fair-share rows (docs/multitenancy.md): the two-tenant probe
+        # runs with fairshare admission on and its keys are the
+        # contract the driver greps across rounds
+        mt = out.get("multitenancy")
+        assert mt is not None
+        assert "fairness_index" in mt
+        assert "isolation_p99_ratio" in mt
+        assert 0.0 <= mt["fairness_index"] <= 1.0
+        if mt["fairness_index"] > 0:        # probe succeeded
+            assert mt["fairshare_enabled"] is True
+            assert mt["isolation_p99_ratio"] >= 1.0

@@ -447,6 +447,8 @@ WIRED_SEAMS = [
     "batch.result_flush",
     "trace.flush",
     "profile.flush",
+    "admission.verdict",
+    "tenancy.quota_sync",
 ]
 
 

@@ -225,6 +225,44 @@ p_consistent = p_slower >= len(p_ratios) - 1
 results["profiling_overhead_pct"] = round(p_overhead, 1)
 results["profiling_overhead_consistent"] = bool(p_consistent)
 
+# probe 8: fair-share overhead on the queued-drain path — the SAME
+# submit-then-drain burst with the tenancy subsystem disabled
+# (`fairshare` off, the default — one enabled-flag check per submit)
+# vs fairshare ON (verdicts + ledger ordering + quota gates + per-task
+# accounting on the drain side). Both arms run on a FRESH cluster with
+# an identical warm-up so neither inherits the long-warmed state of
+# probes 1-7 (an asymmetric warm reads as fair-share overhead), and
+# the arms alternate to spread box drift evenly.
+# Budget: the ON path costs <= 3% drain rate vs OFF — which bounds the
+# off-path tax at strictly less (docs/multitenancy.md).
+
+
+def drain_rate(fairshare: bool, n=1500) -> float:
+    kw = {"_system_config": {"fairshare": True}} if fairshare else {}
+    ray_tpu.init(num_nodes=1, resources={"CPU": 8}, **kw)
+    ray_tpu.get([noop.remote() for _ in range(300)])    # warm pools
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n)]
+    t_submit = time.perf_counter() - t0
+    ray_tpu.get(refs)
+    rate = n / (time.perf_counter() - t0 - t_submit)
+    ray_tpu.shutdown()
+    return rate
+
+
+ray_tpu.shutdown()
+off_rates, on_rates = [], []
+for _ in range(3):
+    off_rates.append(drain_rate(False))
+    on_rates.append(drain_rate(True))
+fs_overhead = max(0.0, (1.0 - statistics.median(on_rates)
+                        / statistics.median(off_rates)) * 100.0)
+# cross-cluster rounds are noisier than same-cluster pairs: only a
+# separation of the full samples counts as a consistent regression
+fs_consistent = max(on_rates) < min(off_rates)
+results["fairshare_overhead_pct"] = round(fs_overhead, 1)
+results["fairshare_overhead_consistent"] = bool(fs_consistent)
+
 ray_tpu.shutdown()
 print(json.dumps(results, indent=2))
 
@@ -233,11 +271,13 @@ print(json.dumps(results, indent=2))
 # rate floors.
 TRACING_OVERHEAD_MAX = 5.0
 PROFILING_OVERHEAD_MAX = 3.0
+FAIRSHARE_OVERHEAD_MAX = 3.0
 
 if rebaseline:
     floors = {k: v for k, v in results.items()
               if not k.startswith(("tracing_overhead",
-                                   "profiling_overhead"))}
+                                   "profiling_overhead",
+                                   "fairshare_overhead"))}
     with open(FLOOR_PATH, "w") as fh:
         json.dump(floors, fh, indent=2)
         fh.write("\n")
@@ -267,7 +307,8 @@ if not _have_native and "put_get_1MiB_mbps" in floors:
 
 failed = False
 for name, floor in floors.items():
-    if name.startswith(("tracing_overhead", "profiling_overhead")):
+    if name.startswith(("tracing_overhead", "profiling_overhead",
+                        "fairshare_overhead")):
         continue    # legacy floor entry: budget-checked below instead
     got = results.get(name, 0.0)
     limit = floor * (1.0 - TOLERANCE)
@@ -301,6 +342,17 @@ print(f"profiling_overhead_pct: {p_overhead:.1f}% vs budget "
       f"({p_slower}/{len(p_ratios)} pairs slower, on/off ratios "
       f"{p_raw}) {p_verdict}")
 if p_trip:
+    failed = True
+fs_trip = fs_overhead > FAIRSHARE_OVERHEAD_MAX and fs_consistent
+fs_verdict = ("REGRESSION" if fs_trip else
+              "ok" if fs_overhead <= FAIRSHARE_OVERHEAD_MAX else
+              "ok (noise: overlapping samples)")
+fs_raw = ("on=[" + ", ".join(f"{r:,.0f}" for r in on_rates)
+          + "] off=[" + ", ".join(f"{r:,.0f}" for r in off_rates) + "]")
+print(f"fairshare_overhead_pct: {fs_overhead:.1f}% vs budget "
+      f"{FAIRSHARE_OVERHEAD_MAX:.0f}% (queued-drain rates/s {fs_raw}) "
+      f"{fs_verdict}")
+if fs_trip:
     failed = True
 sys.exit(1 if failed else 0)
 EOF
