@@ -480,6 +480,23 @@ void rtpu_ext_release(Store* s, uint32_t slot) {
   }
 }
 
+// Bulk slot decrement for crash reclamation: drop up to n refs in one
+// CAS (same floor-at-zero discipline as rtpu_ext_release) and return
+// how many were actually dropped, so the caller's grant ledger can
+// account for refs the dead client had already released locally.
+uint32_t rtpu_ext_release_n(Store* s, uint32_t slot, uint32_t n) {
+  if (slot >= RTPU_NSLOTS || n == 0) return 0;
+  uint32_t* p = &s->hdr()->slots[slot].refs;
+  uint32_t cur = __atomic_load_n(p, __ATOMIC_ACQUIRE);
+  while (cur > 0) {
+    uint32_t drop = cur < n ? cur : n;
+    if (__atomic_compare_exchange_n(p, &cur, cur - drop, false,
+                                    __ATOMIC_ACQ_REL, __ATOMIC_ACQUIRE))
+      return drop;
+  }
+  return 0;
+}
+
 uint32_t rtpu_ext_refs(Store* s, uint32_t slot) {
   if (slot >= RTPU_NSLOTS) return 0;
   return __atomic_load_n(&s->hdr()->slots[slot].refs, __ATOMIC_ACQUIRE);

@@ -631,6 +631,11 @@ class DaemonHandle:
                             and bool(_cfg().objectplane_attach))
         self.arena_name = out.get("arena")
         self.arena_capacity = int(out.get("arena_capacity") or 0)
+        # connection-scoped grant-ledger identity: the daemon charges
+        # every slot grant / reservation this driver requests to it and
+        # reclaims the lot if the connection dies (docs/object_plane.md
+        # "crash reclamation")
+        self.client_id = out.get("client_id")
         # protocol feature flag: daemons that understand push_task_batch
         # advertise it; anything older gets the per-task wire protocol
         from ray_tpu._private.config import cfg

@@ -204,6 +204,26 @@ class PreemptionWatcher:
 # object table: dict for small blobs, C++ shm arena for large ones
 # ---------------------------------------------------------------------------
 
+# Ledger identity for grants whose caller could not be established
+# (legacy callers, direct in-process use). Never swept by liveness —
+# reclaimed only when refs observably hit zero.
+UNKNOWN_CLIENT = "?"
+
+
+def _pid_alive(pid: int) -> bool:
+    """Liveness probe for the orphan sweep (signal 0 = existence check;
+    EPERM still proves the pid exists)."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    except OSError:
+        return False
+    return True
+
+
 class ObjectTable:
     def __init__(self, arena_name: str, capacity: int,
                  sweep: bool = True):
@@ -219,13 +239,24 @@ class ObjectTable:
         self._by_oid: Dict[bytes, bytes] = {}   #: guarded by self._lock
         self._ref_of: Dict[bytes, bytes] = {}   #: guarded by self._lock
         self._raw: Dict[bytes, Any] = {}        #: guarded by self._lock
-        # arena slots handed to external clients via get_ext_meta; the
+        # per-client grant ledger: every slot ref the owner increments
+        # on a client's behalf (get_ext_meta) is charged to that
+        # client's identity, so liveness-driven reclamation can drop a
+        # dead client's outstanding grants without a daemon restart.
+        # Clients release with SILENT local atomics, so a ledger count
+        # is an UPPER BOUND on what the client still holds — reclaim
+        # drops min(granted, observed_refs - other clients' ledger
+        # counts) and the orphan sweep trues up the residue (see
+        # docs/object_plane.md "crash reclamation").
+        self._ext_slots: Dict[str, Dict[int, int]] = {}  #: guarded by self._lock
+        # slot -> oid of the last grant (operator attribution); the
         # native lib has no slot-enumeration API, so leak observability
-        # (ray_tpu_arena_slot_refs) polls ext_refs() over this set. A
-        # SIGKILL'd client that never dropped its grant stays visible
-        # here instead of silently pinning arena bytes (docs/
-        # object_plane.md "limitations").
-        self._ext_slots: Dict[int, bytes] = {}  #: guarded by self._lock
+        # (ray_tpu_arena_slot_refs) polls ext_refs() over this set.
+        self._slot_owners: Dict[int, bytes] = {}  #: guarded by self._lock
+        # unsealed direct-put reservations: key -> (client_id, ts);
+        # popped at seal/abort, aborted by reclaim_client and by the
+        # heartbeat sweep once past the TTL.
+        self._reservations: Dict[bytes, Tuple[str, float]] = {}  #: guarded by self._lock
         self._shm = None
         if sweep:
             # stale-segment hygiene: a SIGKILL'd predecessor daemon of
@@ -281,55 +312,188 @@ class ObjectTable:
             return None
         return (self.arena_name, self.capacity, off, size)
 
-    def get_ext_meta(self, oid: bytes):
+    def get_ext_meta(self, oid: bytes, client_id: str = UNKNOWN_CLIENT):
         """(arena, capacity, off, size, slot) with the object's
         PROCESS-SHARED slot refcount incremented on the client's behalf
         (the client reads through its own mapping and drops the ref with
-        a local atomic — no release round trip), or None."""
+        a local atomic — no release round trip), or None. The grant is
+        charged to ``client_id`` in the ledger; incref + ledger entry
+        commit under one lock hold so reclaim/sweep never observe a ref
+        whose holder is not yet recorded."""
         if self._shm is None:
             return None
-        try:
-            off, size, slot = self._shm.get_ext(oid)
-        except Exception:
-            return None
         with self._lock:
-            self._ext_slots[slot] = oid
+            try:
+                off, size, slot = self._shm.get_ext(oid)
+            except Exception:
+                return None
+            grants = self._ext_slots.setdefault(client_id, {})
+            grants[slot] = grants.get(slot, 0) + 1
+            self._slot_owners[slot] = oid
         return (self.arena_name, self.capacity, off, size, slot)
 
-    def ext_release(self, slot: int) -> None:
-        if self._shm is not None:
+    def ext_release(self, slot: int, client_id: Optional[str] = None
+                    ) -> None:
+        """Owner-side slot release (the RPC fallback path for clients
+        with no local mapping). When the caller is identified, the
+        ledger charge drops with the ref so reclaim never re-drops it."""
+        if self._shm is None:
+            return
+        with self._lock:
             try:
                 self._shm.ext_release(slot)
             except Exception:
                 pass
+            if client_id is not None:
+                grants = self._ext_slots.get(client_id)
+                if grants and slot in grants:
+                    if grants[slot] <= 1:
+                        del grants[slot]
+                    else:
+                        grants[slot] -= 1
+                    if not grants:
+                        del self._ext_slots[client_id]
 
-    def slot_ref_stats(self) -> Dict[str, int]:
+    def slot_ref_stats(self, attribution: bool = False) -> Dict[str, Any]:
         """{"held": slots with outstanding external refs, "refs": total
         outstanding external refs} over every slot ever granted via
-        get_ext_meta. Fully-released slots leave tracking here; what
-        remains with refs > 0 is either live readers or a leaked grant
-        (SIGKILL'd client). Zeros on the dict-only fallback."""
+        get_ext_meta. Fully-released slots leave tracking here (their
+        ledger charges are cleared too — refs hitting zero proves every
+        grant was released); what remains with refs > 0 is live readers
+        or a not-yet-reclaimed grant. With ``attribution`` the reply
+        adds ``clients``: per-client ledger rows so operators can see
+        WHO holds a slot. Zeros on the dict-only fallback."""
         if self._shm is None:
-            return {"held": 0, "refs": 0}
-        with self._lock:
-            slots = list(self._ext_slots.items())
+            return {"held": 0, "refs": 0, "clients": []} if attribution \
+                else {"held": 0, "refs": 0}
         held = refs = 0
-        released = []
-        for slot, _oid in slots:
-            try:
-                n = int(self._shm.ext_refs(slot))
-            except Exception:
-                n = 0
-            if n > 0:
-                held += 1
-                refs += n
-            else:
-                released.append(slot)
-        if released:
-            with self._lock:
-                for slot in released:
-                    self._ext_slots.pop(slot, None)
-        return {"held": held, "refs": refs}
+        with self._lock:
+            tracked = set(self._slot_owners)
+            for grants in self._ext_slots.values():
+                tracked.update(grants)
+            released = []
+            for slot in tracked:
+                try:
+                    n = int(self._shm.ext_refs(slot))
+                except Exception:
+                    n = 0
+                if n > 0:
+                    held += 1
+                    refs += n
+                else:
+                    released.append(slot)
+            for slot in released:
+                self._slot_owners.pop(slot, None)
+                for cid in list(self._ext_slots):
+                    grants = self._ext_slots[cid]
+                    grants.pop(slot, None)
+                    if not grants:
+                        del self._ext_slots[cid]
+            out: Dict[str, Any] = {"held": held, "refs": refs}
+            if attribution:
+                out["clients"] = [
+                    {"client": cid,
+                     "slots": len(grants),
+                     "granted": sum(grants.values())}
+                    for cid, grants in sorted(self._ext_slots.items())]
+        return out
+
+    def ledger_clients(self) -> list:
+        """Client ids with outstanding grants or reservations (sweep
+        input: the service checks each for liveness)."""
+        with self._lock:
+            out = set(self._ext_slots)
+            out.update(cid for cid, _ts in self._reservations.values())
+            return sorted(out)
+
+    def reclaim_client(self, client_id: str) -> Tuple[int, int]:
+        """Drop a dead client's outstanding state: CAS-drop its slot
+        grants (bounded so a grant the client already released locally
+        — or a ref another live client holds — is never stolen), abort
+        its unsealed reservations, then reap so deferred deletes free
+        NOW rather than at daemon restart. Returns (refs dropped,
+        reservations aborted). Idempotent: a second call finds an empty
+        ledger and does nothing."""
+        with self._lock:
+            grants = self._ext_slots.pop(client_id, None) or {}
+            res_keys = [k for k, (cid, _ts) in self._reservations.items()
+                        if cid == client_id]
+            for k in res_keys:
+                self._reservations.pop(k, None)
+            dropped = 0
+            if self._shm is not None and grants:
+                # ledger counts of every OTHER still-registered client
+                # per slot: ledgers over-count (silent local releases),
+                # so observed - others is a SAFE LOWER BOUND on what the
+                # dead client still holds. Residue trues up in the
+                # orphan sweep once the co-holders release or die.
+                others: Dict[int, int] = {}
+                for grants_o in self._ext_slots.values():
+                    for slot, n in grants_o.items():
+                        if slot in grants:
+                            others[slot] = others.get(slot, 0) + n
+                for slot, granted in grants.items():
+                    try:
+                        observed = int(self._shm.ext_refs(slot))
+                    except Exception:
+                        continue
+                    n = min(granted, max(0, observed - others.get(slot, 0)))
+                    if n > 0:
+                        try:
+                            dropped += int(self._shm.ext_release_n(slot, n))
+                        except Exception:
+                            pass
+        for k in res_keys:
+            self.abort_reserve(k)
+        self.reap()
+        return dropped, len(res_keys)
+
+    def stale_reservations(self, ttl: float) -> list:
+        """Reservation keys older than ``ttl`` seconds (client reserved
+        arena space but never sealed or aborted — dead mid-direct-put)."""
+        now = time.monotonic()
+        with self._lock:
+            return [k for k, (_cid, ts) in self._reservations.items()
+                    if now - ts > ttl]
+
+    def sweep_orphan_slots(self) -> int:
+        """True-up pass for ledger drift. Two rules, both safe because
+        grants/reclaims serialize under the table lock: (a) a slot with
+        outstanding refs but NO ledger holder carries only refs of
+        already-reclaimed dead clients — force them to zero; (b) a slot
+        whose SINGLE holder's charge exceeds observed refs had silent
+        local releases — clamp the charge down (keeps ledger >= actual,
+        the invariant reclaim's bound depends on). Returns refs dropped."""
+        if self._shm is None:
+            return 0
+        dropped = 0
+        with self._lock:
+            holders: Dict[int, list] = {}
+            for cid, grants in self._ext_slots.items():
+                for slot in grants:
+                    holders.setdefault(slot, []).append(cid)
+            for slot in list(self._slot_owners):
+                try:
+                    observed = int(self._shm.ext_refs(slot))
+                except Exception:
+                    continue
+                held_by = holders.get(slot, [])
+                if observed > 0 and not held_by:
+                    try:
+                        dropped += int(self._shm.ext_release_n(slot,
+                                                               observed))
+                    except Exception:
+                        pass
+                elif len(held_by) == 1:
+                    grants = self._ext_slots[held_by[0]]
+                    if grants.get(slot, 0) > observed:
+                        if observed == 0:
+                            del grants[slot]
+                            if not grants:
+                                del self._ext_slots[held_by[0]]
+                        else:
+                            grants[slot] = observed
+        return dropped
 
     def release(self, oid: bytes) -> None:
         if self._shm is not None:
@@ -357,17 +521,24 @@ class ObjectTable:
             return self._raw.get(key)
 
     # -- direct-put (reserve + client write + seal) ----------------------
-    def reserve(self, key: bytes, size: int) -> Optional[int]:
+    def reserve(self, key: bytes, size: int,
+                client_id: str = UNKNOWN_CLIENT) -> Optional[int]:
         """Reserve arena space for a client-side write; None = no arena
         or no room (caller falls back to the blob path). Idempotent for
-        a retried reserve of the same (key, size)."""
+        a retried reserve of the same (key, size). The unsealed entry is
+        charged to ``client_id`` so a writer that dies between reserve
+        and seal gets its bytes reclaimed (reclaim_client or the TTL
+        sweep) instead of stranding them forever."""
         if self._shm is None:
             return None
         from ray_tpu.native_store import ShmStoreFull
         try:
-            return self._shm.reserve(key, size)
+            off = self._shm.reserve(key, size)
         except (ShmStoreFull, KeyError):
             return None
+        with self._lock:
+            self._reservations[key] = (client_id, time.monotonic())
+        return off
 
     def seal(self, key: bytes, ref: bytes = b"", raw=None) -> bool:
         """Seal a reserved entry (idempotent; pin matches put(pin=True)
@@ -378,6 +549,8 @@ class ObjectTable:
             self._shm.seal(key, pin=True)
         except KeyError:
             return False
+        with self._lock:
+            self._reservations.pop(key, None)
         self.register_oid(ref, key, raw=raw)
         return True
 
@@ -439,6 +612,7 @@ class ObjectTable:
         with self._lock:
             self._small.pop(oid, None)
             self._raw.pop(oid, None)
+            self._reservations.pop(oid, None)
             ref = self._ref_of.pop(oid, None)
             if ref is not None:
                 self._by_oid.pop(ref, None)
@@ -815,12 +989,14 @@ class DaemonRuntime:
         the heartbeat loop flushes it to the head)."""
         return self.service.task_events
 
-    def shm_ops(self, call: str, kw: Dict[str, Any]):
+    def shm_ops(self, call: str, kw: Dict[str, Any], client=None):
         """Daemon-LOCAL object-plane ops for this daemon's workers
         (never forwarded to the owner): meta resolution for zero-copy
         gets, reserve/seal/abort for direct puts. The worker side only
-        issues these once its arena attach succeeded."""
-        return self.service.handle_worker_shm_op(call, kw)
+        issues these once its arena attach succeeded. ``client`` is the
+        issuing WorkerClient — grants get charged to its (pid,
+        generation) identity for crash reclamation."""
+        return self.service.handle_worker_shm_op(call, kw, client)
 
     def forward_core_op(self, msg: Dict[str, Any]) -> Tuple[bool, bytes]:
         owner = self.service.owner
@@ -1091,7 +1267,11 @@ class DaemonService:
                 "objectplane": self.objects._shm is not None,
                 "arena": self.objects.arena_name,
                 "arena_capacity": (self.objects._shm.capacity()
-                                   if self.objects._shm else 0)}
+                                   if self.objects._shm else 0),
+                # connection-scoped grant-ledger identity: every slot
+                # grant / reservation this connection requests is
+                # charged here and reclaimed when the connection dies
+                "client_id": self._conn_client_id(conn)}
 
     def notify_driver(self, kind: str, **kw) -> None:
         conn = self.driver_conn
@@ -1099,6 +1279,15 @@ class DaemonService:
             conn.push(kind, **kw)
 
     def on_disconnect(self, conn: Connection) -> None:
+        cid = None
+        try:
+            cid = conn.meta.get("arena_client_id")
+        except Exception:
+            pass
+        if cid is not None:
+            # connection gone (clean close and SIGKILL look the same
+            # here): reclaim every grant/reservation charged to it
+            self.reclaim_client(cid, "disconnect")
         if conn is self.driver_conn:
             if self.persist:
                 # Shared cluster (`ray-tpu start`): drop the departed
@@ -1143,6 +1332,98 @@ class DaemonService:
                 client.kill(expected=True)
             except Exception:
                 pass
+
+    # -- object-plane crash reclamation ----------------------------------
+    def reclaim_client(self, client_id: str, reason: str
+                       ) -> Tuple[int, int]:
+        """One funnel for every death signal — worker pipe EOF, fast-
+        lane generation death, RPC connection close — that drops the
+        dead client's grants, aborts its reservations, and reaps, so
+        deferred deletes free NOW instead of at daemon restart. Returns
+        (refs dropped, reservations aborted); idempotent per client."""
+        if _fp.ENABLED:
+            try:
+                # drop/error arm = the event-path reclaim is LOST (the
+                # death signal raced a daemon hiccup); the heartbeat
+                # orphan sweep is the backstop and must still converge
+                # the leak gauge to zero
+                if _fp.fire("arena.grant_reclaim", client=client_id,
+                            reason=reason) is _fp.DROP:
+                    return (0, 0)
+            except Exception:
+                return (0, 0)
+        try:
+            dropped, aborted = self.objects.reclaim_client(client_id)
+        except Exception:
+            return (0, 0)   # reclamation must never take the daemon down
+        if dropped or aborted:
+            from ray_tpu.objectplane import tiers as _tiers
+            _tiers.count_grants_reclaimed(dropped, reason)
+        return dropped, aborted
+
+    def sweep_object_plane(self) -> None:
+        """Heartbeat orphan sweep: the backstop for anything the event-
+        path reclaim missed — reservations stale past the TTL (writer
+        died between reserve and seal), grants charged to worker pids
+        that no longer exist, and ledger drift from silent local
+        releases (sweep_orphan_slots). Faults here must never take the
+        beat down."""
+        obj = self.objects
+        if _fp.ENABLED:
+            try:
+                # drop/error arm = this sweep pass is skipped wholesale
+                # (a later beat retries); delay stretches the pass
+                if _fp.fire("arena.reservation_sweep") is _fp.DROP:
+                    return
+            except Exception:
+                return
+        try:
+            ttl = float(os.environ.get("RAY_TPU_ARENA_RESERVE_TTL_S",
+                                       "30"))
+        except ValueError:
+            ttl = 30.0
+        stale = obj.stale_reservations(ttl)
+        for key in stale:
+            try:
+                obj.abort_reserve(key)
+            except Exception:
+                pass
+        if stale:
+            from ray_tpu.objectplane import tiers as _tiers
+            _tiers.count_stale_reservations(len(stale))
+        # grants held by dead worker pids the pipe-EOF callback missed
+        for cid in obj.ledger_clients():
+            if not cid.startswith("w:"):
+                continue    # conn-scoped ids reclaim via on_disconnect
+            try:
+                pid = int(cid.split(":")[1])
+            except (IndexError, ValueError):
+                continue
+            if pid > 0 and not _pid_alive(pid):
+                self.reclaim_client(cid, "sweep")
+        dropped = obj.sweep_orphan_slots()
+        if dropped:
+            from ray_tpu.objectplane import tiers as _tiers
+            _tiers.count_grants_reclaimed(dropped, "sweep")
+        obj.reap()
+
+    def slot_ref_attribution(self) -> Dict[str, Any]:
+        """slot_ref_stats plus liveness: each ledger client row gains
+        its parsed pid (worker identities only) and whether that pid is
+        still alive, so operators can see WHO holds a slot and whether
+        the holder is a reclamation candidate."""
+        stats = self.objects.slot_ref_stats(attribution=True)
+        for row in stats.get("clients", ()):
+            pid = None
+            cid = row.get("client", "")
+            if cid.startswith("w:"):
+                try:
+                    pid = int(cid.split(":")[1])
+                except (IndexError, ValueError):
+                    pid = None
+            row["pid"] = pid
+            row["alive"] = _pid_alive(pid) if pid else None
+        return stats
 
     # -- worker lease protocol ------------------------------------------
     def handle_request_worker_lease(self, conn, rid, msg):
@@ -1607,18 +1888,41 @@ class DaemonService:
         return {"ok": True}
 
     # -- object plane -----------------------------------------------------
-    def handle_worker_shm_op(self, call: str, kw: Dict[str, Any]):
+    def _worker_client_id(self, client) -> str:
+        """Ledger identity for a pool worker: ``w:<pid>:<generation>``
+        (generation disambiguates a recycled pid). The FIRST grant arms
+        the crash hook — the pipe-EOF death callback fans into
+        reclaim_client, covering exit, crash, and SIGKILL alike."""
+        if client is None:
+            return UNKNOWN_CLIENT
+        cid = getattr(client, "arena_client_id", None)
+        if cid is None:
+            pid = getattr(getattr(client, "proc", None), "pid", 0) or 0
+            cid = f"w:{pid}:{getattr(client, 'gen', 0)}"
+            try:
+                client.arena_client_id = cid
+                client.add_death_callback(
+                    lambda _c, cid=cid: self.reclaim_client(cid, "death"))
+            except Exception:
+                pass
+        return cid
+
+    def handle_worker_shm_op(self, call: str, kw: Dict[str, Any],
+                             client=None):
         """Object-plane ops from this daemon's OWN workers, served over
         the worker pipe without touching the owner (the zero-copy
-        protocol's metadata leg — payloads never ride the pipe)."""
+        protocol's metadata leg — payloads never ride the pipe).
+        Grants and reservations are charged to the issuing worker's
+        ledger identity so its death reclaims them."""
         obj = self.objects
         if call == "shm_get_meta":
+            cid = self._worker_client_id(client)
             out = []
             for oid in kw["oids"]:
                 entry = None
                 key = obj.key_for(oid)
                 if key is not None:
-                    meta = obj.get_ext_meta(key)    # increfs ext slot
+                    meta = obj.get_ext_meta(key, cid)  # increfs ext slot
                     if meta is not None:
                         arena, cap, off, size, slot = meta
                         entry = {"arena": arena, "capacity": cap,
@@ -1627,11 +1931,13 @@ class DaemonService:
                 out.append(entry)
             return out
         if call == "shm_release":
+            cid = self._worker_client_id(client)
             for slot in kw.get("slots", ()):
-                obj.ext_release(slot)
+                obj.ext_release(slot, cid)
             return True
         if call == "shm_put_reserve":
-            off = obj.reserve(kw["key"], int(kw["size"]))
+            off = obj.reserve(kw["key"], int(kw["size"]),
+                              self._worker_client_id(client))
             if off is None:
                 return {"full": True}
             return {"off": off}
@@ -1652,11 +1958,26 @@ class DaemonService:
             self.objects.register_oid(key[4:], key)
         return {"ok": True}
 
+    def _conn_client_id(self, conn) -> str:
+        """Ledger identity for an RPC client (driver or external
+        attacher): minted at hello, or lazily here for attachers that
+        skip it — either way connection-scoped, so on_disconnect
+        reclaims everything charged to it."""
+        if conn is None:
+            return UNKNOWN_CLIENT
+        try:
+            import uuid
+            return conn.meta.setdefault(
+                "arena_client_id", f"c:{uuid.uuid4().hex[:12]}")
+        except Exception:
+            return UNKNOWN_CLIENT
+
     def handle_create_object(self, conn, rid, msg):
         """Reserve arena space for a same-host client's direct put (the
         client writes the payload through its own mapping, then
         seal_object). Idempotent for a retried (oid, size)."""
-        off = self.objects.reserve(msg["oid"], int(msg["size"]))
+        off = self.objects.reserve(msg["oid"], int(msg["size"]),
+                                   self._conn_client_id(conn))
         if off is None:
             return {"full": True}
         return {"ok": True, "off": off, "arena": self.objects.arena_name,
@@ -1678,7 +1999,8 @@ class DaemonService:
             # protocol (slot_ok) — an older driver would release via
             # release_object(oid), which decrements the entry's PIN
             # ref (corrupting ownership) and leaks the slot ref
-            meta = (self.objects.get_ext_meta(msg["oid"])
+            meta = (self.objects.get_ext_meta(msg["oid"],
+                                              self._conn_client_id(conn))
                     if msg.get("slot_ok") else None)
             if meta is not None:
                 # ext slot ref taken on the caller's behalf: the caller
@@ -1701,7 +2023,8 @@ class DaemonService:
     def handle_release_object(self, conn, rid, msg):
         if msg.get("slot") is not None:
             # ext-slot release fallback (client could not attach)
-            self.objects.ext_release(int(msg["slot"]))
+            self.objects.ext_release(int(msg["slot"]),
+                                     self._conn_client_id(conn))
             return {"ok": True}
         self.objects.release(msg["oid"])
         return {"ok": True}
@@ -2292,6 +2615,9 @@ class DaemonService:
                 "push_stats": dict(self.pushes.stats),
                 "push_rx_stats": dict(self.push_rx.stats),
                 "arena": self.objects.arena_name,
+                # grant-ledger leak observability with per-client
+                # attribution (who holds a slot, is the holder alive)
+                "slot_refs": self.slot_ref_attribution(),
                 "fast_lane": fast,
                 "agent_port": getattr(self, "agent_port", None),
                 "actors": len(
@@ -2373,19 +2699,44 @@ def _gate_profile_flush(last_push: float,
     return payload
 
 
+# per-client attribution series published last beat: departed clients'
+# series are removed (not left frozen at their last value) so the
+# dashboard never shows a reclaimed client as still holding slots
+_CLIENT_SERIES_SEEN: set = set()
+
+
 def _publish_object_plane_metrics(service: DaemonService) -> None:
     """Leak + transfer observability gauges, refreshed each beat so
     they ride the metrics snapshot to the head: arena slot grants still
-    referenced (a SIGKILL'd client's leaked grant shows up here) and
-    the push engine's cumulative/in-flight counters."""
+    referenced (a crashed client's not-yet-reclaimed grant shows up
+    here, attributed to its ledger identity) and the push engine's
+    cumulative/in-flight counters."""
     from ray_tpu.util.metrics import Gauge
-    slots = service.objects.slot_ref_stats()
+    slots = service.slot_ref_attribution()
     g = Gauge("ray_tpu_arena_slot_refs",
               "external arena slot grants: slots still referenced "
               "('held') and total outstanding refs ('refs')",
               tag_keys=("state",))
     g.set(float(slots["held"]), tags={"state": "held"})
     g.set(float(slots["refs"]), tags={"state": "refs"})
+    cg = Gauge("ray_tpu_arena_slot_clients",
+               "outstanding ledger grants per client identity "
+               "(alive=false rows are reclamation candidates)",
+               tag_keys=("client", "alive"))
+    live = set()
+    for row in slots.get("clients", ()):
+        alive = row.get("alive")
+        tags = {"client": row["client"],
+                "alive": "unknown" if alive is None else str(alive).lower()}
+        cg.set(float(row["granted"]), tags=tags)
+        live.add(tuple(sorted(tags.items())))
+    for stale in _CLIENT_SERIES_SEEN - live:
+        try:
+            cg.remove(dict(stale))
+        except Exception:
+            pass
+    _CLIENT_SERIES_SEEN.clear()
+    _CLIENT_SERIES_SEEN.update(live)
     push = Gauge("ray_tpu_push_stats",
                  "object-plane push engine counters (cumulative), "
                  "tx = PushManager, rx = PushReceiver",
@@ -2502,6 +2853,10 @@ def main() -> None:
             # silent atomics), publish host-tier occupancy — the gauge
             # rides the metrics snapshot below to the head
             service.objects.reap()
+            # orphan sweep: backstop for any death signal the event-
+            # path reclaim missed (stale reservations, dead-pid grants,
+            # ledger drift) — includes its own reap
+            service.sweep_object_plane()
             service.push_rx.sweep()
             _tiers.publish_tier_bytes(_tiers.TIER_HOST,
                                       service.objects.used_bytes())

@@ -8,7 +8,12 @@ ad-hoc monkeypatching.
 
 A *failpoint* is a named seam in the runtime (``"rpc.client.send"``,
 ``"daemon.push_task"``, ...) that calls :func:`fire` when the registry
-is active. An *arm* configured for that name decides what happens:
+is active. Seams cut REACTIONS as well as actions: the object-plane
+reclamation seams (``"arena.grant_reclaim"``,
+``"arena.reservation_sweep"``) suppress the daemon's *response* to a
+client death so chaos runs can prove the backstop (heartbeat sweep,
+event-path retry) still converges. An *arm* configured for that name
+decides what happens:
 
 =========== ==============================================================
 action      effect at the seam

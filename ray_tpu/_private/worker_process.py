@@ -1354,6 +1354,12 @@ def _untrack_after(router, task_id, it):
         router.untrack_task(task_id)
 
 
+# Monotonic spawn counter: (pid, generation) identifies a worker to the
+# object-plane grant ledger even if the OS recycles the pid within one
+# daemon lifetime.
+_WORKER_GEN = itertools.count(1)
+
+
 class WorkerClient:
     """Host handle to one worker process."""
 
@@ -1364,6 +1370,10 @@ class WorkerClient:
                         name="ray-tpu-worker")
         _start_sans_main(p)
         self.proc = _ProcHandle(p)
+        self.gen = next(_WORKER_GEN)
+        # set by the daemon at the worker's first arena grant; reclaim
+        # keys the grant ledger off it when the process dies
+        self.arena_client_id: Optional[str] = None
         child.close()
         # First frame: boot config (platform pinning etc.).
         self.conn.send_bytes(cloudpickle.dumps(boot))
@@ -1500,8 +1510,11 @@ class WorkerClient:
             if shm is not None:
                 # object-plane metadata ops are DAEMON-LOCAL: the whole
                 # point is that neither metadata resolution nor payload
-                # ever round-trips through the owner
-                value = shm(msg["call"], cloudpickle.loads(msg["payload"]))
+                # ever round-trips through the owner. The client handle
+                # rides along so grants are charged to THIS worker's
+                # (pid, generation) in the reclamation ledger.
+                value = shm(msg["call"], cloudpickle.loads(msg["payload"]),
+                            self)
                 reply = {"op": "reply", "for": msg["id"], "ok": True,
                          "value": cloudpickle.dumps(value)}
             elif local_fn is not None:

@@ -77,6 +77,15 @@ def _load():
         lib.rtpu_ext_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                      u64p, u64p, u32p]
         lib.rtpu_ext_release.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        try:
+            # Bulk decrement (crash reclamation); absent from .so builds
+            # that predate the grant ledger — callers fall back per-ref.
+            lib.rtpu_ext_release_n.restype = ctypes.c_uint32
+            lib.rtpu_ext_release_n.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_uint32,
+                                               ctypes.c_uint32]
+        except AttributeError:
+            pass
         lib.rtpu_ext_refs.restype = ctypes.c_uint32
         lib.rtpu_ext_refs.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         lib.rtpu_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
@@ -262,6 +271,29 @@ class ShmObjectStore:
             #         freed handle (the owner's reap tolerates the
             #         leaked count; a closed client is gone anyway)
         self._lib.rtpu_ext_release(self._handle, ctypes.c_uint32(slot))
+
+    def ext_release_n(self, slot: int, n: int) -> int:
+        """Drop up to ``n`` external refs from ``slot`` in one atomic op.
+
+        Returns the count actually dropped (the slot floors at zero, so
+        reclaiming a dead client's grants can never wrap the count or
+        steal refs that were already released locally).
+        """
+        if self._closed or n <= 0:
+            return 0
+        fn = getattr(self._lib, "rtpu_ext_release_n", None)
+        if fn is None:           # pre-ledger .so: decrement one at a time
+            dropped = 0
+            for _ in range(n):
+                if self._lib.rtpu_ext_refs(self._handle,
+                                           ctypes.c_uint32(slot)) == 0:
+                    break
+                self._lib.rtpu_ext_release(self._handle,
+                                           ctypes.c_uint32(slot))
+                dropped += 1
+            return dropped
+        return int(fn(self._handle, ctypes.c_uint32(slot),
+                      ctypes.c_uint32(n)))
 
     def ext_refs(self, slot: int) -> int:
         if self._closed:

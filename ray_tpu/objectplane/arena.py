@@ -19,6 +19,17 @@ Failure is never fatal: an attach that cannot map the segment (no
 native build, hardened /dev/shm, the ``shm.attach`` failpoint) disables
 the plane for this process and every operation falls back to the
 classic per-task RPC path.
+
+Crash safety: every slot ref the daemon increments on this process's
+behalf is charged to a per-client grant ledger keyed by this process's
+identity (workers: pid+generation, drivers: a connection-scoped id
+minted at hello). If this process dies without releasing — SIGKILL mid-
+view, mid-direct-put, whatever — the daemon's death signal (worker pipe
+EOF or RPC disconnect) funnels into ``reclaim_client``, which drops the
+outstanding grants, aborts unsealed reservations, and reaps; a
+heartbeat orphan sweep backstops any signal the event path missed. A
+crashed client therefore leaks nothing past the next beat — no daemon
+restart needed (docs/object_plane.md "crash reclamation").
 """
 
 from __future__ import annotations

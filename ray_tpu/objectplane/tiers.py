@@ -44,6 +44,32 @@ def count_zero_copy_get(n: int = 1) -> None:
         pass    # metrics must never fail the data path
 
 
+def count_grants_reclaimed(n: int, reason: str) -> None:
+    """Crash reclamation dropped ``n`` external slot refs a dead client
+    never released — ``reason`` says which death signal fired (worker
+    pipe EOF = ``death``, RPC connection close = ``disconnect``, the
+    heartbeat orphan sweep = ``sweep``)."""
+    try:
+        from ray_tpu.util.metrics import Counter
+        Counter("ray_tpu_arena_grants_reclaimed_total",
+                "external arena slot refs reclaimed from dead clients",
+                tag_keys=("reason",)).inc(n, tags={"reason": reason})
+    except Exception:
+        pass    # metrics must never fail the data path
+
+
+def count_stale_reservations(n: int = 1) -> None:
+    """The orphan sweep aborted ``n`` direct-put reservations whose
+    writer died between reserve and seal (bytes un-stranded)."""
+    try:
+        from ray_tpu.util.metrics import Counter
+        Counter("ray_tpu_arena_stale_reservations_total",
+                "reserved-but-never-sealed arena entries aborted by "
+                "the TTL sweep").inc(n)
+    except Exception:
+        pass    # metrics must never fail the data path
+
+
 def raw_put_eligible(value):
     """(dtype_str, shape) when ``value`` qualifies for the RAW tier on
     a direct put, else None — THE single eligibility predicate, shared

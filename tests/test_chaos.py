@@ -473,3 +473,363 @@ def test_chaos_quota_exceeded_job_degrades_others_unharmed(seed):
         assert fp.hit_count("admission.verdict") >= fired
     finally:
         ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# process-death reclamation campaign: SIGKILL'd clients leak nothing
+# ---------------------------------------------------------------------------
+# The object-plane crash-safety contract (docs/object_plane.md "Crash
+# reclamation"): every slot ref / reservation charged to a client that
+# dies — worker SIGKILL mid-view, writer SIGKILL between reserve and
+# seal, external attacher SIGKILL holding live grants — is reclaimed by
+# the SAME daemon (death signal or heartbeat sweep), the leak gauge
+# returns to zero, and the evicted bytes become re-allocatable. No
+# daemon restart, no task failures attributable to reclamation.
+
+def _first_daemon(rt):
+    return list(rt.cluster_backend.daemons.values())[0]
+
+
+def _slot_refs(handle):
+    return handle.client.call("daemon_stats")["slot_refs"]
+
+
+def _wait_refs_zero(handle, timeout=20.0):
+    """Poll the per-client attributed leak gauge until every externally
+    granted slot ref has been reclaimed (registry truth, not timing)."""
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        last = _slot_refs(handle)
+        if last["refs"] == 0:
+            return last
+        time.sleep(0.1)
+    raise AssertionError(f"slot refs never reclaimed: {last}")
+
+
+def _wait_store_used(handle, at_most, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        last = handle.client.call("daemon_stats")["store_used"]
+        if last <= at_most:
+            return last
+        time.sleep(0.1)
+    raise AssertionError(f"store_used stuck at {last} > {at_most}")
+
+
+def _needs_arena(handle):
+    if not handle.objectplane:
+        pytest.skip("no native arena on this box (dict-only store)")
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_chaos_sigkill_worker_mid_view_reclaims_grants(seed):
+    """SIGKILL an actor's worker while it holds a live zero-copy view:
+    the worker-pipe EOF funnels into reclaim_client, the leak gauge
+    (ray_tpu_arena_slot_refs{state=refs}) returns to zero, and the
+    freed bytes are re-allocatable — without a daemon restart."""
+    import signal
+    import numpy as np
+
+    rt = ray_tpu.init(num_nodes=1, resources={"CPU": 4},
+                      cluster="daemons")
+    try:
+        handle = _first_daemon(rt)
+        _needs_arena(handle)
+        daemon_pid = handle.proc.pid
+
+        # produced WORKER-side so the result direct-puts into the
+        # daemon's arena raw-tier (c-contiguous, > direct_put_min_
+        # bytes): the consumer's get is then a zero-copy view whose
+        # finalizer is the ONLY releaser — exactly what a SIGKILL
+        # strands. (A driver-side put stays in the driver's store and
+        # the consumer would get shipped bytes, not a slot grant.)
+        nbytes = 96 * 1024 * 8                          # 768 KiB
+
+        @ray_tpu.remote
+        def produce(n):
+            return np.arange(n, dtype=np.float64)
+
+        ref = produce.remote(96 * 1024)
+
+        @ray_tpu.remote
+        class Holder:
+            def hold(self, refs):
+                import os as _os
+                self.view = ray_tpu.get(refs)[0]
+                return _os.getpid(), float(self.view[7])
+
+        h = Holder.remote()
+        victim_pid, v = ray_tpu.get(h.hold.remote([ref]), timeout=60)
+        assert v == 7.0
+        before = _slot_refs(handle)
+        assert before["refs"] >= 1, before
+        # attribution names a live worker client holding the grant
+        workers = [c for c in before["clients"]
+                   if c["client"].startswith("w:")]
+        assert workers and any(c["alive"] for c in workers), before
+
+        os.kill(victim_pid, signal.SIGKILL)
+        after = _wait_refs_zero(handle)
+        assert after["refs"] == 0 and after["clients"] == []
+
+        # the daemon never restarted
+        assert handle.proc.poll() is None
+        assert handle.proc.pid == daemon_pid
+
+        # freed bytes are re-allocatable: drop the driver ref, then the
+        # deferred delete (its last ext ref died with the worker) frees
+        # on reap and the same-size reservation succeeds
+        del ref
+        import gc
+        gc.collect()
+        handle.flush_frees()
+        out = None
+        deadline = time.monotonic() + 20
+        while out is None and time.monotonic() < deadline:
+            out = handle.arena_reserve(b"chaos:realloc:%d" % seed, nbytes)
+            if out is None:
+                time.sleep(0.1)
+        assert out is not None and "off" in out, "bytes not re-allocatable"
+        handle.free_objects([b"chaos:realloc:%d" % seed])
+
+        # zero task failures attributable to reclamation
+        @ray_tpu.remote
+        def ping():
+            return "up"
+
+        assert ray_tpu.get(ping.remote(), timeout=60) == "up"
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_chaos_sigkill_worker_mid_direct_put_aborts_reservation(seed):
+    """SIGKILL a worker between reserve and seal (the direct-put write
+    window): the death signal aborts the unsealed reservation and the
+    reserved bytes return to the arena — no TTL wait, no restart."""
+    import signal
+
+    rt = ray_tpu.init(num_nodes=1, resources={"CPU": 4},
+                      cluster="daemons")
+    try:
+        handle = _first_daemon(rt)
+        _needs_arena(handle)
+        baseline = handle.client.call("daemon_stats")["store_used"]
+
+        @ray_tpu.remote
+        def reserve_and_stall(seed):
+            # reserve arena space exactly like a direct put, then return
+            # WITHOUT sealing: the daemon now carries an unsealed
+            # reservation charged to this worker's identity
+            import os as _os
+            from ray_tpu._private import worker as worker_mod
+            st = worker_mod._global_runtime._state
+            key = b"chaos:stall:%d:%d" % (seed, _os.getpid())
+            out = st.call_host("shm_put_reserve", key=key, size=1 << 20)
+            assert isinstance(out, dict) and "off" in out, out
+            return _os.getpid()
+
+        victim_pid = ray_tpu.get(reserve_and_stall.remote(seed),
+                                 timeout=60)
+        used = handle.client.call("daemon_stats")["store_used"]
+        assert used >= baseline + (1 << 20), (used, baseline)
+
+        os.kill(victim_pid, signal.SIGKILL)
+        # pipe EOF -> reclaim_client aborts the reservation; the bytes
+        # come back without any daemon restart (small slack: stored
+        # task results share the same table)
+        _wait_store_used(handle, baseline + 64 * 1024)
+        assert handle.proc.poll() is None
+
+        # the same key reserves cleanly afterwards (the abort deleted
+        # the unsealed entry, it did not poison the key)
+        out = handle.arena_reserve(b"chaos:stall:again:%d" % seed, 1 << 20)
+        assert out is not None and "off" in out
+        handle.free_objects([b"chaos:stall:again:%d" % seed])
+
+        @ray_tpu.remote
+        def ping():
+            return "ok"
+
+        assert ray_tpu.get(ping.remote(), timeout=60) == "ok"
+    finally:
+        ray_tpu.shutdown()
+
+
+def _external_attacher_script():
+    """Source for a subprocess that plays a driver-like external
+    attacher: grabs a slot grant + an unsealed reservation over raw
+    RPC, reports READY, then blocks until SIGKILLed."""
+    return r"""
+import sys, time
+host, port, oid_hex = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+from ray_tpu._private import rpc
+rpc.declare("get_object", "oid", "prefer_shm")
+rpc.declare("create_object", "oid", "size")
+c = rpc.Client((host, port), timeout=10)
+out = c.call("get_object", oid=bytes.fromhex(oid_hex),
+             prefer_shm=True, slot_ok=True)
+assert out.get("slot") is not None, out
+res = c.call("create_object", oid=b"chaos:ext:res", size=256 * 1024)
+assert res.get("ok"), res
+print("READY", flush=True)
+time.sleep(120)
+"""
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_chaos_sigkill_external_client_holding_views(seed):
+    """SIGKILL an external attacher (driver-protocol client) that holds
+    a slot grant on a deferred-deleted object PLUS an unsealed
+    reservation: the RPC disconnect reclaims both, the deferred delete
+    frees on the very next reap (NOT at daemon restart), and an
+    allocation that could not fit while the leak lived succeeds."""
+    import subprocess
+    import sys
+
+    # arena sized so the seeded blob + a leaked copy cannot coexist:
+    # re-reserving the blob's size FAILS while the dead client's grant
+    # pins the deferred delete, and SUCCEEDS once reclaimed
+    rt = ray_tpu.init(num_nodes=1, resources={"CPU": 2},
+                      cluster="daemons",
+                      object_store_memory=4 * 1024 * 1024)
+    try:
+        handle = _first_daemon(rt)
+        _needs_arena(handle)
+        daemon_pid = handle.proc.pid
+        key = b"chaos:ext:%d" % seed
+        blob = os.urandom(int(2.5 * 1024 * 1024))
+        handle.put_object_blob(key, blob)
+
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _external_attacher_script(),
+             handle.addr[0], str(handle.addr[1]), key.hex()],
+            stdout=subprocess.PIPE, text=True)
+        try:
+            assert proc.stdout.readline().strip() == "READY"
+            before = _slot_refs(handle)
+            assert before["refs"] >= 1, before
+            assert any(c["client"].startswith("c:")
+                       for c in before["clients"]), before
+
+            # delete while the external grant pins it: deferred delete,
+            # bytes still held -> a same-size reservation cannot fit
+            handle.free_objects([key])
+            assert handle.arena_reserve(b"chaos:ext:probe", len(blob)) \
+                is None, "leak did not pin the arena (test inert)"
+
+            proc.kill()     # SIGKILL: no release, no goodbye
+            proc.wait(timeout=10)
+
+            # conn EOF -> on_disconnect -> reclaim_client: grant dropped,
+            # reservation aborted, reap frees the deferred delete
+            after = _wait_refs_zero(handle)
+            assert after["refs"] == 0
+            out = None
+            deadline = time.monotonic() + 20
+            while out is None and time.monotonic() < deadline:
+                out = handle.arena_reserve(b"chaos:ext:re", len(blob))
+                if out is None:
+                    time.sleep(0.1)
+            assert out is not None and "off" in out, \
+                "deferred delete not freed by reap after reclaim"
+            handle.free_objects([b"chaos:ext:re"])
+
+            # same daemon process throughout — reclamation, not restart
+            assert handle.proc.poll() is None
+            assert handle.proc.pid == daemon_pid
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+        @ray_tpu.remote
+        def ping():
+            return 1
+
+        assert ray_tpu.get(ping.remote(), timeout=60) == 1
+    finally:
+        ray_tpu.shutdown()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_chaos_sweep_backstop_when_event_reclaim_dropped(seed):
+    """arena.grant_reclaim drop arm (env-activated so it arms the
+    SPAWNED daemon): the death-signal reclaim is LOST once; the
+    heartbeat orphan sweep must still converge the leak gauge to zero
+    (dead-pid ledger reclaim), and the reclaimed grants surface on the
+    federated ray_tpu_arena_grants_reclaimed_total counter."""
+    import signal
+    import numpy as np
+
+    os.environ["RAY_TPU_FAILPOINTS"] = "arena.grant_reclaim=drop:max=1"
+    os.environ["RAY_TPU_FAILPOINTS_SEED"] = str(seed)
+    os.environ["RAY_TPU_ARENA_RESERVE_TTL_S"] = "1"
+    try:
+        rt = ray_tpu.init(num_nodes=1, resources={"CPU": 4},
+                          cluster="daemons")
+        try:
+            handle = _first_daemon(rt)
+            _needs_arena(handle)
+
+            @ray_tpu.remote
+            def produce(n):
+                return np.arange(n, dtype=np.float64)
+
+            ref = produce.remote(96 * 1024)   # lands raw in the arena
+
+            @ray_tpu.remote
+            class Holder:
+                def hold(self, refs):
+                    import os as _os
+                    self.view = ray_tpu.get(refs)[0]
+                    return _os.getpid()
+
+            h = Holder.remote()
+            victim_pid = ray_tpu.get(h.hold.remote([ref]), timeout=60)
+            assert _slot_refs(handle)["refs"] >= 1
+
+            os.kill(victim_pid, signal.SIGKILL)
+            # event path suppressed by the drop arm (max=1); the sweep
+            # (every daemon heartbeat) finds the dead pid in the ledger
+            # and reclaims through the now-exhausted seam
+            after = _wait_refs_zero(handle, timeout=30.0)
+            assert after["refs"] == 0
+            assert handle.proc.poll() is None
+
+            # a driver-side reservation never sealed: the TTL sweep
+            # (RAY_TPU_ARENA_RESERVE_TTL_S=1) aborts it while this
+            # connection stays OPEN — stale-reservation path, not the
+            # disconnect path
+            used0 = handle.client.call("daemon_stats")["store_used"]
+            out = handle.arena_reserve(b"chaos:ttl:%d" % seed, 512 * 1024)
+            assert out is not None and "off" in out
+            _wait_store_used(handle, used0 + 64 * 1024, timeout=30.0)
+
+            # federated accounting: the daemon's reclaim counters reach
+            # the driver's cluster view (per-node rows)
+            from ray_tpu.util import metrics
+            deadline = time.monotonic() + 30
+            names = set()
+            while time.monotonic() < deadline:
+                names = {(r["name"],
+                          dict(r.get("labels") or {}).get("reason"))
+                         for r in metrics.cluster_metrics_json()["metrics"]}
+                if ("ray_tpu_arena_grants_reclaimed_total",
+                        "sweep") in names and \
+                   ("ray_tpu_arena_stale_reservations_total",
+                        None) in names:
+                    break
+                time.sleep(0.25)
+            assert ("ray_tpu_arena_grants_reclaimed_total",
+                    "sweep") in names, sorted(names)
+            assert ("ray_tpu_arena_stale_reservations_total",
+                    None) in names, sorted(names)
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_FAILPOINTS", None)
+        os.environ.pop("RAY_TPU_FAILPOINTS_SEED", None)
+        os.environ.pop("RAY_TPU_ARENA_RESERVE_TTL_S", None)

@@ -449,6 +449,8 @@ WIRED_SEAMS = [
     "profile.flush",
     "admission.verdict",
     "tenancy.quota_sync",
+    "arena.grant_reclaim",
+    "arena.reservation_sweep",
 ]
 
 

@@ -1,0 +1,251 @@
+"""Unit tests for crash-safe object-plane reclamation: the per-client
+grant ledger, ``reclaim_client``, and the heartbeat orphan sweep
+(docs/object_plane.md "Crash reclamation").
+
+These run at the ObjectTable / native-store layer — no cluster boot —
+so they stay in the tier-1 sweep. The end-to-end SIGKILL campaign
+(worker mid-view, writer mid-direct-put, external attacher, and the
+``arena.grant_reclaim`` / ``arena.reservation_sweep`` failpoint
+backstops) lives in the chaos tier: tests/test_chaos.py.
+"""
+
+import os
+import time
+import uuid
+
+import pytest
+
+from ray_tpu.native_store import available
+
+needs_native = pytest.mark.skipif(
+    not available(), reason="native store unavailable (no compiler)")
+
+pytestmark = needs_native
+
+CAP = 4 * 1024 * 1024
+
+
+@pytest.fixture
+def table():
+    from ray_tpu._private.daemon import ObjectTable
+    t = ObjectTable(f"rtpu_t_{os.getpid()}_{uuid.uuid4().hex[:8]}",
+                    CAP, sweep=False)
+    if t._shm is None:
+        t.close()
+        pytest.skip("arena creation failed on this box")
+    try:
+        yield t
+    finally:
+        t.close()
+
+
+def _pinned(table, oid=b"obj-1", n=200_000):
+    """Store a pinned arena entry (blob above the inline threshold)."""
+    table.put(oid, b"x" * n)
+    return oid
+
+
+def _refs(table, slot):
+    return int(table._shm.ext_refs(slot))
+
+
+# ---------------------------------------------------------------------------
+# the satellite regression: reclaim frees deferred deletes via reap,
+# NOT at daemon restart
+# ---------------------------------------------------------------------------
+
+def test_reclaim_frees_deferred_delete_without_restart(table):
+    """A deferred-deleted entry whose LAST external ref is dropped by
+    reclaim_client is freed by the reap reclaim itself runs — the bytes
+    are re-allocatable immediately, no daemon restart involved."""
+    oid = _pinned(table, n=int(CAP * 0.6))   # > half: two can't coexist
+    meta = table.get_ext_meta(oid, "w:4242:1")
+    assert meta is not None
+    slot = meta[4]
+    assert _refs(table, slot) == 1
+
+    # delete while the grant pins it: deferred, bytes still held
+    table.delete(oid)
+    used_before = table.used_bytes()
+    assert used_before >= int(CAP * 0.6)
+    assert table.reserve(b"probe", int(CAP * 0.6)) is None  # no room
+
+    dropped, aborted = table.reclaim_client("w:4242:1")
+    assert dropped == 1 and aborted == 0
+    assert _refs(table, slot) == 0
+    # reclaim's own reap freed the deferred entry: same-size reserve
+    # now fits in the same (still-running) table
+    off = table.reserve(b"probe2", int(CAP * 0.6))
+    assert off is not None
+
+
+def test_double_reclaim_is_idempotent(table):
+    """A second death signal for the same client finds an empty ledger:
+    nothing is dropped twice (a re-drop would steal refs a later holder
+    of the recycled slot legitimately owns)."""
+    oid = _pinned(table)
+    slot = table.get_ext_meta(oid, "w:7:1")[4]
+    # a second, LIVE client shares the slot
+    assert table.get_ext_meta(oid, "w:8:1")[4] == slot
+    assert _refs(table, slot) == 2
+
+    assert table.reclaim_client("w:7:1") == (1, 0)
+    assert _refs(table, slot) == 1          # the live holder's ref
+    assert table.reclaim_client("w:7:1") == (0, 0)   # idempotent
+    assert _refs(table, slot) == 1
+    assert table.reclaim_client("w:8:1") == (1, 0)
+    assert _refs(table, slot) == 0
+
+
+# ---------------------------------------------------------------------------
+# the safe bound: ledgers over-count, reclaim never steals
+# ---------------------------------------------------------------------------
+
+def test_reclaim_never_steals_a_live_clients_ref(table):
+    """The dead client already released one grant with a silent local
+    atomic (its ledger over-counts): reclaim drops only
+    observed - other clients' charges, leaving the live reader's ref."""
+    oid = _pinned(table)
+    slot = table.get_ext_meta(oid, "w:100:1")[4]    # dead-to-be
+    table.get_ext_meta(oid, "w:100:1")              # granted twice
+    table.get_ext_meta(oid, "w:200:1")              # live co-holder
+    assert _refs(table, slot) == 3
+    table._shm.ext_release(slot)    # dead client's silent release
+    assert _refs(table, slot) == 2
+
+    # ledger says 2, but only 1 is really theirs (2 observed - 1 other)
+    assert table.reclaim_client("w:100:1") == (1, 0)
+    assert _refs(table, slot) == 1  # live holder keeps reading
+
+
+def test_reclaim_aborts_unsealed_reservations(table):
+    """A writer that dies between reserve and seal: reclaim aborts its
+    reservation and the key is clean for reuse."""
+    assert table.reserve(b"res-1", 1 << 20, "w:55:1") is not None
+    assert table.reserve(b"res-2", 1 << 20, "w:55:1") is not None
+    used = table.used_bytes()
+    assert used >= 2 << 20
+
+    dropped, aborted = table.reclaim_client("w:55:1")
+    assert aborted == 2 and dropped == 0
+    assert table.used_bytes() < used
+    assert table.reserve(b"res-1", 1 << 20, "w:56:1") is not None
+
+
+def test_sealed_reservation_is_not_reclaimed(table):
+    """Seal clears the reservation charge: the writer dying AFTER seal
+    must not drop the sealed entry."""
+    off = table.reserve(b"sealed", 300_000, "w:9:1")
+    assert off is not None
+    assert table.seal(b"sealed")
+    assert table.reclaim_client("w:9:1") == (0, 0)
+    assert table.get_blob(b"sealed") is not None
+
+
+# ---------------------------------------------------------------------------
+# the heartbeat sweep: stale reservations + ledger-drift true-up
+# ---------------------------------------------------------------------------
+
+def test_stale_reservations_respect_ttl(table):
+    table.reserve(b"old", 4096, "c:abc")
+    assert table.stale_reservations(ttl=0.0) == [b"old"]
+    assert table.stale_reservations(ttl=3600.0) == []
+    time.sleep(0.02)
+    assert table.stale_reservations(ttl=0.01) == [b"old"]
+    table.abort_reserve(b"old")
+    assert table.stale_reservations(ttl=0.0) == []
+
+
+def test_sweep_force_drops_holderless_refs(table):
+    """A slot with outstanding refs but NO ledger holder carries only
+    refs of already-reclaimed clients (reclaim's bound left a residue):
+    the sweep forces them to zero."""
+    oid = _pinned(table)
+    slot = table.get_ext_meta(oid, "w:1:1")[4]
+    # an untagged incref (legacy path / pre-ledger client): invisible
+    # to the ledger, so reclaim's safe bound strands it...
+    table._shm.get_ext(oid)
+    assert _refs(table, slot) == 2
+    assert table.reclaim_client("w:1:1") == (1, 0)
+    assert _refs(table, slot) == 1
+    # ...until the orphan sweep sees refs with no registered holder
+    assert table.sweep_orphan_slots() == 1
+    assert _refs(table, slot) == 0
+
+
+def test_sweep_clamps_single_holder_overcount(table):
+    """Silent local releases shrink observed refs below the single
+    holder's charge: the sweep clamps the ledger down (preserving the
+    ledger >= actual invariant) without dropping the live ref."""
+    oid = _pinned(table)
+    slot = table.get_ext_meta(oid, "w:3:1")[4]
+    table.get_ext_meta(oid, "w:3:1")
+    table.get_ext_meta(oid, "w:3:1")
+    assert _refs(table, slot) == 3
+    table._shm.ext_release(slot)     # two silent client-side releases
+    table._shm.ext_release(slot)
+    assert _refs(table, slot) == 1
+
+    assert table.sweep_orphan_slots() == 0      # clamp, not drop
+    assert _refs(table, slot) == 1
+    assert table._ext_slots["w:3:1"][slot] == 1
+    # the clamped ledger now reclaims EXACTLY what the client holds
+    assert table.reclaim_client("w:3:1") == (1, 0)
+    assert _refs(table, slot) == 0
+
+
+def test_sweep_does_not_touch_live_grants(table):
+    oid = _pinned(table)
+    slot = table.get_ext_meta(oid, "w:11:1")[4]
+    assert table.sweep_orphan_slots() == 0
+    assert _refs(table, slot) == 1
+
+
+# ---------------------------------------------------------------------------
+# observability: attribution rows + zero-ref pruning
+# ---------------------------------------------------------------------------
+
+def test_slot_ref_stats_attribution_and_pruning(table):
+    oid = _pinned(table)
+    slot = table.get_ext_meta(oid, "w:21:1")[4]
+    table.get_ext_meta(oid, "w:21:1")
+    table.get_ext_meta(oid, "c:deadbeef")
+
+    stats = table.slot_ref_stats(attribution=True)
+    assert stats["refs"] == 3 and stats["held"] == 1
+    rows = {r["client"]: r for r in stats["clients"]}
+    assert rows["w:21:1"]["granted"] == 2
+    assert rows["c:deadbeef"]["granted"] == 1
+
+    # tagged owner-side releases retire the charges with the refs
+    table.ext_release(slot, "w:21:1")
+    table.ext_release(slot, "w:21:1")
+    table.ext_release(slot, "c:deadbeef")
+    stats = table.slot_ref_stats(attribution=True)
+    assert stats == {"held": 0, "refs": 0, "clients": []}
+    # fully-released slots leave tracking entirely
+    assert table._slot_owners == {} and table._ext_slots == {}
+
+
+def test_ledger_clients_lists_grants_and_reservations(table):
+    oid = _pinned(table)
+    table.get_ext_meta(oid, "w:31:1")
+    table.reserve(b"r", 4096, "c:conn1")
+    assert table.ledger_clients() == ["c:conn1", "w:31:1"]
+
+
+# ---------------------------------------------------------------------------
+# native bulk op
+# ---------------------------------------------------------------------------
+
+def test_ext_release_n_floors_at_zero(table):
+    """rtpu_ext_release_n drops at most what the slot holds and reports
+    what it actually dropped (CAS loop floored at zero)."""
+    oid = _pinned(table)
+    slot = table.get_ext_meta(oid, "w:41:1")[4]
+    table.get_ext_meta(oid, "w:41:1")
+    assert _refs(table, slot) == 2
+    assert table._shm.ext_release_n(slot, 5) == 2   # clamped
+    assert _refs(table, slot) == 0
+    assert table._shm.ext_release_n(slot, 1) == 0   # already zero
+    assert _refs(table, slot) == 0

@@ -13,19 +13,29 @@
 # graceful node drain against failpoint-injected migration faults,
 # worker crashes, and deadline escalation, across both topologies.
 #
+# The process-kill tier (last stage) SIGKILLs real object-plane
+# clients — a worker mid-zero-copy-view, a writer between reserve and
+# seal, an external attacher holding live grants — and fails on a
+# nonzero end-of-run leak gauge: every scenario asserts
+# ray_tpu_arena_slot_refs{state=refs} returns to zero and the evicted
+# bytes are re-allocatable, with no daemon restart.
+#
 # Usage: tools/run_chaos.sh [extra pytest args...]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
+# the reclamation scenarios get their own stage below
+PROCKILL="sigkill or sweep_backstop"
+
 echo "=== chaos tier: in-process topology ==="
 RAY_TPU_CLUSTER= python -m pytest tests/test_chaos.py -q -m chaos \
-    -p no:cacheprovider -p no:randomly "$@"
+    -k "not ($PROCKILL)" -p no:cacheprovider -p no:randomly "$@"
 
 echo "=== chaos tier: daemons topology ==="
 RAY_TPU_CLUSTER=daemons python -m pytest tests/test_chaos.py -q -m chaos \
-    -p no:cacheprovider -p no:randomly "$@"
+    -k "not ($PROCKILL)" -p no:cacheprovider -p no:randomly "$@"
 
 echo "=== chaos tier: lock-sanitizer seed (in-process topology) ==="
 # One seeded replay with the runtime lock-order sanitizer armed: the
@@ -41,4 +51,15 @@ RAY_TPU_LOCK_SANITIZER=1 RAY_TPU_CLUSTER= python -m pytest \
     -W "error::ray_tpu._private.lock_sanitizer.LockOrderViolation" \
     -p no:cacheprovider -p no:randomly "$@"
 
-echo "chaos tier: OK (both topologies + sanitized seed)"
+echo "=== chaos tier: process-kill reclamation (both topologies) ==="
+# SIGKILL campaign over every seed, swept over both topology env
+# settings (the scenarios boot their own daemons cluster either way —
+# the sweep varies the surrounding driver runtime, matching the other
+# stages). A leaked grant, a stranded reservation, or a daemon restart
+# fails the run inside the tests themselves.
+RAY_TPU_CLUSTER= python -m pytest tests/test_chaos.py -q -m chaos \
+    -k "$PROCKILL" -p no:cacheprovider -p no:randomly "$@"
+RAY_TPU_CLUSTER=daemons python -m pytest tests/test_chaos.py -q -m chaos \
+    -k "$PROCKILL" -p no:cacheprovider -p no:randomly "$@"
+
+echo "chaos tier: OK (both topologies + sanitized seed + process-kill)"
