@@ -70,4 +70,25 @@ CAPABILITY_FLAGS = {
         "doc": "this driver understands ext-slot object grants "
                "(self-describing: reflects the sender's own ability)",
     },
+    "fence": {
+        "kind": "hello",
+        "guard": "_fence_supported",
+        "doc": "daemon stamps its registration epoch (ep) and the task "
+               "attempt (att) into result/termination frames so the "
+               "driver can fence stale deliveries across healed "
+               "partitions",
+    },
+    "ep": {
+        "kind": "frame",
+        "requires": [],
+        "doc": "daemon registration epoch stamped on a result frame "
+               "(self-describing: an unstamped frame is simply never "
+               "fenced, so no hello guard dominates the send)",
+    },
+    "att": {
+        "kind": "frame",
+        "requires": [],
+        "doc": "task attempt number stamped on a result frame "
+               "(self-describing, like ep)",
+    },
 }

@@ -276,8 +276,14 @@ class _SubmitCoalescer:
                 # frame handling into linger AND double-count it
                 # against the daemon's dispatch span
                 flush_mono = time.perf_counter()
+                # the reply is an enqueue ACK (results ride the pump), so
+                # a deadline is safe — an unacked flush past it means a
+                # wedged link, which the RpcError path below treats as
+                # node death (timeout audit: no unbounded dispatch trips)
+                from ray_tpu._private.config import cfg as _cfg
                 handle.client.call("push_task_batch", tasks=batch,
-                                   fns=fns, timeout=None)
+                                   fns=fns,
+                                   timeout=_cfg().control_call_timeout_s)
                 self._record_linger(batch, flush_mono)
             except rpc.RemoteError as e:
                 if "no such method" in str(e):
@@ -424,10 +430,26 @@ class _FreeCoalescer:
                         self._oids[:0] = oids
                 return
         try:
+            from ray_tpu._private.config import cfg as _cfg
             self.handle.client.call("free_objects", oids=oids,
-                                    timeout=None)
+                                    timeout=_cfg().control_call_timeout_s)
         except (rpc.RpcError, rpc.RemoteError):
             pass    # daemon dead/erroring: its store dies with it
+
+
+def _count_fenced(kind: str) -> None:
+    """Count one result frame rejected by partition fencing.
+    ``kind``: "epoch" (stale daemon incarnation), "attempt" (stale task
+    attempt), "dead" (stamped frame arrived after mark_dead)."""
+    try:
+        from ray_tpu.util.metrics import Counter
+        Counter("ray_tpu_fenced_results_total",
+                "result/stream frames rejected by partition fencing "
+                "(stale epoch, stale attempt, or arrival after the "
+                "handle was marked dead)",
+                tag_keys=("kind",)).inc(tags={"kind": kind})
+    except Exception:
+        pass    # metrics must never fail result ingest
 
 
 class DaemonHandle:
@@ -443,8 +465,17 @@ class DaemonHandle:
         self._slock = tracked_lock("cluster.handle.streams",
                                    reentrant=False)
         self.on_actor_worker_died = None  # set by the backend
-        self.client = Client(addr, timeout=None, on_push=self._on_push)
+        self.client = Client(addr, timeout=None,
+                             on_push=self._on_push).link(
+                                 "daemon", node_id.hex())
         self.dead = False
+        # partition fencing: the daemon's registration epoch (minted by
+        # the head, learned at hello and refreshed via membership) — a
+        # result frame stamped with a LOWER epoch came from a superseded
+        # incarnation across a healed partition and must not resolve
+        # waiters (docs/fault_tolerance.md "Partitions, epochs & fencing")
+        self.epoch = 0
+        self._fence_supported = False       # daemon advertises in hello
         # zero-copy object plane (set from the hello reply)
         self.objectplane = False
         self.arena_name: Optional[str] = None
@@ -484,6 +515,9 @@ class DaemonHandle:
             self._ingest_batch(msg.get("outcomes", ()))
             return
         if method in ("task_yield", "task_stream_end", "task_stream_crash"):
+            if self._stale_epoch(msg):
+                _count_fenced("epoch")
+                return
             with self._slock:
                 stream = self._streams.get(msg["task"])
             if stream is not None:
@@ -510,6 +544,16 @@ class DaemonHandle:
 
     def mark_dead(self) -> None:
         self.dead = True
+        # fail in-flight RPCs with a typed transport error: a one-way
+        # partition (daemon->driver direction lost) would otherwise wedge
+        # timeout=None callers (classic submit_task) forever — the head's
+        # death-mark is the deadline that lands here. The reader thread
+        # stays up, so LATE result pushes still arrive and are counted
+        # by the fence (kind="dead") instead of silently vanishing.
+        try:
+            self.client._fail_all()
+        except Exception:
+            pass
         with self._slock:
             streams = list(self._streams.values())
         for stream in streams:
@@ -528,12 +572,38 @@ class DaemonHandle:
         if fl is not None:
             fl.close()
 
+    def _stale_epoch(self, msg: Dict[str, Any]) -> bool:
+        """True when a frame's ``ep`` stamp is from a SUPERSEDED daemon
+        incarnation (the head re-minted the node's epoch since). An
+        unstamped frame (pre-fence daemon, or a locally-synthesized
+        outcome) is never stale."""
+        if not self._fence_supported:
+            return False        # pre-fence daemon: nothing is stamped
+        ep = msg.get("ep")
+        return ep is not None and bool(self.epoch) and ep < self.epoch
+
     def _complete_batch_task(self, out: Dict[str, Any]) -> None:
+        if self._stale_epoch(out):
+            _count_fenced("epoch")
+            return
         with self._bw_lock:
-            slot = self._batch_waiters.pop(out.get("task", ""), None)
+            task_hex = out.get("task", "")
+            slot = self._batch_waiters.get(task_hex)
+            if slot is not None:
+                att = out.get("att")
+                if att is not None and len(slot) > 2 and att != slot[2]:
+                    # stale ATTEMPT: leave the slot armed for the live
+                    # attempt's outcome
+                    slot = None
+                else:
+                    self._batch_waiters.pop(task_hex, None)
+            else:
+                att = None
         if slot is not None:
             slot[1] = out
             slot[0].set()
+        elif att is not None:
+            _count_fenced("attempt")
 
     def _ingest_batch(self, outcomes) -> None:
         """Ingest one task_batch_done frame WITHOUT re-entering per-task
@@ -544,19 +614,46 @@ class DaemonHandle:
         batch.result_flush retry, or out-of-order arrival of a resent
         frame) find no slot and are dropped — exactly-once per task."""
         t0 = time.perf_counter()
+        if self.dead:
+            # mark_dead already failed every waiter: a STAMPED frame
+            # arriving now is a late delivery across a healed partition
+            # (or a post-death flush) — count it so chaos campaigns can
+            # assert the fence actually engaged
+            for out in outcomes:
+                if out.get("ep") is not None or out.get("att") is not None:
+                    _count_fenced("dead")
+            return
         finals = []
         streams = []
+        fenced_epoch = 0
         for out in outcomes:
+            if self._stale_epoch(out):
+                fenced_epoch += 1
+                continue
             (streams if out.get("stream") else finals).append(out)
+        for _ in range(fenced_epoch):
+            _count_fenced("epoch")
         woke = []
+        fenced_attempt = 0
         if finals:
             with self._bw_lock:
                 for out in finals:
-                    slot = self._batch_waiters.pop(out.get("task", ""),
-                                                   None)
-                    if slot is not None:
-                        slot[1] = out
-                        woke.append((slot, out))
+                    task_hex = out.get("task", "")
+                    slot = self._batch_waiters.get(task_hex)
+                    if slot is None:
+                        continue
+                    att = out.get("att")
+                    if att is not None and len(slot) > 2 and att != slot[2]:
+                        # a retried task's slot carries the LIVE attempt
+                        # number: an outcome from an earlier attempt
+                        # (replayed across a heal) must not resolve it
+                        fenced_attempt += 1
+                        continue
+                    self._batch_waiters.pop(task_hex, None)
+                    slot[1] = out
+                    woke.append((slot, out))
+            for _ in range(fenced_attempt):
+                _count_fenced("attempt")
             for slot, _out in woke:
                 slot[0].set()
         if streams:
@@ -649,6 +746,9 @@ class DaemonHandle:
         # tenancy capability receive tenancy_sync job tables (old
         # daemons simply keep unconditional admission)
         self._tenancy_supported = bool(out.get("tenancy"))
+        # partition fencing: epoch/attempt stamps on result frames
+        self._fence_supported = bool(out.get("fence"))
+        self.epoch = int(out.get("epoch") or 0)
         self._job_id = job_id
         return out
 
@@ -684,7 +784,9 @@ class DaemonHandle:
                 if _fp.ENABLED:
                     _fp.fire("cluster.lane_reconnect",
                              node=self.node_id.hex()[:8])
-                return FastLaneClient((self.addr[0], port))
+                return FastLaneClient(
+                    (self.addr[0], port),
+                    link_id=f"lane:{self.node_id.hex()}")
 
             try:
                 fl = lane_reconnect_policy().run(
@@ -830,7 +932,10 @@ class DaemonHandle:
         """Enqueue on the coalescer and wait for the batched completion;
         same outcome dict (and error surface) as the submit_task RPC."""
         task_hex = spec.task_id.hex()
-        slot = [threading.Event(), None]
+        # slot = [wake event, outcome, live attempt number] — the third
+        # element lets the ingest path fence outcomes replayed from an
+        # earlier attempt across a healed partition
+        slot = [threading.Event(), None, spec.attempt_number]
         with self._bw_lock:
             if self.dead:
                 raise DaemonCrashed(
@@ -881,7 +986,7 @@ class DaemonHandle:
         delivery — same outcome dict and error surface as the coalesced
         path."""
         task_hex = spec.task_id.hex()
-        slot = [threading.Event(), None]
+        slot = [threading.Event(), None, spec.attempt_number]
         with self._bw_lock:
             if self.dead:
                 raise DaemonCrashed(
@@ -1784,6 +1889,15 @@ class ClusterBackend:
             return None
         with self._lock:
             if node_id in self.daemons or self._shutting_down:
+                existing = self.daemons.get(node_id)
+                if existing is not None:
+                    # re-registered daemon (healed partition / head
+                    # restart): adopt the head-minted epoch so stale
+                    # frames still queued on the OLD connection are
+                    # fenced, not double-observed
+                    ep = int(info.get("epoch") or 0)
+                    if ep > existing.epoch:
+                        existing.epoch = ep
                 return None
         try:
             handle = DaemonHandle(node_id, tuple(info["addr"]), None,

@@ -186,6 +186,20 @@ FLAG_DEFS = [
          "daemon/head/worker processes"),
     Flag("failpoints_seed", int, 0, "RNG seed for probabilistic "
          "failpoint arms (0 = unseeded); same seed => same schedule"),
+    Flag("net_chaos", str, "", "network-chaos link-policy spec "
+         "degrading control-plane links deterministically, e.g. "
+         "'driver>daemon=drop=0.3;daemon>head=partition:start=500"
+         ":dur=2000'; also honored as the RAY_TPU_NET_CHAOS env var "
+         "by spawned daemon/head/worker processes "
+         "(_private/netchaos.py)"),
+    Flag("net_chaos_seed", int, 0, "RNG seed for probabilistic "
+         "link-policy draws (0 = unseeded); same seed => same "
+         "drop/dup/jitter schedule"),
+    Flag("control_call_timeout_s", float, 60.0, "deadline for bounded "
+         "control-plane round trips whose reply is an ack, not a task "
+         "outcome (batch-submit flush, free flush): a silent one-way "
+         "partition surfaces as a typed RpcError instead of a wedged "
+         "thread"),
     Flag("retry_base_backoff_s", float, 0.05, "RetryPolicy.default "
          "first-backoff cap (exponential, full jitter)"),
     Flag("retry_max_backoff_s", float, 2.0, "RetryPolicy.default "

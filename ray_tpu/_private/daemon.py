@@ -108,6 +108,10 @@ declare("oom_check", "task_id", "fast_lane")
 declare("set_memory_limit", "limit")
 declare("core_op", "call", "payload", "task")
 declare("core_release", "task")
+# chaos harness only: (de)activate a seeded network-chaos spec inside
+# THIS daemon process — lets a campaign partition one node's head link
+# when env activation (pre-spawn, all nodes) is too blunt
+declare("net_chaos", "spec")
 
 
 # ---------------------------------------------------------------------------
@@ -818,6 +822,11 @@ class _BatchTaskConn:
     def reply(self, rid, **kw) -> None:
         out = dict(kw)
         out["task"] = self.task_hex
+        # fencing stamps: the attempt this outcome belongs to and the
+        # daemon's registration epoch — the driver accepts exactly the
+        # live (attempt, epoch) pair and counts the rest as fenced
+        out["att"] = self.key[1]
+        out["ep"] = self.service.epoch
         if self.trace is not None:
             out["tr"] = list(self.trace)
         self.service._batch_task_done(self.conn, self.key, out)
@@ -835,6 +844,8 @@ class _BatchTaskConn:
             # per-task push and never hangs its stream consumer
             out = dict(kw)
             out["stream"] = method
+            out["att"] = self.key[1]
+            out["ep"] = self.service.epoch
             self.service._batch_pump.add(self.conn, out)
             return
         self.conn.push(method, **kw)
@@ -1041,6 +1052,11 @@ class DaemonService:
                                self.objects._shm.capacity())
         self.owner: Optional[Client] = None
         self.driver_conn: Optional[Connection] = None
+        # fencing epoch minted by the head at register_node (0 =
+        # standalone / never registered); stamped into heartbeats,
+        # hello replies, and every result/stream frame so drivers can
+        # fence a healed pre-death incarnation's late results
+        self.epoch = 0
         # per-process span buffer (task_event_buffer.cc role): daemon
         # dispatch spans + this daemon's worker exec spans, flushed to
         # the head's task-event store on heartbeats (main loop)
@@ -1219,7 +1235,9 @@ class DaemonService:
     # -- wiring ----------------------------------------------------------
     def handle_hello_driver(self, conn, rid, msg):
         self.driver_conn = conn
-        self.owner = Client(tuple(msg["owner_addr"]), timeout=None)
+        conn.link("driver")
+        self.owner = Client(tuple(msg["owner_addr"]),
+                            timeout=None).link("driver")
         self.runtime.job_id = cloudpickle.loads(msg["job_id"])
         self.runtime.namespace = msg["namespace"]
         # driver import roots: future workers get them in the boot
@@ -1262,6 +1280,13 @@ class DaemonService:
                 # tenancy_sync job tables (old drivers never send
                 # them and keep unconditional admission)
                 "tenancy": True,
+                # partition fencing: result/stream frames carry epoch
+                # (ep) and attempt (att) stamps; the registration
+                # epoch rides along so the driver knows the live
+                # incarnation (old daemons advertise neither and the
+                # driver accepts frames unfenced)
+                "fence": True,
+                "epoch": self.epoch,
                 # zero-copy object plane: same-host clients attach this
                 # arena by name for direct puts / slot-ref'd gets
                 "objectplane": self.objects._shm is not None,
@@ -1475,21 +1500,25 @@ class DaemonService:
             conn.reply(rid, outcome="gen")
             crashed = False
             try:
+                # ep: the daemon's fencing epoch rides every stream
+                # push so the driver can reject a healed pre-death
+                # incarnation's late stream frames
                 for kind, blob in outcome[1]:
                     if kind == "yield_raw":
-                        conn.push("task_yield", task=task_hex, blob=blob)
+                        conn.push("task_yield", task=task_hex,
+                                  blob=blob, ep=self.epoch)
                     else:
                         conn.push("task_stream_end", task=task_hex,
-                                  ok=False, blob=blob)
+                                  ok=False, blob=blob, ep=self.epoch)
                         break
                 else:
                     conn.push("task_stream_end", task=task_hex,
-                              ok=True, blob=b"")
+                              ok=True, blob=b"", ep=self.epoch)
             except WorkerCrashed as e:
                 crashed = True
                 client.kill(expected=False)
                 conn.push("task_stream_crash", task=task_hex,
-                          error=str(e))
+                          error=str(e), ep=self.epoch)
             finally:
                 with self._lock:
                     self._task_rids.pop(task_hex, None)
@@ -2582,6 +2611,21 @@ class DaemonService:
     def handle_daemon_ping(self, conn, rid, msg):
         return {"pid": os.getpid(), "node_id": self.node_id.hex()}
 
+    def handle_net_chaos(self, conn, rid, msg):
+        """Chaos-campaign hook: install (or clear, with an empty spec) a
+        seeded netchaos registry in THIS daemon process. Programmatic
+        per-node activation — the env form reaches every spawned
+        process, so a schedule that must degrade ONE daemon's head link
+        (partition-then-death-mark campaigns) arms it here instead."""
+        from ray_tpu._private import netchaos as _nc
+        spec = msg.get("spec") or ""
+        if not spec:
+            _nc.reset()
+            return {"ok": True, "active": False}
+        seed = msg.get("seed")
+        _nc.activate(spec, seed=int(seed) if seed is not None else None)
+        return {"ok": True, "active": True, "links": _nc.describe()}
+
     def handle_tenancy_sync(self, conn, rid, msg):
         """Adopt the driver's per-job quota/weight table. The daemon is
         not the admission authority (dispatch gating runs driver-side,
@@ -2767,6 +2811,8 @@ def main() -> None:
     args = parser.parse_args()
 
     resources = json.loads(args.resources)
+    from ray_tpu._private import netchaos as _nc
+    _nc.set_local_role("daemon")
     service = DaemonService(args.node_id, resources,
                             args.object_store_bytes,
                             persist=args.persist, host=args.host)
@@ -2787,6 +2833,10 @@ def main() -> None:
     out = head.register_node(args.node_id, resources, labels, server.addr)
     if out.get("dead"):
         os._exit(0)     # fenced: this node_id was declared dead
+    # Fencing epoch: minted by the head at every registration; stamped
+    # into heartbeats and result frames so a healed partition can never
+    # deliver results from a superseded incarnation.
+    service.epoch = int(out.get("epoch") or 0)
 
     # Head-FT (reference: raylets resync after a GCS restart,
     # gcs_init_data.h): on transport failure keep re-dialing the head for
@@ -2817,11 +2867,15 @@ def main() -> None:
         def attempt() -> HeadClient:
             client = HeadClient(head_addr)
             try:
-                client.register_node(args.node_id, resources, labels,
-                                     server.addr)
+                rep = client.register_node(args.node_id, resources,
+                                           labels, server.addr)
             except BaseException:
                 client.close()
                 raise
+            if rep.get("dead"):
+                client.close()
+                os._exit(0)     # fenced out during the head outage
+            service.epoch = int(rep.get("epoch") or service.epoch)
             return client
 
         try:
@@ -2888,7 +2942,8 @@ def main() -> None:
             out = head.heartbeat(args.node_id, resources,
                                  wall_ts=time.time(),
                                  events=span_batch, metrics=snapshot,
-                                 profile=profile)
+                                 profile=profile,
+                                 epoch=service.epoch)
             # advance the cursor ONLY on an acknowledged beat: an
             # "unknown" reply (restarted head, pre-re-register) returns
             # BEFORE ingesting the events — advancing would lose the
@@ -2919,6 +2974,7 @@ def main() -> None:
                 continue
             if out2.get("dead"):
                 os._exit(0)     # fenced out: never rejoin as a zombie
+            service.epoch = int(out2.get("epoch") or service.epoch)
 
 
 if __name__ == "__main__":

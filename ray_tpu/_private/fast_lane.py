@@ -36,6 +36,7 @@ import msgpack
 from ray_tpu._private.lock_sanitizer import tracked_lock
 
 from ray_tpu._private import failpoints as _fp
+from ray_tpu._private import netchaos as _nc
 
 # ops (mirror daemon_core.cc)
 OP_HELLO_WORKER = 0x01
@@ -79,8 +80,13 @@ from ray_tpu._private.rpc import recv_exact as _recv_exact
 
 
 def _read_frame(sock: socket.socket) -> bytearray:
-    (blen,) = _U32.unpack(_recv_exact(sock, 4))
-    return _recv_exact(sock, blen)
+    while True:
+        (blen,) = _U32.unpack(_recv_exact(sock, 4))
+        blob = _recv_exact(sock, blen)
+        if (_nc.ENABLED
+                and _nc.on_recv(sock, blen + 4) is _nc.DROP_FRAME):
+            continue    # inbound lane frame lost on the simulated link
+        return blob
 
 
 def _frame_stream(sock: socket.socket):
@@ -97,6 +103,10 @@ def _frame_stream(sock: socket.socket):
             end = off + 4 + blen
             if end > n:
                 break
+            if (_nc.ENABLED
+                    and _nc.on_recv(sock, blen + 4) is _nc.DROP_FRAME):
+                off = end       # frame lost on the simulated link
+                continue
             yield buf[off + 4:end]
             off = end
         if off:
@@ -113,6 +123,17 @@ def _send_lane_frame(sock: socket.socket, wlock: threading.Lock, op: int,
     small payloads concatenate (one syscall); large payloads go as a
     second sendall under the same lock — no multi-MB concat copy."""
     prefix = _U32.pack(1 + len(head) + len(payload)) + bytes([op]) + head
+    if _nc.ENABLED:
+        verdict = _nc.on_send(sock, len(prefix) + len(payload))
+        if verdict is _nc.DROP_FRAME:
+            return      # whole frame suppressed; lane framing intact
+        if verdict is _nc.DUP_FRAME:
+            with wlock:
+                if len(payload) <= _SEND_CONCAT_MAX:
+                    sock.sendall(prefix + payload)
+                else:
+                    sock.sendall(prefix)
+                    sock.sendall(payload)
     with wlock:
         if len(payload) <= _SEND_CONCAT_MAX:
             sock.sendall(prefix + payload)
@@ -208,10 +229,14 @@ def lane_reconnect_policy():
 class FastLaneClient:
     """One connection to a daemon's C++ core; thread-safe submit."""
 
-    def __init__(self, addr: Tuple[str, int]):
+    def __init__(self, addr: Tuple[str, int], link_id: str = "lane"):
         self._sock = socket.create_connection(addr, timeout=10.0)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock.settimeout(None)
+        # default identity is the bare "lane"; the driver passes a
+        # node-scoped id ("lane:<node_hex>") so a chaos spec can
+        # partition ONE node's lane without touching its siblings
+        _nc.register_link(self._sock, "daemon", link_id=link_id)
         self._wlock = tracked_lock("fast_lane.wire", reentrant=False)
         self._rids = itertools.count(1)
         # rid -> [Event, kind, payload]
@@ -302,6 +327,17 @@ class FastLaneClient:
         without ever concatenating the big payload."""
         run: list = []
         for f, _ in batch:
+            if _nc.ENABLED:
+                nb = (len(f[0]) + len(f[1])) if isinstance(f, tuple) \
+                    else len(f)
+                verdict = _nc.on_send(self._sock, nb)
+                if verdict is _nc.DROP_FRAME:
+                    continue    # staged frame lost on the simulated link
+                if verdict is _nc.DUP_FRAME:
+                    if isinstance(f, tuple):
+                        run.extend(f)
+                    else:
+                        run.append(f)
             if isinstance(f, tuple):
                 if run:
                     self._sock.sendall(
@@ -522,6 +558,7 @@ def worker_fast_lane_start(addr: Tuple[str, int], state,
     sock = socket.create_connection(addr, timeout=10.0)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     sock.settimeout(None)
+    _nc.register_link(sock, "daemon", link_id="lane")
     wlock = threading.Lock()
 
     def send(op: int, head: bytes, payload: bytes = b"") -> None:

@@ -73,7 +73,7 @@ declare("register_node", "node_id", "resources", "labels", "addr")
 # batch for the task-event store), ``metrics`` (absolute metric snapshot
 # federated into the cluster /metrics view) — all optional/empty.
 declare("heartbeat", "node_id", "available", "wall_ts", "events",
-        "metrics", "profile")
+        "metrics", "profile", "epoch")
 declare("metrics_get")
 declare("profile_get")
 declare("list_nodes")
@@ -105,7 +105,7 @@ TRANSIENT_WINDOW = 200
 class _NodeEntry:
     __slots__ = ("node_id", "resources", "labels", "addr", "alive",
                  "last_beat", "available", "reason", "avail_gossip_ts",
-                 "draining", "drain_deadline", "drain_reason")
+                 "draining", "drain_deadline", "drain_reason", "epoch")
 
     def __init__(self, node_id: str, resources: Dict[str, float],
                  labels: Dict[str, str], addr: Tuple[str, int]):
@@ -124,11 +124,15 @@ class _NodeEntry:
         self.draining = False
         self.drain_deadline = 0.0    # monotonic
         self.drain_reason = ""
+        # fencing epoch minted by the head at register_node; a frame
+        # stamped with a LOWER epoch comes from a pre-death incarnation
+        self.epoch = 0
 
     def view(self) -> Dict[str, Any]:
         return {"node_id": self.node_id, "resources": self.resources,
                 "labels": self.labels, "addr": list(self.addr),
                 "alive": self.alive, "available": self.available,
+                "epoch": self.epoch,
                 "reason": self.reason, "draining": self.draining,
                 "drain_reason": self.drain_reason,
                 "drain_deadline_s": (
@@ -236,6 +240,10 @@ _DRAIN_KEY = b"\x00drain\x00"
 # colon-free raw-prefix scheme: ``--state-path`` survives head respawn,
 # so quotas outlive both the head process and the submitting driver.
 _TENANCY_KEY = b"\x00tenancy\x00"
+# Per-node fencing epochs persist under the same colon-free raw-prefix
+# scheme: epochs must be monotonic ACROSS head restarts, or a healed
+# pre-restart zombie could stamp frames the fence accepts.
+_EPOCH_KEY = b"\x00epoch\x00"
 
 
 class HeadService:
@@ -279,6 +287,9 @@ class HeadService:
         # semantics — each driver report supersedes its previous one).
         self._tenancy: Dict[str, Dict[str, Any]] = {}  #: guarded by self._lock
         self._tenancy_usage: Dict[str, Dict[str, Any]] = {}  #: guarded by self._lock
+        # node_id -> last minted fencing epoch (persisted: epochs stay
+        # monotonic across a head restart even though membership resets)
+        self._node_epochs: Dict[str, int] = {}  #: guarded by self._lock
         if state_path:
             self._store = _HeadStore(state_path)
             self._kv, self._events = self._store.load()
@@ -297,6 +308,14 @@ class HeadService:
                 try:
                     self._tenancy[key[len(_TENANCY_KEY):].decode()] = (
                         msgpack.unpackb(blob, raw=False))
+                except Exception:
+                    self._store.delete(key)
+            for key in [k for k in self._kv
+                        if k.startswith(_EPOCH_KEY)]:
+                blob = self._kv.pop(key)
+                try:
+                    self._node_epochs[key[len(_EPOCH_KEY):].decode()] = (
+                        int(blob))
                 except Exception:
                     self._store.delete(key)
         self._stop = threading.Event()
@@ -326,8 +345,20 @@ class HeadService:
                 entry.drain_deadline = time.monotonic() + max(
                     0.0, drain[0] - time.time())
                 entry.drain_reason = drain[1]
+            # Mint a monotonic fencing epoch for this incarnation:
+            # bumped on EVERY register (a re-registration after a head
+            # restart or death-mark gets a strictly higher epoch), and
+            # persisted so epochs survive head respawn. Drivers fence
+            # result frames stamped with an older epoch.
+            epoch = self._node_epochs.get(node_id, 0) + 1
+            self._node_epochs[node_id] = epoch
+            entry.epoch = epoch
+            if self._store is not None:
+                self._store.put(_EPOCH_KEY + node_id.encode(),
+                                str(epoch).encode())
             self._nodes[node_id] = entry
         conn.meta["node_id"] = node_id
+        conn.link("daemon", node_id)
         self._publish("node", {"kind": "added", "node": entry.view()})
         if entry.draining:
             # re-announce so a (re)subscribed driver resumes migration
@@ -336,7 +367,8 @@ class HeadService:
                 "deadline_s": max(0.0, entry.drain_deadline
                                   - time.monotonic()),
                 "reason": entry.drain_reason})
-        return {"ok": True, "draining": entry.draining}
+        return {"ok": True, "draining": entry.draining,
+                "epoch": entry.epoch}
 
     def handle_heartbeat(self, conn, rid, msg):
         node_id = msg["node_id"]
@@ -352,6 +384,13 @@ class HeadService:
             entry = self._nodes.get(node_id)
             if entry is None:
                 return {"ok": False, "unknown": True}
+            ep = msg.get("epoch")
+            if ep is not None and ep and entry.epoch and ep < entry.epoch:
+                # Stale-epoch beat: a NEWER incarnation of this node_id
+                # has registered since this sender's epoch was minted.
+                # The zombie must exit — and its beat must not refresh
+                # the live incarnation's liveness.
+                return {"ok": False, "dead": True, "stale_epoch": True}
             entry.last_beat = time.monotonic()
             # The daemon's heartbeat carries its STATIC resources; the
             # driver's syncer gossip carries the true availability.
@@ -694,7 +733,7 @@ class HeadClient:
     """
 
     def __init__(self, addr: Tuple[str, int], reconnect_window: float = 0.0):
-        self._client = Client(addr)
+        self._client = Client(addr).link("head")
         self.addr = addr
         self._reconnect_window = reconnect_window
         self._dial_lock = tracked_lock("head_client.dial",
@@ -713,7 +752,8 @@ class HeadClient:
         with self._dial_lock:
             if not self._client.dead:
                 return
-            client = Client(self.addr)  # raises OSError while head is down
+            # raises OSError while head is down
+            client = Client(self.addr).link("head")
             old, self._client = self._client, client
             old.close()
 
@@ -753,11 +793,12 @@ class HeadClient:
                   wall_ts: float = 0.0,
                   events: Optional[List[Dict[str, Any]]] = None,
                   metrics: Optional[List[Dict[str, Any]]] = None,
-                  profile: Optional[Dict[str, Any]] = None):
+                  profile: Optional[Dict[str, Any]] = None,
+                  epoch: int = 0):
         return self._call("heartbeat", node_id=node_id,
                           available=available, wall_ts=wall_ts,
                           events=events or [], metrics=metrics,
-                          profile=profile, timeout=5.0)
+                          profile=profile, epoch=epoch, timeout=5.0)
 
     def metrics_get(self) -> Dict[str, List[Dict[str, Any]]]:
         """node_id -> latest federated metric snapshot. Bounded: a
@@ -928,6 +969,8 @@ def main() -> None:
     parser.add_argument("--announce-fd", type=int, default=-1,
                         help="write the bound port here once listening")
     args = parser.parse_args()
+    from ray_tpu._private import netchaos as _nc
+    _nc.set_local_role("head")
     server = Server(HeadService(state_path=args.state_path or None),
                     host=args.host, port=args.port).start()
     try:    # continuous profiler (profiling_hz knob; default off)

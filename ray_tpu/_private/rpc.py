@@ -37,6 +37,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import msgpack
 
 from ray_tpu._private import failpoints as _fp
+from ray_tpu._private import netchaos as _nc
 
 _LEN = struct.Struct("!I")
 MAX_FRAME = 1 << 31
@@ -136,6 +137,7 @@ def wire_metric_entries() -> list:
             "description": "inbound RPC requests by method",
             "samples": [[[["method", m]], v]
                         for m, v in sorted(server.items())]})
+    out.extend(_nc.chaos_metric_entries())
     return out
 
 
@@ -155,6 +157,22 @@ def send_frame_bytes(sock: socket.socket, blob, wlock) -> None:
     n = len(blob)
     if n > MAX_FRAME:
         raise RpcError(f"frame too large: {n}")
+    if _nc.ENABLED:
+        # chaos sits BELOW the frame layer: a drop suppresses the WHOLE
+        # frame (never a byte prefix, so framing stays intact); a dup
+        # delivers the same complete frame twice back-to-back
+        verdict = _nc.on_send(sock, n + 4)
+        if verdict is _nc.DROP_FRAME:
+            return
+        if verdict is _nc.DUP_FRAME:
+            _WIRE["bytes_sent"] += n + 4
+            _WIRE["frames_sent"] += 1
+            with wlock:
+                if n <= SEND_CONCAT_MAX:
+                    sock.sendall(_LEN.pack(n) + blob)
+                else:
+                    sock.sendall(_LEN.pack(n))
+                    sock.sendall(blob)
     _WIRE["bytes_sent"] += n + 4    # lossy-tolerant plain add (hot path)
     _WIRE["frames_sent"] += 1
     if n <= SEND_CONCAT_MAX:
@@ -191,10 +209,14 @@ def recv_exact(sock: socket.socket, n: int) -> bytearray:
 
 
 def _recv_frame(sock: socket.socket) -> Dict[str, Any]:
-    (n,) = _LEN.unpack(recv_exact(sock, 4))
-    _WIRE["bytes_recv"] += n + 4    # lossy-tolerant plain add (hot path)
-    _WIRE["frames_recv"] += 1
-    return msgpack.unpackb(recv_exact(sock, n), raw=False)
+    while True:
+        (n,) = _LEN.unpack(recv_exact(sock, 4))
+        _WIRE["bytes_recv"] += n + 4    # lossy-tolerant plain add (hot path)
+        _WIRE["frames_recv"] += 1
+        blob = recv_exact(sock, n)
+        if _nc.ENABLED and _nc.on_recv(sock, n + 4) is _nc.DROP_FRAME:
+            continue        # inbound frame lost on the simulated link
+        return msgpack.unpackb(blob, raw=False)
 
 
 # ---------------------------------------------------------------------------
@@ -222,6 +244,12 @@ class Client:
         self._reader = threading.Thread(target=self._read_loop, daemon=True,
                                         name=f"rpc-client-{addr[1]}")
         self._reader.start()
+
+    def link(self, peer_role: str, link_id: str = "") -> "Client":
+        """Tag this connection's socket with the peer's chaos-link
+        identity (cold path; chainable: ``Client(addr).link("head")``)."""
+        _nc.register_link(self._sock, peer_role, link_id)
+        return self
 
     def _read_loop(self) -> None:
         try:
@@ -367,6 +395,12 @@ class Connection:
         self._lane: deque = deque()
         self._lane_lock = threading.Lock()
         self._lane_busy = False
+
+    def link(self, peer_role: str, link_id: str = "") -> "Connection":
+        """Tag the accepted socket's chaos-link identity — services
+        call this once the peer identifies itself (hello/register)."""
+        _nc.register_link(self.sock, peer_role, link_id)
+        return self
 
     def reply(self, rid: int, **kw) -> None:
         msg = dict(kw)

@@ -244,6 +244,12 @@ class Runtime:
         # activate from the inherited RAY_TPU_FAILPOINTS env var)
         from ray_tpu._private import failpoints as _failpoints
         _failpoints.maybe_activate_from_config(_cfg())
+        # network chaos rides the same activation discipline: the
+        # `net_chaos` flag arms this process's link policies and
+        # exports RAY_TPU_NET_CHAOS for spawned processes
+        from ray_tpu._private import netchaos as _netchaos
+        _netchaos.maybe_activate_from_config(_cfg())
+        _netchaos.set_local_role("driver")
         self.tpu_topology = None
         _topo_spec = _cfg().tpu_topology
         if _topo_spec:

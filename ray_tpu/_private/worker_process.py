@@ -1010,6 +1010,11 @@ def _child_main(conn) -> None:
         pin_cpu_env(boot.get("cpu_devices"))
     from ray_tpu._private import worker as worker_mod
 
+    # network-chaos role tag: any control-plane socket this worker opens
+    # (e.g. fast-lane result delivery) matches worker>* link policies
+    from ray_tpu._private import netchaos as _nc
+    _nc.set_local_role("worker")
+
     # continuous profiler (profiling_hz via the env the host shipped in
     # boot["env"] / inherited from the forkserver template; default off)
     try:

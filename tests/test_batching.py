@@ -461,6 +461,8 @@ def test_ingest_batch_out_of_order_and_duplicates():
     h = DaemonHandle.__new__(DaemonHandle)
     h._bw_lock = threading.Lock()
     h._slock = threading.Lock()
+    h.dead = False
+    h._fence_supported = False
     slots = {name: [threading.Event(), None] for name in ("t1", "t2", "t3")}
     h._batch_waiters = dict(slots)
     stream = SimpleNamespace(q=queue.Queue())

@@ -833,3 +833,239 @@ def test_chaos_sweep_backstop_when_event_reclaim_dropped(seed):
         os.environ.pop("RAY_TPU_FAILPOINTS", None)
         os.environ.pop("RAY_TPU_FAILPOINTS_SEED", None)
         os.environ.pop("RAY_TPU_ARENA_RESERVE_TTL_S", None)
+
+
+# ---------------------------------------------------------------------------
+# network partitions: one-way splits, death-mark + heal fencing, flapping
+# links, partition racing a graceful drain (docs/fault_tolerance.md
+# "Partitions, epochs & fencing"). These boot their own daemons cluster
+# (the run_chaos.sh `network` tier sweeps the surrounding driver
+# topology env, like the process-kill tier).
+# ---------------------------------------------------------------------------
+
+from ray_tpu._private import netchaos as nc  # noqa: E402
+
+
+def _fenced_results_total(kind=None):
+    """Sum of ray_tpu_fenced_results_total in THIS driver's registry
+    (optionally one kind) — the fencing layer is driver-side."""
+    from ray_tpu.util import metrics
+    total = 0.0
+    for line in metrics.prometheus_text().splitlines():
+        if not line.startswith("ray_tpu_fenced_results_total"):
+            continue
+        if kind is not None and f'kind="{kind}"' not in line:
+            continue
+        total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_netchaos_partition_one_way_driver_daemon_mid_burst(seed):
+    """One-way driver->daemon partition opened MID-BURST against one
+    node (requests vanish; replies and result pushes still flow). The
+    wedge-proof contract: the bounded batch-flush deadline surfaces the
+    silent link as a typed RpcError -> node death -> retries on the
+    survivor; lane submits swallowed by the partition unwedge through
+    the same death mark. Every task converges exactly once."""
+    rt = ray_tpu.init(num_nodes=2, resources={"CPU": 4},
+                      cluster="daemons",
+                      _system_config={"control_call_timeout_s": 1.5})
+    try:
+        victim = _first_daemon(rt)
+        vh = victim.node_id.hex()
+
+        @ray_tpu.remote(max_retries=5, num_returns=2)
+        def pair(i):
+            time.sleep(0.05)
+            return i, i * 11
+
+        @ray_tpu.remote(max_retries=5)
+        def plain(i):
+            time.sleep(0.05)
+            return i * 7
+
+        # pre-partition traffic on both planes (pump + fast lane)
+        pre = [pair.remote(i) for i in range(6)]
+        pre_plain = [plain.remote(i) for i in range(6)]
+        time.sleep(0.3)
+        # one-way split: everything the driver sends toward the victim
+        # (control client AND its node-scoped lane) is dropped
+        nc.activate(f"driver>daemon@{vh}=partition;"
+                    f"driver>daemon@lane:{vh}=partition", seed=seed)
+        post = [pair.remote(i) for i in range(6, 14)]
+        post_plain = [plain.remote(i) for i in range(6, 14)]
+
+        flat = [r for pr in pre + post for r in pr]
+        vals = ray_tpu.get(flat, timeout=120)
+        assert vals == [x for i in range(14) for x in (i, i * 11)]
+        assert ray_tpu.get(pre_plain + post_plain, timeout=120) == [
+            i * 7 for i in range(14)]
+
+        # the partition actually ate frames, deterministically logged
+        assert nc.injected_count("drop") > 0
+        dropped_links = {e["policy"] for e in nc.hit_log()}
+        assert f"driver>daemon@{vh}" in dropped_links
+        # typed-timeout contract: the victim was declared dead (flush
+        # deadline -> RpcError -> mark_dead), never a wedged thread
+        assert victim.dead
+        # the survivor keeps serving new work
+        assert ray_tpu.get(plain.remote(99), timeout=60) == 693
+    finally:
+        nc.reset()
+        ray_tpu.shutdown()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_netchaos_partition_death_mark_then_heal_fences_results(seed):
+    """Daemon<->head partition long enough for the head's liveness
+    timer to death-mark the node, then heal. In-flight work finishes on
+    the superseded node and its late result pushes arrive at the driver
+    AFTER the death mark: the fence rejects them (counter > 0), the
+    retried attempts complete exactly once on the survivor, and the
+    fenced daemon exits via the {"dead": True} re-register contract."""
+    rt = ray_tpu.init(num_nodes=2, resources={"CPU": 4},
+                      cluster="daemons")
+    try:
+        victim = _first_daemon(rt)
+        vh = victim.node_id.hex()
+        fenced_before = _fenced_results_total()
+
+        @ray_tpu.remote(max_retries=5, num_returns=2)
+        def slow(i):
+            time.sleep(2.5)
+            return i, i * 13
+
+        refs = [slow.remote(i) for i in range(8)]
+        time.sleep(0.4)     # let the burst dispatch across both nodes
+        # partition THIS daemon's head link (programmatic per-node
+        # activation inside the spawned process); window outlives the
+        # node_dead_after_s liveness deadline, then heals
+        out = victim.client.call(
+            "net_chaos", spec="daemon>head=partition:dur=3500",
+            seed=seed, timeout=5.0)
+        assert out["active"]
+
+        vals = ray_tpu.get([r for pr in refs for r in pr], timeout=120)
+        # exactly once per ref: each task resolves to ONE value even
+        # though the superseded node also ran (and pushed) it
+        assert vals == [x for i in range(8) for x in (i, i * 13)]
+        # the dead-marked node's work was re-run through retries
+        assert rt.stats["tasks_retried"] >= 1
+        # the fence engaged: stamped frames from the superseded
+        # incarnation were rejected, not double-delivered
+        deadline = time.monotonic() + 30
+        while (_fenced_results_total() <= fenced_before
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        assert _fenced_results_total() > fenced_before
+        # heal: the partition window closes, the daemon re-registers,
+        # learns it was fenced, and drains via the dead-exit contract
+        victim.proc.wait(timeout=40)
+        views = {n["node_id"]: n
+                 for n in rt.cluster_backend.head.list_nodes()}
+        assert not views[vh]["alive"]
+
+        @ray_tpu.remote
+        def ping():
+            return "up"
+
+        assert ray_tpu.get(ping.remote(), timeout=60) == "up"
+    finally:
+        nc.reset()
+        ray_tpu.shutdown()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_netchaos_flapping_link_under_queued_drain(seed):
+    """Flapping latency bursts (150ms impaired / 150ms clean, seeded
+    jitter) on one node's control link + lane while that node drains
+    with a queue of admitted work: every queued task converges exactly
+    once, the drained node departs, and the flap's off-transitions fire
+    the net.partition_heal seam."""
+    rt = ray_tpu.init(num_nodes=2, resources={"CPU": 4},
+                      cluster="daemons")
+    try:
+        fp.activate("net.partition_heal=delay(0);net.link_drop=delay(0)",
+                    seed=seed)
+        victim = _first_daemon(rt)
+        vh = victim.node_id.hex()
+
+        @ray_tpu.remote(max_retries=2)
+        def work(i):
+            time.sleep(0.15)
+            return i * 9
+
+        # queue depth > cluster CPU: the drain finds admitted-but-
+        # unstarted work behind the stuttering link
+        refs = [work.remote(i) for i in range(12)]
+        # 200ms impaired / 100ms clean: the second half of the burst and
+        # the drain's migration chatter cross the flap's on-phase
+        nc.activate(f"driver>daemon@{vh}=lat=120:jitter=40:"
+                    f"flap=200/100:sym;"
+                    f"driver>daemon@lane:{vh}=lat=120:flap=200/100",
+                    seed=seed)
+        refs += [work.remote(i) for i in range(12, 24)]
+        time.sleep(0.1)
+        assert rt.drain_node(victim.node_id, deadline_s=20,
+                             reason="netchaos-flap")
+        assert ray_tpu.get(refs, timeout=120) == [
+            i * 9 for i in range(24)]
+        deadline = time.monotonic() + 30
+        while (rt.get_node(victim.node_id) is not None
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        assert rt.get_node(victim.node_id) is None
+        # the stutter really ran: seeded delays were injected and at
+        # least one impaired->clear flap transition reported a heal
+        assert nc.injected_count("delay") > 0
+        assert fp.fire_count("net.partition_heal") >= 1
+        assert ray_tpu.get(work.remote(50), timeout=60) == 450
+    finally:
+        nc.reset()
+        ray_tpu.shutdown()
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_netchaos_partition_during_graceful_drain(seed):
+    """A daemon<->head partition opens DURING a graceful drain of that
+    node. Whichever side wins the race — the drain finishing its
+    migration, or the head's liveness timer escalating to node death —
+    every task converges exactly once, the node departs, and the
+    partitioned daemon process exits instead of lingering as a zombie."""
+    rt = ray_tpu.init(num_nodes=2, resources={"CPU": 4},
+                      cluster="daemons")
+    try:
+        victim = _first_daemon(rt)
+        vh = victim.node_id.hex()
+
+        @ray_tpu.remote(max_retries=5)
+        def work(i):
+            time.sleep(0.6)
+            return i * 4
+
+        refs = [work.remote(i) for i in range(24)]
+        time.sleep(0.2)
+        assert rt.drain_node(victim.node_id, deadline_s=15,
+                             reason="netchaos-drain")
+        # permanent split from the head, mid-drain: heartbeats vanish
+        victim.client.call("net_chaos", spec="daemon>head=partition",
+                           seed=seed, timeout=5.0)
+
+        assert ray_tpu.get(refs, timeout=120) == [
+            i * 4 for i in range(24)]
+        deadline = time.monotonic() + 30
+        while (rt.get_node(victim.node_id) is not None
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        assert rt.get_node(victim.node_id) is None
+        views = {n["node_id"]: n
+                 for n in rt.cluster_backend.head.list_nodes()}
+        assert not views[vh]["alive"]
+        # drained OR death-marked, the daemon process must EXIT (clean
+        # drain completion, or the fenced re-register dead reply)
+        victim.proc.wait(timeout=40)
+        assert ray_tpu.get(work.remote(99), timeout=60) == 396
+    finally:
+        nc.reset()
+        ray_tpu.shutdown()

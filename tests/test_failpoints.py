@@ -451,6 +451,8 @@ WIRED_SEAMS = [
     "tenancy.quota_sync",
     "arena.grant_reclaim",
     "arena.reservation_sweep",
+    "net.link_drop",
+    "net.partition_heal",
 ]
 
 
@@ -548,7 +550,7 @@ def test_drain_announce_drop_loses_the_notice():
         # register through the handler directly: an rpc.Client would
         # mark the node dead on disconnect (conn.meta fencing)
         svc.handle_register_node(
-            types.SimpleNamespace(meta={}), 1,
+            types.SimpleNamespace(meta={}, link=lambda *a: None), 1,
             {"node_id": "n1", "resources": {}, "labels": {},
              "addr": ["127.0.0.1", 1]})
 

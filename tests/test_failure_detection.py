@@ -229,6 +229,9 @@ class _FakeConn:
     def reply(self, rid, **kw):
         self.replies.append((rid, kw))
 
+    def link(self, *a, **kw):
+        pass
+
 
 def _register(svc, node_id="n1", port=7001):
     return svc.handle_register_node(_FakeConn(), 1, {

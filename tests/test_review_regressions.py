@@ -529,6 +529,8 @@ def test_stream_terminations_gated_on_term_pump():
             self.added.append(out)
 
     class FakeService:
+        epoch = 0
+
         def __init__(self):
             self._batch_pump = FakePump()
 

@@ -26,16 +26,19 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-# the reclamation scenarios get their own stage below
+# the reclamation and network-partition scenarios get their own stages
 PROCKILL="sigkill or sweep_backstop"
+NETWORK="netchaos"
 
 echo "=== chaos tier: in-process topology ==="
 RAY_TPU_CLUSTER= python -m pytest tests/test_chaos.py -q -m chaos \
-    -k "not ($PROCKILL)" -p no:cacheprovider -p no:randomly "$@"
+    -k "not ($PROCKILL) and not ($NETWORK)" \
+    -p no:cacheprovider -p no:randomly "$@"
 
 echo "=== chaos tier: daemons topology ==="
 RAY_TPU_CLUSTER=daemons python -m pytest tests/test_chaos.py -q -m chaos \
-    -k "not ($PROCKILL)" -p no:cacheprovider -p no:randomly "$@"
+    -k "not ($PROCKILL) and not ($NETWORK)" \
+    -p no:cacheprovider -p no:randomly "$@"
 
 echo "=== chaos tier: lock-sanitizer seed (in-process topology) ==="
 # One seeded replay with the runtime lock-order sanitizer armed: the
@@ -62,4 +65,17 @@ RAY_TPU_CLUSTER= python -m pytest tests/test_chaos.py -q -m chaos \
 RAY_TPU_CLUSTER=daemons python -m pytest tests/test_chaos.py -q -m chaos \
     -k "$PROCKILL" -p no:cacheprovider -p no:randomly "$@"
 
-echo "chaos tier: OK (both topologies + sanitized seed + process-kill)"
+echo "=== chaos tier: network partitions (both topologies) ==="
+# Deterministic network-chaos campaign (tests/test_netchaos.py has the
+# tier-1 units; this is the cluster-level replay): one-way
+# driver->daemon split mid-burst, daemon<->head partition across a
+# death-mark + heal (fenced-result counter must move), a flapping link
+# under a queued drain, and a partition racing a graceful drain —
+# every seed, swept over both topology env settings (the scenarios
+# boot their own daemons cluster either way, like the kill tier).
+RAY_TPU_CLUSTER= python -m pytest tests/test_chaos.py -q -m chaos \
+    -k "$NETWORK" -p no:cacheprovider -p no:randomly "$@"
+RAY_TPU_CLUSTER=daemons python -m pytest tests/test_chaos.py -q -m chaos \
+    -k "$NETWORK" -p no:cacheprovider -p no:randomly "$@"
+
+echo "chaos tier: OK (both topologies + sanitized seed + process-kill + network)"
