@@ -456,8 +456,12 @@ def _child() -> int:
     else:
         result = _run_train(error)
     if os.environ.get("BENCH_CONTROL_PLANE", "1") != "0":
+        from ray_tpu._private.config import cfg as _cfg
         result["control_plane"] = {
             **_control_plane_probe(),
+            # which wire/dispatch core produced these rows — A/B runs
+            # flip RAY_TPU_ASYNC_CORE and diff the same json key
+            "async_core": bool(_cfg().async_core),
             # spans-on vs spans-off delta, paired + median-of-ratios in
             # ONE cluster (sequential unpaired probes are a noise
             # lottery on shared hosts — see tools/perf_smoke.sh probe 4)

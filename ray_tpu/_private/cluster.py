@@ -465,9 +465,9 @@ class DaemonHandle:
         self._slock = tracked_lock("cluster.handle.streams",
                                    reentrant=False)
         self.on_actor_worker_died = None  # set by the backend
-        self.client = Client(addr, timeout=None,
-                             on_push=self._on_push).link(
-                                 "daemon", node_id.hex())
+        self.client = rpc.connect(addr, timeout=None,
+                                  on_push=self._on_push).link(
+                                      "daemon", node_id.hex())
         self.dead = False
         # partition fencing: the daemon's registration epoch (minted by
         # the head, learned at hello and refreshed via membership) — a
@@ -476,6 +476,7 @@ class DaemonHandle:
         # waiters (docs/fault_tolerance.md "Partitions, epochs & fencing")
         self.epoch = 0
         self._fence_supported = False       # daemon advertises in hello
+        self._async_core_remote = False     # which core the daemon runs
         # zero-copy object plane (set from the hello reply)
         self.objectplane = False
         self.arena_name: Optional[str] = None
@@ -748,6 +749,9 @@ class DaemonHandle:
         self._tenancy_supported = bool(out.get("tenancy"))
         # partition fencing: epoch/attempt stamps on result frames
         self._fence_supported = bool(out.get("fence"))
+        # observational only (frames are core-agnostic): lets cluster
+        # stats name which peers run the asyncio core in a mixed fleet
+        self._async_core_remote = bool(out.get("async_core"))
         self.epoch = int(out.get("epoch") or 0)
         self._job_id = job_id
         return out
@@ -1679,7 +1683,7 @@ class ClusterBackend:
             target=self._supervise_head, daemon=True, name="head-supervisor")
         self._supervisor.start()
         self.owner_service = OwnerService(runtime)
-        self.owner_server = Server(self.owner_service).start()
+        self.owner_server = rpc.serve(self.owner_service).start()
         self.daemons: Dict[NodeID, DaemonHandle] = {}  #: guarded by self._lock
         self._lock = tracked_lock("cluster.backend.daemons",
                                   reentrant=False)
@@ -1728,7 +1732,7 @@ class ClusterBackend:
                                reconnect_window=cls.HEAD_RECONNECT_S)
         self._shutting_down = False
         self.owner_service = OwnerService(runtime)
-        self.owner_server = Server(self.owner_service).start()
+        self.owner_server = rpc.serve(self.owner_service).start()
         # single-threaded construction: attach() is a constructor, the
         # reporter/subscriber threads that contend start below
         self.daemons = {}       # raylint: disable=guarded-by
@@ -1745,6 +1749,22 @@ class ClusterBackend:
         self.start_resource_reporter()
         self.start_task_event_flusher()
         return self
+
+    def describe_peers(self) -> List[str]:
+        """One line per connected daemon for debug_state dumps: which
+        control-plane core the peer advertised in hello (the async_core
+        capability bit), plus liveness. Mixed clusters — a rolling
+        restart flipping ``async_core``, or an old daemon behind a new
+        driver — are invisible on the wire (frames are byte-identical),
+        so this is the one place an operator can SEE the mix."""
+        out = []
+        with self._lock:
+            handles = list(self.daemons.values())
+        for h in handles:
+            core = "async" if h._async_core_remote else "threaded"
+            out.append(f"daemon {h.node_id.hex()[:8]}: core={core} "
+                       f"alive={not h.dead}")
+        return out
 
     def start_resource_reporter(self, interval_s: float = 0.5) -> None:
         """Syncer gossip (``ray_syncer.h:83`` role): the driver is the

@@ -42,6 +42,7 @@ from typing import Any, Dict, Optional, Tuple
 import cloudpickle
 
 from ray_tpu._private import events as _events
+from ray_tpu._private import eventloop
 from ray_tpu._private import failpoints as _fp
 from ray_tpu._private import rpc
 from ray_tpu._private.head import HeadClient, _hb_interval
@@ -881,14 +882,69 @@ class _BatchReplyPump:
         # conn -> [(outcome, t_add)]: t_add is perf_counter at buffering
         # for traced outcomes (0.0 untraced — no clock read)
         self._buf: Dict[Connection, list] = {}  #: guarded by self._cv
-        threading.Thread(target=self._loop, daemon=True,
-                         name="batch-reply-pump").start()
+        # async core: the pump is a call_later chain on the event loop —
+        # one cross-thread wake per linger WINDOW (the arming hop), not
+        # one per completion, and the flush runs where the write batcher
+        # lives, so a chunk's push coalesces with other loop writes.
+        # Threaded core: the dedicated cv-wait thread, as before.
+        self._aloop = eventloop.get_loop() if cfg().async_core else None
+        self._armed = False     #: guarded by self._cv (loop mode)
+        if self._aloop is None:
+            threading.Thread(target=self._loop, daemon=True,
+                             name="batch-reply-pump").start()
 
     def add(self, conn: Connection, out: Dict[str, Any]) -> None:
         t_add = time.perf_counter() if "tr" in out else 0.0
         with self._cv:
             self._buf.setdefault(conn, []).append((out, t_add))
-            self._cv.notify()
+            if self._aloop is None:
+                self._cv.notify()
+                return
+            if self._armed:
+                return      # a flush is already scheduled: coalesce
+            self._armed = True
+        if eventloop.on_loop():
+            self._arm_flush()  # raylint: disable=loop-affinity — on_loop() guard
+        else:
+            self._aloop.call_soon_threadsafe(self._arm_flush)
+
+    def _arm_flush(self, backoff: float = 0.0) -> None:  #: loop-only
+        delay = max(self.linger_s, backoff)
+        if delay > 0:
+            self._aloop.call_later(delay, self._flush_on_loop)
+        else:
+            self._aloop.call_soon(self._flush_on_loop)
+
+    def _flush_on_loop(self) -> None:  #: loop-only
+        with self._cv:
+            buf, self._buf = self._buf, {}
+            self._armed = False
+        failed = False
+        for conn, entries in buf.items():
+            if conn.closed:
+                continue
+            i = 0
+            while i < len(entries):
+                chunk = entries[i:i + self.max_per_frame]
+                if not self._send_chunk(conn, chunk):
+                    # lost in transit: requeue, preserving order (the
+                    # resend is idempotent at the driver); concurrent
+                    # add()s may have re-armed already — checked below
+                    failed = True
+                    with self._cv:
+                        self._buf.setdefault(conn, [])[:0] = entries[i:]
+                    break
+                i += self.max_per_frame
+        if failed:
+            with self._cv:
+                re_arm = not self._armed and bool(self._buf)
+                if re_arm:
+                    self._armed = True
+            if re_arm:
+                # the 1ms floor is the same retry backoff the threaded
+                # pump applies after a failed pass (no busy-spin at
+                # linger 0 against a failing-but-open connection)
+                self._arm_flush(backoff=0.001)
 
     def _loop(self) -> None:
         failed_last_pass = False
@@ -1212,10 +1268,22 @@ class DaemonService:
                            line=line, node=self.node_id.hex()[:8])
 
     def _peer(self, addr: Tuple[str, int]) -> Client:
+        # dial OUTSIDE the lock: holding it across a TCP connect
+        # stalled every other peer lookup for the dial's duration.
+        # Losing a dial race just closes the extra connection.
         with self._lock:
             peer = self._peers.get(addr)
-            if peer is None or peer.dead:
-                peer = self._peers[addr] = Client(addr)
+        if peer is not None and not peer.dead:
+            return peer
+        fresh = rpc.connect(addr)
+        with self._lock:
+            peer = self._peers.get(addr)
+            if peer is not None and not peer.dead:
+                pass        # raced: keep the established winner
+            else:
+                peer = self._peers[addr] = fresh
+        if peer is not fresh:
+            fresh.close()
         return peer
 
     def _locate_via_owner(self, oid: bytes):
@@ -1236,8 +1304,8 @@ class DaemonService:
     def handle_hello_driver(self, conn, rid, msg):
         self.driver_conn = conn
         conn.link("driver")
-        self.owner = Client(tuple(msg["owner_addr"]),
-                            timeout=None).link("driver")
+        self.owner = rpc.connect(tuple(msg["owner_addr"]),
+                                 timeout=None).link("driver")
         self.runtime.job_id = cloudpickle.loads(msg["job_id"])
         self.runtime.namespace = msg["namespace"]
         # driver import roots: future workers get them in the boot
@@ -1286,6 +1354,10 @@ class DaemonService:
                 # incarnation (old daemons advertise neither and the
                 # driver accepts frames unfenced)
                 "fence": True,
+                # which wire+dispatch core this daemon runs (frames are
+                # identical either way — purely observational, see
+                # capabilities.py)
+                "async_core": self._batch_pump._aloop is not None,
                 "epoch": self.epoch,
                 # zero-copy object plane: same-host clients attach this
                 # arena by name for direct puts / slot-ref'd gets
@@ -1601,12 +1673,20 @@ class DaemonService:
         self._start_batch_task(conn, msg, key)
         return {"outcome": "pump"}
 
+    @rpc.loop_safe
     def handle_push_task_batch(self, conn, rid, msg):
         """Coalesced submit: N tasks on one frame (driver-side
         _SubmitCoalescer). Each task runs exactly like submit_task —
         fused lease+push+release on a pooled worker — but the per-task
         RPC round trip is gone: the frame is acked once, and completions
         return batched on task_batch_done push frames.
+
+        loop_safe: on the async core this runs inline on the event loop
+        (dedupe is dict ops under a short lock hold; nothing blocks),
+        so frame parse -> admission -> ack has zero thread hand-offs.
+        The per-task pool submits — which may cold-SPAWN pool threads —
+        are fanned out by ONE pool job below, keeping spawn cost off
+        the loop.
 
         Idempotent by task id: a retried frame (driver saw its flush
         fail in transit) skips tasks already running and resends the
@@ -1619,6 +1699,7 @@ class DaemonService:
             from ray_tpu._private import worker_process as wp
             wp.register_function_blob(blob)
         resend = []
+        starts = []
         for entry in msg["tasks"]:
             # dedupe identity is (task, attempt): a RETRY reuses the
             # task id but must execute — only a resent frame of the
@@ -1633,15 +1714,27 @@ class DaemonService:
                     resend.append(done)
                     continue
                 self._batch_running.add(key)
-            self._start_batch_task(conn, entry, key)
+            starts.append(self._start_batch_task(conn, entry, key,
+                                                 defer=True))
+        if starts:
+            if len(starts) == 1 or not eventloop.on_loop():
+                for s in starts:
+                    self._task_pool.submit(s)
+            else:
+                def _fan_out():
+                    for s in starts:
+                        self._task_pool.submit(s)
+                self._task_pool.submit(_fan_out)
         for out in resend:
             self._batch_pump.add(conn, out)
         return {"ok": True, "accepted": len(msg["tasks"])}
 
-    def _start_batch_task(self, conn, entry, key: tuple) -> None:
+    def _start_batch_task(self, conn, entry, key: tuple,
+                          defer: bool = False):
         """Acquire a pooled worker OFF the RPC lane thread (the pool may
         cold-spawn a process) and run the shared pushed-task machinery
-        with the batch reply adapter."""
+        with the batch reply adapter. ``defer=True`` returns the start
+        closure instead of submitting it (batch fan-out)."""
         trace = ((entry.get("name", ""), entry["trace"])
                  if entry.get("trace") else None)
         bconn = _BatchTaskConn(self, conn, entry["task"], key,
@@ -1668,7 +1761,10 @@ class DaemonService:
                 wp.release_worker(client)
                 bconn.reply_error(None, f"{type(e).__name__}: {e}")
 
+        if defer:
+            return start
         self._task_pool.submit(start)
+        return None
 
     def _batch_task_done(self, conn, key: tuple,
                          out: Dict[str, Any]) -> None:
@@ -2816,7 +2912,8 @@ def main() -> None:
     service = DaemonService(args.node_id, resources,
                             args.object_store_bytes,
                             persist=args.persist, host=args.host)
-    server = Server(service, host=args.host, port=0).start()
+    eventloop.set_proc_label(f"daemon:{args.node_id[:8]}")
+    server = rpc.serve(service, host=args.host, port=0).start()
     if args.announce_fd >= 0:
         os.write(args.announce_fd, f"{server.addr[1]}\n".encode())
         os.close(args.announce_fd)

@@ -428,6 +428,15 @@ class FastLaneClient:
 
     def wait(self, slot: list,
              timeout: Optional[float] = None) -> Tuple[int, bytes]:
+        # Same loop-affinity contract as AsyncClient.call: the lane
+        # reply arrives on a reader thread, but blocking the process
+        # event loop here would stall every peer the loop serves —
+        # fail loudly instead of deadlocking quietly (async core).
+        from ray_tpu._private import eventloop
+        if eventloop.on_loop():
+            raise RuntimeError(
+                "FastLaneClient.wait would block the event loop; "
+                "fast-lane round-trips belong on worker/caller threads")
         if not slot[0].wait(timeout):
             raise TimeoutError("fast lane reply timed out")
         if slot[1] is _UNSUBMITTED:

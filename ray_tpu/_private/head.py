@@ -733,7 +733,7 @@ class HeadClient:
     """
 
     def __init__(self, addr: Tuple[str, int], reconnect_window: float = 0.0):
-        self._client = Client(addr).link("head")
+        self._client = rpc.connect(addr).link("head")
         self.addr = addr
         self._reconnect_window = reconnect_window
         self._dial_lock = tracked_lock("head_client.dial",
@@ -752,8 +752,11 @@ class HeadClient:
         with self._dial_lock:
             if not self._client.dead:
                 return
-            # raises OSError while head is down
-            client = Client(self.addr).link("head")
+            # raises OSError while head is down. _dial_lock exists to
+            # single-flight this dial — concurrent callers must wait
+            # for ONE reconnect, not race N of them — so holding it
+            # across the connect is the lock's entire purpose.
+            client = rpc.connect(self.addr).link("head")  # raylint: disable=blocking-under-lock
             old, self._client = self._client, client
             old.close()
 
@@ -886,7 +889,7 @@ class HeadClient:
         def loop():
             cursor = 0
             try:
-                sub = Client(self.addr, timeout=None)
+                sub = rpc.connect(self.addr, timeout=None)
             except OSError:
                 return
             self._sub_swap(None, sub)
@@ -908,7 +911,8 @@ class HeadClient:
                             # not wait out a multi-second redial sleep
                             new = RetryPolicy.default(
                                 deadline_s=self._reconnect_window).run(
-                                lambda: Client(self.addr, timeout=None),
+                                lambda: rpc.connect(self.addr,
+                                                    timeout=None),
                                 loop="head.subscribe_redial",
                                 retry_on=(OSError,),
                                 abort=self._sub_stop.is_set,
@@ -971,8 +975,10 @@ def main() -> None:
     args = parser.parse_args()
     from ray_tpu._private import netchaos as _nc
     _nc.set_local_role("head")
-    server = Server(HeadService(state_path=args.state_path or None),
-                    host=args.host, port=args.port).start()
+    from ray_tpu._private import eventloop
+    eventloop.set_proc_label("head")
+    server = rpc.serve(HeadService(state_path=args.state_path or None),
+                       host=args.host, port=args.port).start()
     try:    # continuous profiler (profiling_hz knob; default off)
         from ray_tpu.util import profiling as _profiling
         _profiling.maybe_start_from_config("head")

@@ -256,8 +256,15 @@ class Registry:
         with self._lock:
             return bool(self._policies)
 
-    def apply(self, src: str, dst: str, link: str,
-              nbytes: int) -> Optional[_Verdict]:
+    def apply(self, src: str, dst: str, link: str, nbytes: int,
+              defer: bool = False):
+        """Consult policies for one frame. With ``defer=False`` (the
+        threaded wire) latency/bandwidth delays are slept here and the
+        verdict alone is returned. With ``defer=True`` (the asyncio
+        wire, which must never sleep on the loop) the return is a
+        ``(verdict, delay_s)`` pair and the CALLER owes the delay —
+        typically a per-connection ``call_later`` chain so delayed
+        frames still serialize per link but not across links."""
         pol = None
         with self._lock:
             for p in self._policies:    # first match wins
@@ -265,7 +272,7 @@ class Registry:
                     pol = p
                     break
             if pol is None:
-                return None
+                return (None, 0.0) if defer else None
             effect, delay_s, healed = pol.decide(nbytes)
             if effect is not None or delay_s:
                 _COUNTS[effect or "delay"] = \
@@ -278,15 +285,15 @@ class Registry:
         # must not serialize every other link behind it
         if healed and _fp.ENABLED:
             _fp.fire("net.partition_heal", src=src, dst=dst, link=link)
-        if delay_s > 0:
+        if delay_s > 0 and not defer:
             time.sleep(delay_s)
         if effect == "drop":
             if _fp.ENABLED:
                 _fp.fire("net.link_drop", src=src, dst=dst, link=link)
-            return DROP_FRAME
+            return (DROP_FRAME, delay_s) if defer else DROP_FRAME
         if effect == "dup":
-            return DUP_FRAME
-        return None
+            return (DUP_FRAME, delay_s) if defer else DUP_FRAME
+        return (None, delay_s) if defer else None
 
     def log(self, key: Optional[str] = None) -> List[Dict[str, Any]]:
         with self._lock:
@@ -433,6 +440,21 @@ def on_recv(sock, nbytes: int) -> Optional[_Verdict]:
     src, dst, lid = _edge(sock, outbound=False)
     v = _registry.apply(src, dst, lid, nbytes)
     return DROP_FRAME if v is DROP_FRAME else None
+
+
+def on_send_decide(sock, nbytes: int) -> Tuple[Optional[_Verdict], float]:
+    """``on_send`` for the asyncio wire: returns (verdict, delay_s)
+    WITHOUT sleeping — the event loop owes the delay via call_later."""
+    src, dst, lid = _edge(sock, outbound=True)
+    return _registry.apply(src, dst, lid, nbytes, defer=True)
+
+
+def on_recv_decide(sock, nbytes: int) -> Tuple[Optional[_Verdict], float]:
+    """``on_recv`` for the asyncio wire: no sleep, dup suppressed (dup
+    is a send-side effect, matching the threaded path)."""
+    src, dst, lid = _edge(sock, outbound=False)
+    v, delay_s = _registry.apply(src, dst, lid, nbytes, defer=True)
+    return (DROP_FRAME if v is DROP_FRAME else None), delay_s
 
 
 # -- introspection (test assertions) ----------------------------------

@@ -49,6 +49,20 @@ class ResourceLedger:
         self.total = dict(total)
         self._available = dict(total)
         self._cond = threading.Condition()
+        # availability-grew hook (async dispatch): fired OUTSIDE the
+        # condition lock after release/release_many/add_total so a
+        # loop-hosted dispatch pass wakes immediately instead of
+        # polling wait_for_change. The threaded dispatch loop keeps
+        # using the condition and never sets this.
+        self.on_change: Optional[Callable[[], None]] = None
+
+    def _fire_on_change(self) -> None:
+        cb = self.on_change
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass    # a wake hook must never fail a release
 
     def can_fit_total(self, demand: Dict[str, float]) -> bool:
         return all(self.total.get(k, 0.0) >= v for k, v in demand.items())
@@ -68,6 +82,7 @@ class ResourceLedger:
                 self._available[k] = min(
                     self._available.get(k, 0.0) + v, self.total.get(k, 0.0))
             self._cond.notify_all()
+        self._fire_on_change()
 
     def wait_for_change(self, timeout: float) -> None:
         with self._cond:
@@ -88,6 +103,7 @@ class ResourceLedger:
                 self.total[k] = self.total.get(k, 0.0) + v
                 self._available[k] = self._available.get(k, 0.0) + v
             self._cond.notify_all()
+        self._fire_on_change()
         _bump_cluster_epoch()   # can_fit_total answers changed
 
     def remove_total(self, extra: Dict[str, float]) -> None:
@@ -134,6 +150,7 @@ class ResourceLedger:
                         self._available.get(k, 0.0) + v * count,
                         self.total.get(k, 0.0))
             self._cond.notify_all()
+        self._fire_on_change()
 
 
 class _DirectOp:
@@ -514,10 +531,29 @@ class Node:
         # queue lag surfaced in debug_state dumps).
         self.loop_stats = {"dispatch_iterations": 0, "tasks_launched": 0,
                            "max_queue_lag_ms": 0.0, "launch_ms_total": 0.0}
-        self._dispatcher = threading.Thread(
-            target=self._dispatch_loop, daemon=True,
-            name=f"dispatch-{node_id.hex()[:8]}")
-        self._dispatcher.start()
+        # async core: the dispatch pass is a callback on the process
+        # event loop — submit, release and dispatch share one thread,
+        # so the cross-thread convoys (queue.Queue futex wake per
+        # enqueue, ledger condition notify per completion, dispatch
+        # thread wakeup per release) disappear. Producers stage on
+        # plain deques and arm ONE call_soon_threadsafe per burst
+        # behind a dirty flag. Threaded core: the dedicated dispatcher
+        # thread below, unchanged.
+        if cfg().async_core:
+            from ray_tpu._private import eventloop
+            self._aloop = eventloop.get_loop()
+            self._inbox: deque = deque()     # GIL-atomic append/popleft
+            self._wake_armed = False         # dirty flag (benign races)
+            self._stopped = False            #: loop-only
+            self._retry_timer = None         #: loop-only
+            self._dispatcher = None
+            self.ledger.on_change = self._wake_loop
+        else:
+            self._aloop = None
+            self._dispatcher = threading.Thread(
+                target=self._dispatch_loop, daemon=True,
+                name=f"dispatch-{node_id.hex()[:8]}")
+            self._dispatcher.start()
 
     def info(self) -> NodeInfo:
         return NodeInfo(node_id=self.node_id, alive=self.alive,
@@ -537,7 +573,32 @@ class Node:
         with self._pending_lock:
             for k, v in spec.resources.items():
                 self._pending_demand[k] = self._pending_demand.get(k, 0.0) + v
-        self._queue.put(spec)
+        self._post(spec)
+
+    def _post(self, item) -> None:
+        """Dispatch-input hand-off. Threaded core: the blocking queue
+        (one futex wake per item). Async core: stage on a plain deque
+        and coalesce wakes behind the dirty flag — one
+        call_soon_threadsafe per BURST of submissions, not one per
+        task."""
+        if self._aloop is None:
+            self._queue.put(item)
+            return
+        self._inbox.append(item)
+        self._wake_loop()
+
+    def _wake_loop(self) -> None:
+        # benign race on the flag: two producers may both arm — the
+        # second pass finds empty stages and returns; a producer that
+        # loses the other way (flag already True) is covered by the
+        # armed pass, which drains AFTER clearing the flag
+        if self._wake_armed or self._aloop is None:
+            return
+        self._wake_armed = True
+        try:
+            self._aloop.call_soon_threadsafe(self._loop_pass)
+        except RuntimeError:
+            pass    # loop torn down (interpreter exit)
 
     def _drop_pending(self, spec: TaskSpec) -> None:
         self._drop_pending_many((spec,))
@@ -575,130 +636,187 @@ class Node:
                     if spec is _WAKE:
                         timeout = 0.0
                         continue
-                    # re-read per spec: the runtime attaches the manager
-                    # right after construction, but this thread may have
-                    # captured a stale None before the first enqueue
-                    ten = self.tenancy
-                    key = tuple(sorted(spec.resources.items()))
-                    if ten is not None:
-                        key = (spec.job_id.hex()
-                               if spec.job_id is not None else "", key)
-                    bucket = self._backlog.get(key)
-                    if bucket is None:
-                        bucket = self._backlog[key] = deque()
-                    bucket.append(spec)
-                    self._backlog_n += 1
+                    self._ingest(spec)
                     timeout = 0.0
             except queue.Empty:
                 pass
-            ten = self.tenancy
-            if not self.alive:
-                self._fail_backlog()
-                continue
-            if self.draining and (self._backlog_n
-                                  or self._exec_pool
-                                  .has_handback_pending()):
-                # Hand queued-but-unstarted work back to the cluster
-                # scheduler (no retry consumed) — both backlog entries
-                # AND specs already admitted into the exec-pool queue
-                # (the backlog can be empty while the pool still holds
-                # unstarted work). Whatever bounces back (nowhere else
-                # fits) falls through and dispatches here.
-                self._resubmit_backlog()
-            progressed = False
-            self.loop_stats["dispatch_iterations"] += 1
-            if ten is not None and self._backlog:
-                # Deficit-ordered batch admission: a job's same-shape
-                # ready group is considered whole, highest fair-share
-                # deficit first (batch-DAG dispatch per 2002.07062) —
-                # a light job's small groups cut ahead of a saturating
-                # job's backlog instead of interleaving arbitrarily.
-                keys = ten.order_buckets(
-                    [((_bucket_job(k), k), len(b))
-                     for k, b in self._backlog.items()])
-                keys = [k for _job, k in keys]
-            else:
-                keys = list(self._backlog)
-            for key in keys:
-                bucket = self._backlog.get(key)
-                if bucket is None:
-                    continue
-                while bucket:
-                    demand = bucket[0].resources
-                    want = len(bucket)
-                    if ten is not None:
-                        # per-job hard-cap gate: a clamped group stays
-                        # QUEUED in the backlog (never lost) until the
-                        # job's own completions free quota headroom
-                        want = ten.admit_cap(_bucket_job(key), demand,
-                                             want)
-                        if want <= 0:
-                            break
-                    # Batch admission: every task in a bucket shares one
-                    # resource shape, so ONE ledger lock round-trip
-                    # admits as many as currently fit (per-task
-                    # try_acquire paid a lock + dict scan per task).
-                    n = self.ledger.try_acquire_many(demand, want)
-                    if n <= 0:
-                        break
-                    admitted = [bucket.popleft() for _ in range(n)]
-                    self._backlog_n -= n
-                    self._drop_pending_many(admitted)
-                    t0 = time.perf_counter()
-                    for spec in admitted:
-                        # Pairs this admission's ledger acquire with
-                        # exactly one release: the worker may release
-                        # early (see worker._release_task_resources) or
-                        # _run_spec's `finally` does.
-                        spec._resources_released = False
-                        if spec.enqueued_at:
-                            lag_ms = (t0 - spec.enqueued_at) * 1000
-                            if lag_ms > self.loop_stats["max_queue_lag_ms"]:
-                                self.loop_stats["max_queue_lag_ms"] = lag_ms
-                            _metrics.note_queue_dwell(
-                                "node.dispatch", lag_ms / 1000.0)
-                            if getattr(spec, "trace_sampled", False):
-                                # queue phase: backlog enqueue ->
-                                # dispatch-loop admission. t0 is reused
-                                # as the span end: zero extra clock
-                                # reads on the dispatch thread.
-                                from ray_tpu._private import events as _ev
-                                _ev.record_phase_rt(
-                                    spec, "queue", lag_ms / 1000.0,
-                                    self.node_id.hex(),
-                                    start_wall=_ev.wall_at(
-                                        spec.enqueued_at),
-                                    end_mono=t0)
-                    # count BEFORE the pool takes them: a task may
-                    # finish (and a get() observe it) before control
-                    # returns here
-                    self.loop_stats["tasks_launched"] += n
-                    if ten is not None:
-                        ten.note_admitted(_bucket_job(key), demand, n)
-                    with self._running_lock:
-                        self._running.update(s.task_id for s in admitted)
-                    # ONE handoff for the whole admitted batch; the
-                    # sized pool reuses threads instead of paying a
-                    # spawn + closure per task
-                    self._exec_pool.submit_batch(admitted)
-                    self.loop_stats["launch_ms_total"] += (
-                        time.perf_counter() - t0) * 1000
-                    progressed = True
-                if not bucket:
-                    self._backlog.pop(key, None)
-            if ten is not None:
-                counts: Dict[str, int] = {}
-                for k, b in self._backlog.items():
-                    job = _bucket_job(k)
-                    counts[job] = counts.get(job, 0) + len(b)
-                # unchanged since last round ⇒ the ledger already saw
-                # this state (idle deficit reset included) — skip the
-                # per-round lock round-trip
-                if counts != self._tenancy_qcounts:
-                    self._tenancy_qcounts = counts
-                    ten.observe_queued(self.node_id.hex(), counts)
+            progressed = self._dispatch_pass()
             if self._backlog_n and not progressed:
                 self.ledger.wait_for_change(0.05)
+
+    def _ingest(self, spec: TaskSpec) -> None:
+        """Bucket one submitted spec into the backlog (dispatch thread
+        or event loop — whichever owns the backlog in this mode)."""
+        # re-read per spec: the runtime attaches the tenancy manager
+        # right after construction, but the dispatcher may have
+        # captured a stale None before the first enqueue
+        ten = self.tenancy
+        key = tuple(sorted(spec.resources.items()))
+        if ten is not None:
+            key = (spec.job_id.hex()
+                   if spec.job_id is not None else "", key)
+        bucket = self._backlog.get(key)
+        if bucket is None:
+            bucket = self._backlog[key] = deque()
+        bucket.append(spec)
+        self._backlog_n += 1
+
+    def _loop_pass(self) -> None:  #: loop-only
+        """One dispatch round on the process event loop (async core).
+
+        Producers (submit handlers, completing workers, ledger
+        releases) stage work on plain deques and arm at most one of
+        these per burst via ``_wake_armed``. The flag is cleared FIRST:
+        a wake staged after the clear schedules a fresh pass, one
+        staged before it is drained below — the occasional extra no-op
+        pass (an on-loop ledger release re-arms mid-pass) is cheaper
+        than a lost wake.
+        """
+        self._wake_armed = False
+        if self._retry_timer is not None:
+            self._retry_timer.cancel()
+            self._retry_timer = None
+        # coalesced ledger releases: one release_many for the burst
+        with self._stage_lock:
+            batch, self._release_stage = self._release_stage, []
+        if batch:
+            self._release_batch(batch)
+        inbox = self._inbox
+        while inbox:
+            item = inbox.popleft()
+            if item is None:
+                self._stopped = True
+                return
+            if item is _WAKE:
+                continue
+            self._ingest(item)
+        if self._stopped:
+            return
+        progressed = self._dispatch_pass()
+        if self._backlog_n and not progressed and not self._stopped:
+            # blocked on resources/quota with no release in flight —
+            # poll-retry, mirroring the threaded loop's
+            # wait_for_change(0.05); a real release cancels this timer
+            # via the ledger's on_change wake
+            self._retry_timer = self._aloop.call_later(
+                0.05, self._retry_pass)
+
+    def _retry_pass(self) -> None:  #: loop-only
+        self._retry_timer = None
+        self._loop_pass()
+
+    def _dispatch_pass(self) -> bool:
+        """One admission pass over the backlog buckets (shared by the
+        threaded dispatcher and the loop-hosted async pass). Returns
+        whether any bucket made progress; the caller decides how to
+        wait when blocked (condition poll vs call_later retry)."""
+        ten = self.tenancy
+        if not self.alive:
+            self._fail_backlog()
+            return True     # backlog emptied: nothing to wait on
+        if self.draining and (self._backlog_n
+                              or self._exec_pool
+                              .has_handback_pending()):
+            # Hand queued-but-unstarted work back to the cluster
+            # scheduler (no retry consumed) — both backlog entries
+            # AND specs already admitted into the exec-pool queue
+            # (the backlog can be empty while the pool still holds
+            # unstarted work). Whatever bounces back (nowhere else
+            # fits) falls through and dispatches here.
+            self._resubmit_backlog()
+        progressed = False
+        self.loop_stats["dispatch_iterations"] += 1
+        if ten is not None and self._backlog:
+            # Deficit-ordered batch admission: a job's same-shape
+            # ready group is considered whole, highest fair-share
+            # deficit first (batch-DAG dispatch per 2002.07062) —
+            # a light job's small groups cut ahead of a saturating
+            # job's backlog instead of interleaving arbitrarily.
+            keys = ten.order_buckets(
+                [((_bucket_job(k), k), len(b))
+                 for k, b in self._backlog.items()])
+            keys = [k for _job, k in keys]
+        else:
+            keys = list(self._backlog)
+        for key in keys:
+            bucket = self._backlog.get(key)
+            if bucket is None:
+                continue
+            while bucket:
+                demand = bucket[0].resources
+                want = len(bucket)
+                if ten is not None:
+                    # per-job hard-cap gate: a clamped group stays
+                    # QUEUED in the backlog (never lost) until the
+                    # job's own completions free quota headroom
+                    want = ten.admit_cap(_bucket_job(key), demand,
+                                         want)
+                    if want <= 0:
+                        break
+                # Batch admission: every task in a bucket shares one
+                # resource shape, so ONE ledger lock round-trip
+                # admits as many as currently fit (per-task
+                # try_acquire paid a lock + dict scan per task).
+                n = self.ledger.try_acquire_many(demand, want)
+                if n <= 0:
+                    break
+                admitted = [bucket.popleft() for _ in range(n)]
+                self._backlog_n -= n
+                self._drop_pending_many(admitted)
+                t0 = time.perf_counter()
+                for spec in admitted:
+                    # Pairs this admission's ledger acquire with
+                    # exactly one release: the worker may release
+                    # early (see worker._release_task_resources) or
+                    # _run_spec's `finally` does.
+                    spec._resources_released = False
+                    if spec.enqueued_at:
+                        lag_ms = (t0 - spec.enqueued_at) * 1000
+                        if lag_ms > self.loop_stats["max_queue_lag_ms"]:
+                            self.loop_stats["max_queue_lag_ms"] = lag_ms
+                        _metrics.note_queue_dwell(
+                            "node.dispatch", lag_ms / 1000.0)
+                        if getattr(spec, "trace_sampled", False):
+                            # queue phase: backlog enqueue ->
+                            # dispatch-loop admission. t0 is reused
+                            # as the span end: zero extra clock
+                            # reads on the dispatch thread.
+                            from ray_tpu._private import events as _ev
+                            _ev.record_phase_rt(
+                                spec, "queue", lag_ms / 1000.0,
+                                self.node_id.hex(),
+                                start_wall=_ev.wall_at(
+                                    spec.enqueued_at),
+                                end_mono=t0)
+                # count BEFORE the pool takes them: a task may
+                # finish (and a get() observe it) before control
+                # returns here
+                self.loop_stats["tasks_launched"] += n
+                if ten is not None:
+                    ten.note_admitted(_bucket_job(key), demand, n)
+                with self._running_lock:
+                    self._running.update(s.task_id for s in admitted)
+                # ONE handoff for the whole admitted batch; the
+                # sized pool reuses threads instead of paying a
+                # spawn + closure per task
+                self._exec_pool.submit_batch(admitted)
+                self.loop_stats["launch_ms_total"] += (
+                    time.perf_counter() - t0) * 1000
+                progressed = True
+            if not bucket:
+                self._backlog.pop(key, None)
+        if ten is not None:
+            counts: Dict[str, int] = {}
+            for k, b in self._backlog.items():
+                job = _bucket_job(k)
+                counts[job] = counts.get(job, 0) + len(b)
+            # unchanged since last round ⇒ the ledger already saw
+            # this state (idle deficit reset included) — skip the
+            # per-round lock round-trip
+            if counts != self._tenancy_qcounts:
+                self._tenancy_qcounts = counts
+                ten.observe_queued(self.node_id.hex(), counts)
+        return progressed
 
     def _run_spec(self, spec: TaskSpec) -> None:
         """One task's execution on an exec-pool worker thread."""
@@ -728,7 +846,18 @@ class Node:
         if another thread is already flushing, this release rides its
         drain (one ledger acquisition + one notify for the whole
         batch); otherwise this thread flushes inline — the uncontended
-        single-task case keeps the old release latency."""
+        single-task case keeps the old release latency.
+
+        Async core: every release stages and the LOOP drains the whole
+        batch at the top of its next pass — the completing worker
+        thread never touches the ledger lock, and a drain storm
+        collapses to one release_many + zero cross-thread dispatch
+        wakeups (the pass it woke is already the one dispatching)."""
+        if self._aloop is not None:
+            with self._stage_lock:
+                self._release_stage.append(resources)
+            self._wake_loop()
+            return
         with self._stage_lock:
             self._release_stage.append(resources)
             if self._stage_flushing:
@@ -770,6 +899,19 @@ class Node:
                 entry[1] += 1
         self.ledger.release_many(groups.values())
 
+    def _notify_off_loop(self, fn: Callable[[], None]) -> None:
+        """Run runtime notifications off the event loop. The lost/
+        drained callbacks resubmit through the scheduler and may do
+        blocking RPC (AsyncClient.call raises on the loop by design),
+        so a loop-hosted dispatch pass ships them to a helper thread;
+        a plain caller (threaded core, shutdown path) runs inline."""
+        from ray_tpu._private import eventloop
+        if eventloop.on_loop():
+            threading.Thread(target=fn, daemon=True,
+                             name="node-notify").start()
+        else:
+            fn()
+
     def _fail_backlog(self) -> None:
         from ray_tpu._private import worker
         rt = worker.global_runtime()
@@ -778,9 +920,11 @@ class Node:
         backlog = [spec for bucket in buckets.values() for spec in bucket]
         for spec in backlog:
             self._drop_pending(spec)
-        if rt is not None:
-            for spec in backlog:
-                rt.on_node_task_lost(spec, self)
+        if rt is not None and backlog:
+            def _notify() -> None:
+                for spec in backlog:
+                    rt.on_node_task_lost(spec, self)
+            self._notify_off_loop(_notify)
 
     def start_drain(self) -> None:
         """Enter the DRAINING state: running tasks finish, the dispatch
@@ -791,7 +935,7 @@ class Node:
         # DRAINING must leave cached pick_node candidate sets NOW, not
         # at the next natural invalidation
         _bump_cluster_epoch()
-        self._queue.put(_WAKE)
+        self._post(_WAKE)
 
     def _resubmit_backlog(self) -> None:
         """Graceful-drain pass (dispatch thread only): queued tasks that
@@ -817,20 +961,24 @@ class Node:
         self._backlog_n = sum(len(b) for b in keep.values())
         for spec in moved:
             self._drop_pending(spec)
-        for spec in moved:
-            rt.on_node_task_drained(spec, self)
-        self._drain_pool_pending(rt)
+        handback = self._steal_drain_handback()
+        drained = moved + handback
+        if drained:
+            def _notify() -> None:
+                for spec in drained:
+                    rt.on_node_task_drained(spec, self)
+            self._notify_off_loop(_notify)
 
-    def _drain_pool_pending(self, rt) -> None:
+    def _steal_drain_handback(self) -> List[TaskSpec]:
         """Exec-pool drain interaction: in-flight tasks finish on their
         worker threads, but admitted-but-unstarted specs still sitting
-        in the pool queue are stolen back, their ledger admission
-        undone, and handed to the scheduler like backlog entries (no
-        retry consumed). Bounced-back specs (nothing else fits) re-feed
-        the pool and run here."""
+        in the pool queue are stolen back and their ledger admission
+        undone; the returned specs are handed to the scheduler like
+        backlog entries (no retry consumed). Bounced-back specs
+        (nothing else fits) re-feed the pool and run here."""
         stolen = self._exec_pool.steal_pending()
         if not stolen:
-            return
+            return []
         requeue: List[TaskSpec] = []
         handback: List[TaskSpec] = []
         for spec in stolen:
@@ -841,7 +989,7 @@ class Node:
         if requeue:
             self._exec_pool.submit_batch(requeue)
         if not handback:
-            return
+            return []
         with self._running_lock:
             for spec in handback:
                 self._running.discard(spec.task_id)
@@ -855,8 +1003,7 @@ class Node:
                         spec.job_id.hex()
                         if spec.job_id is not None else "",
                         spec.resources)
-        for spec in handback:
-            rt.on_node_task_drained(spec, self)
+        return handback
 
     # -- actor hosting -----------------------------------------------------
     def host_actor(self, executor: ActorExecutor) -> None:
@@ -872,7 +1019,7 @@ class Node:
         """Stop the node; returns per-actor pending tasks for FT handling."""
         self.alive = False
         _bump_cluster_epoch()
-        self._queue.put(None)
+        self._post(None)
         pending_by_actor: Dict[ActorID, List[TaskSpec]] = {}
         with self._actors_lock:
             actors = dict(self.actors)

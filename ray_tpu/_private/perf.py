@@ -201,17 +201,33 @@ def envelope_rows() -> List[Dict]:
     def val(i):
         return i
 
-    t0 = time.perf_counter()
-    refs = [val.remote(i) for i in range(100_000)]
-    submit_s = time.perf_counter() - t0
-    out = ray_tpu.get(refs)
-    total_s = time.perf_counter() - t0
-    assert out[-1] == 99_999
-    rows.append({"name": "queued_100000_task_drain", "n": 100_000,
-                 "submit_seconds": round(submit_s, 3),
-                 "total_seconds": round(total_s, 3),
-                 "submit_per_s": round(100_000 / submit_s, 1),
-                 "drain_per_s": round(100_000 / total_s, 1)})
+    # scaled queued-drain: climb the backlog ladder until the box
+    # cannot hold the next rung (memory/thread/PID limits) or a rung
+    # blows the time budget. Every rung that held is committed, so
+    # PERF.md records the LARGEST backlog this box drains plus the
+    # rate trend on the way up — degrading gracefully on small hosts
+    # instead of losing the whole section to one oversized slice.
+    import os as _os
+    budget_s = float(_os.environ.get("PERF_ENVELOPE_DRAIN_BUDGET_S",
+                                     "120"))
+    for n in (100_000, 300_000, 1_000_000):
+        t0 = time.perf_counter()
+        try:
+            refs = [val.remote(i) for i in range(n)]
+            submit_s = time.perf_counter() - t0
+            out = ray_tpu.get(refs)
+            total_s = time.perf_counter() - t0
+            assert out[-1] == n - 1
+            del refs, out
+        except Exception:
+            break       # previous rung stands as the box's envelope
+        rows.append({"name": f"queued_{n}_task_drain", "n": n,
+                     "submit_seconds": round(submit_s, 3),
+                     "total_seconds": round(total_s, 3),
+                     "submit_per_s": round(n / submit_s, 1),
+                     "drain_per_s": round(n / total_s, 1)})
+        if total_s > budget_s:
+            break       # next rung would run 3x past the budget
 
     @ray_tpu.remote(_in_process=True)
     class Cell:

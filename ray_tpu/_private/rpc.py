@@ -69,6 +69,20 @@ def concurrent(handler):
     return handler
 
 
+def loop_safe(handler):
+    """Mark a handler as non-blocking: on the async core it runs INLINE
+    on the event loop (parse -> handler -> reply with zero thread
+    hand-offs; the reply joins the peer's coalesced write batch). The
+    contract is strict — no lock that a non-loop thread holds across
+    blocking work, no socket/file I/O, no pool waits; anything heavier
+    must be staged to an executor by the handler itself. Ordering note:
+    loop_safe frames keep arrival order among THEMSELVES (loop FIFO)
+    but may run ahead of earlier lane-queued methods from the same
+    peer. The threaded core ignores the marker (lane semantics)."""
+    handler._rpc_loop_safe = True
+    return handler
+
+
 # ---------------------------------------------------------------------------
 # message schemas (the "proto file"): method -> required field names
 # ---------------------------------------------------------------------------
@@ -551,6 +565,33 @@ class Server:
                 conn.sock.close()
             except OSError:
                 pass
+
+
+# ---------------------------------------------------------------------------
+# core selection: ONE pair of factories gates the async rebuild. Both
+# cores speak identical frames, so a threaded peer and an async peer
+# interoperate on the same socket — cfg().async_core is a per-process
+# choice (advertised via the async_core hello bit), not a wire version.
+# ---------------------------------------------------------------------------
+
+def serve(service: Any, host: str = "127.0.0.1", port: int = 0):
+    """Build the configured server core (NOT started — call .start())."""
+    from ray_tpu._private.config import cfg
+    if cfg().async_core:
+        from ray_tpu._private.aio import AsyncServer
+        return AsyncServer(service, host=host, port=port)
+    return Server(service, host=host, port=port)
+
+
+def connect(addr: Tuple[str, int], timeout: float = 30.0,
+            on_push: Optional[Callable[[str, Dict[str, Any]], None]]
+            = None):
+    """Dial with the configured client core."""
+    from ray_tpu._private.config import cfg
+    if cfg().async_core:
+        from ray_tpu._private.aio import AsyncClient
+        return AsyncClient(addr, timeout=timeout, on_push=on_push)
+    return Client(addr, timeout=timeout, on_push=on_push)
 
 
 def wait_for_server(addr: Tuple[str, int], timeout: float = 15.0) -> None:

@@ -250,6 +250,8 @@ class Runtime:
         from ray_tpu._private import netchaos as _netchaos
         _netchaos.maybe_activate_from_config(_cfg())
         _netchaos.set_local_role("driver")
+        from ray_tpu._private import eventloop as _eventloop
+        _eventloop.set_proc_label("driver")
         self.tpu_topology = None
         _topo_spec = _cfg().tpu_topology
         if _topo_spec:
@@ -2297,6 +2299,9 @@ class Runtime:
                 f"actors={len(node.actors)} "
                 f"store_used={node.store.used_bytes()} "
                 f"loop={node.loop_stats}")
+        if self.cluster_backend is not None:
+            # which control-plane core each daemon advertised in hello
+            lines.extend(self.cluster_backend.describe_peers())
         return "\n".join(lines)
 
     # ------------------------------------------------------------------

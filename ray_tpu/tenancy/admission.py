@@ -87,6 +87,13 @@ class TenancyManager:
         #: guarded by self._lock — per-job OVER-CAP submits awaiting
         #: dispatch (the REJECTED bound; admitted flow never counts)
         self._pending: Dict[str, int] = {}
+        #: guarded by self._lock — per-job demand submitted but not yet
+        #: dispatched. The submit-time verdict folds this in so a BURST
+        #: of submits sees its own outstanding demand: usage alone made
+        #: the QUEUED verdict a race against the dispatch pass (the
+        #: async core coalesces dispatch wakes, so a tight submit loop
+        #: can finish before the first task is ever marked running).
+        self._inflight: Dict[str, Dict[str, float]] = {}
         #: guarded by self._lock — quota/weight records awaiting head sync
         self._dirty: Dict[str, Dict[str, Any]] = {}
         #: guarded by self._lock
@@ -160,7 +167,17 @@ class TenancyManager:
         dispatch-side gate enforces QUEUED)."""
         job = spec.job_id.hex() if spec.job_id is not None else ""
         verdict = ADMITTED
-        if self.ledger.over_hard_cap(job, spec.resources):
+        demand = spec.resources
+        flight = self._inflight.get(job)  # raylint: disable=guarded-by
+        if flight:
+            # this task ON TOP OF the job's own not-yet-dispatched
+            # submits — deterministic under a burst, dispatcher-timing
+            # independent (lock-free peek; a stale read only shades
+            # the advisory verdict, never correctness)
+            demand = dict(demand)
+            for res, v in flight.items():
+                demand[res] = demand.get(res, 0.0) + v
+        if self.ledger.over_hard_cap(job, demand):
             verdict = QUEUED
         if _fp.ENABLED:
             try:
@@ -179,6 +196,15 @@ class TenancyManager:
                     verdict = REJECTED
                 else:
                     self._pending[job] = pending + 1
+        if verdict != REJECTED and self.ledger.any_caps():
+            # the submit's demand counts as in flight until dispatch
+            # marks it running (note_admitted). Only paid once a quota
+            # exists somewhere — quota-free clusters keep the lock-free
+            # submit path.
+            with self._lock:
+                flight = self._inflight.setdefault(job, {})
+                for res, v in spec.resources.items():
+                    flight[res] = flight.get(res, 0.0) + float(v)
         _admission_total.inc(tags=_VERDICT_TAGS[verdict])
         if verdict == REJECTED:
             raise AdmissionRejectedError(
@@ -226,6 +252,22 @@ class TenancyManager:
             with self._lock:
                 left = self._pending.get(job, 0) - n
                 self._pending[job] = left if left > 0 else 0
+        if self._inflight.get(job):  # raylint: disable=guarded-by
+            # retire the dispatched demand from the inflight view. A
+            # dispatched group can mix resource shapes, so per-resource
+            # floors at zero — over-subtraction CORRECTS leaks (tasks
+            # cancelled before dispatch) rather than compounding them.
+            with self._lock:
+                flight = self._inflight.get(job)
+                if flight:
+                    for res, v in demand.items():
+                        left = flight.get(res, 0.0) - float(v) * n
+                        if left > 1e-9:
+                            flight[res] = left
+                        else:
+                            flight.pop(res, None)
+                    if not flight:
+                        self._inflight.pop(job, None)
         self._refresh_gauges()
 
     def note_done(self, job: str, resources: Dict[str, float]) -> None:
@@ -272,9 +314,10 @@ class TenancyManager:
             # work is in flight would race submits mid-bucketing and
             # deflate the rejection bound.
             for job, row in snap.items():
-                if (int(row["queued"]) == 0 and int(row["running"]) == 0
-                        and self._pending.get(job, 0) > 0):
-                    self._pending[job] = 0
+                if int(row["queued"]) == 0 and int(row["running"]) == 0:
+                    if self._pending.get(job, 0) > 0:
+                        self._pending[job] = 0
+                    self._inflight.pop(job, None)
 
     # ------------------------------------------------------------------
     # views / federation

@@ -171,6 +171,12 @@ class FairShareLedger:
     # ------------------------------------------------------------------
     # quota checks
     # ------------------------------------------------------------------
+    def any_caps(self) -> bool:
+        """Lock-free: has ANY job declared a hard/soft cap? Admission
+        reads this to skip inflight bookkeeping on quota-free clusters
+        (same staleness contract as the quota checks below)."""
+        return self._any_caps
+
     def over_hard_cap(self, job: str, demand: Dict[str, float]) -> bool:
         """Would one more task of ``demand`` put ``job`` over a hard cap?
         Also true while the job's tracked object-store bytes exceed a

@@ -43,11 +43,26 @@ class Metric:
         with _REG_LOCK:
             _REGISTRY[name] = self
 
+    def _reattach(self) -> None:
+        # clear_registry() (test isolation, process reuse) empties the
+        # name->metric table, but module-level metric HOLDERS (tenancy
+        # gauges, wire counters) keep writing to the orphaned instance —
+        # which then never appears in prometheus_text() again. Re-attach
+        # on write so a live metric always reaches the exposition; a
+        # cleared metric nobody writes again stays gone. The unlocked
+        # membership probe is safe: dict get is atomic, and a lost race
+        # just means one extra locked setdefault.
+        if _REGISTRY.get(self.name) is not self:
+            with _REG_LOCK:
+                _REGISTRY.setdefault(self.name, self)
+
     def _set(self, key: Tuple, value: float) -> None:
+        self._reattach()
         with self._lock:
             self._values[key] = value
 
     def _add(self, key: Tuple, delta: float) -> None:
+        self._reattach()
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + delta
 
@@ -94,6 +109,7 @@ class Histogram(Metric):
 
     def observe(self, value: float,
                 tags: Optional[Dict[str, str]] = None) -> None:
+        self._reattach()
         key = _labels_key(tags)
         with self._lock:
             counts = self._counts.setdefault(

@@ -180,9 +180,14 @@ def test_admit_verdicts_and_typed_rejection():
     assert ten.admit(spec) == QUEUED                # pending 2 == max
     with pytest.raises(AdmissionRejectedError):
         ten.admit(spec)
-    # completions free the cap: back to ADMITTED (pending bound only
-    # gates over-quota submits)
-    ten.note_done(job, spec.resources)
+    # dispatch retires the queued submits' inflight demand and
+    # completions free the cap: back to ADMITTED. (The verdict folds
+    # in submitted-not-yet-dispatched demand, so queued work must
+    # actually dispatch — not merely have older tasks complete —
+    # before the job reads as under cap again.)
+    ten.note_admitted(job, spec.resources, 2)
+    for _ in range(3):
+        ten.note_done(job, spec.resources)
     assert ten.admit(spec) == ADMITTED
 
 

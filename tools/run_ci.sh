@@ -12,11 +12,15 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-echo "=== stage 0: observability (dashboard endpoints + task tracing) ==="
+echo "=== stage 0: observability + async core (fail-fast) ==="
 # cheap fail-fast pass over the dashboard/trace/federation/profiling
-# tests (they also run inside stages 1-2; this surfaces observability
-# breakage in seconds instead of after the full sweep)
-python -m pytest tests/test_observability.py tests/test_profiling.py -x -q
+# tests plus the asyncio-core suite (loop affinity, coalesced writes,
+# failpoint/netchaos parity, mixed-cluster hello bit — the wire every
+# other suite rides on). They also run inside stages 1-2; this
+# surfaces wire/observability breakage in seconds instead of after
+# the full sweep.
+python -m pytest tests/test_observability.py tests/test_profiling.py \
+    tests/test_async_core.py -x -q
 
 echo "=== stage 0.5: raylint (static concurrency/protocol analysis) ==="
 # fail-fast AST passes: guarded-by, lock-order, blocking-under-lock,
