@@ -508,6 +508,10 @@ class DaemonHandle:
         self._fns_shipped: set = set()      # fids this daemon holds
         self._free = _FreeCoalescer(self)
         self.runtime = None                    # bound by the backend
+        # node memory-pressure level (daemon node_pressure pushes /
+        # gossip at join); mirrored onto the runtime Node so pick_node
+        # soft-excludes hard-pressure nodes like DRAINING ones
+        self.pressure_level = "ok"
 
     # -- push demux -------------------------------------------------------
     def _on_push(self, method: str, msg: Dict[str, Any]) -> None:
@@ -523,6 +527,8 @@ class DaemonHandle:
                 stream = self._streams.get(msg["task"])
             if stream is not None:
                 stream.q.put(msg)
+        elif method == "node_pressure":
+            self._on_node_pressure(msg.get("level") or "ok")
         elif method == "actor_worker_died":
             cb = self.on_actor_worker_died
             if cb is not None:
@@ -542,6 +548,20 @@ class DaemonHandle:
             out = sys.stderr if msg.get("stream") == "err" else sys.stdout
             print(f"(worker node={msg.get('node', '?')} "
                   f"pid={msg.get('pid')}) {msg.get('line')}", file=out)
+
+    def _on_node_pressure(self, level: str) -> None:
+        """Daemon pressure transition: mirror the level onto the
+        runtime Node and invalidate the scheduler's feasibility cache
+        (the DRAINING discipline — a cached pick must not keep landing
+        work on a node that just went hard)."""
+        self.pressure_level = level
+        rt = self.runtime
+        node = rt.get_node(self.node_id) if rt is not None else None
+        if node is not None \
+                and getattr(node, "pressure_level", "ok") != level:
+            node.pressure_level = level
+            from ray_tpu._private.scheduler import bump_cluster_epoch
+            bump_cluster_epoch()
 
     def mark_dead(self) -> None:
         self.dead = True
@@ -1287,7 +1307,12 @@ class DaemonHandle:
                           ref=ref)
 
     def put_object_blob(self, oid: bytes, blob: bytes) -> None:
-        self._call("put_object", oid=oid, blob=blob)
+        out = self._call("put_object", oid=oid, blob=blob)
+        if isinstance(out, dict) and out.get("backpressure"):
+            from ray_tpu.exceptions import MemoryPressureError
+            raise MemoryPressureError(
+                f"node {self.node_id.hex()[:8]} rejected put under "
+                f"{out.get('level', 'hard')} memory pressure")
 
     def free_objects(self, oids: List[bytes]) -> None:
         try:
@@ -1445,7 +1470,14 @@ class RemoteStore:
         blob = wire_dumps(value)
         if self._direct_put_blob(object_id, key, blob):
             return
-        self.daemon.put_object_blob(key, blob)
+        from ray_tpu._private.retry import RetryPolicy
+        from ray_tpu.exceptions import MemoryPressureError
+        # HARD-pressure backpressure is retriable by contract: the node
+        # is actively spilling/preempting its way back to capacity, so
+        # ride the policy until relief instead of failing the put
+        RetryPolicy.default(deadline_s=30.0).run(
+            lambda: self.daemon.put_object_blob(key, blob),
+            loop="put.backpressure", retry_on=(MemoryPressureError,))
         self.register_remote(object_id, key, len(blob))
         self.stats["puts"] += 1
 
@@ -1951,6 +1983,11 @@ class ClusterBackend:
                 self.runtime.begin_node_drain(
                     node, float(info.get("drain_deadline_s") or 0.0),
                     info.get("drain_reason") or "drain")
+            # joined while the node was already pressured (we missed
+            # the node_pressure push): the gossip row carries the level
+            level = (info.get("gossip_load") or {}).get("pressure")
+            if level and level != "ok":
+                handle._on_node_pressure(level)
         return handle
 
     def _on_node_event(self, event: Dict[str, Any]) -> None:

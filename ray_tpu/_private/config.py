@@ -112,6 +112,30 @@ FLAG_DEFS = [
          "(0 = detect from cgroup/system)"),
     Flag("worker_killing_policy", str, "retriable_fifo",
          "'retriable_fifo' or 'group_by_owner'"),
+    # -- memory pressure / graceful degradation (_private/pressure.py,
+    # docs/fault_tolerance.md "Memory pressure & graceful degradation") --
+    Flag("memory_pressure", bool, False, "arm the per-node "
+         "PressureController: fuses host RSS, arena occupancy, and the "
+         "spill-dir budget into an ok/soft/hard level — soft spills "
+         "cold arena entries proactively and throttles push-prefetch, "
+         "hard rejects new reservations/puts with a retriable "
+         "MemoryPressureError and feeds pressure-aware placement; off "
+         "keeps every put/get hot path byte-identical (zero-overhead-"
+         "when-off, same discipline as net_chaos)"),
+    Flag("arena_spill_dir", str, "", "directory for spilled host-shm "
+         "arena entries; empty = <tmpdir>/rtpu_spill_<arena> created "
+         "on first spill"),
+    Flag("arena_spill_watermarks", str, "0.70,0.85", "soft,hard arena "
+         "occupancy fractions: past soft the controller spills cold "
+         "sealed unpinned entries back down to the soft line; past "
+         "hard (or when the host monitor is at its own threshold) new "
+         "reservations/puts are rejected with MemoryPressureError"),
+    Flag("arena_spill_budget_bytes", int, 0, "cap on total bytes parked "
+         "in the spill dir (0 = unbounded); at budget the spiller "
+         "stops and sustained arena pressure escalates to hard"),
+    Flag("pressure_tick_s", float, 0.5, "PressureController evaluation "
+         "period (seconds); 0 disarms the controller even when "
+         "memory_pressure is on"),
     # -- logs --
     Flag("log_to_driver", bool, True, "capture worker stdout/stderr to "
          "per-pid files and tail them to the driver"),

@@ -113,6 +113,10 @@ declare("core_release", "task")
 # THIS daemon process — lets a campaign partition one node's head link
 # when env activation (pre-spawn, all nodes) is too blunt
 declare("net_chaos", "spec")
+# same per-node chaos hook for failpoints: arm a seeded spec inside
+# THIS daemon process (e.g. pressure.level on one node) when env
+# activation — which reaches every spawned process — is too blunt
+declare("fail_points", "spec")
 
 
 # ---------------------------------------------------------------------------
@@ -231,7 +235,8 @@ def _pid_alive(pid: int) -> bool:
 
 class ObjectTable:
     def __init__(self, arena_name: str, capacity: int,
-                 sweep: bool = True):
+                 sweep: bool = True, spill_dir: Optional[str] = None,
+                 spill_budget: int = 0):
         self._small: Dict[bytes, bytes] = {}  #: guarded by self._lock
         self._lock = tracked_lock("daemon.object_table", reentrant=False)
         self.arena_name = arena_name
@@ -262,6 +267,21 @@ class ObjectTable:
         # popped at seal/abort, aborted by reclaim_client and by the
         # heartbeat sweep once past the TTL.
         self._reservations: Dict[bytes, Tuple[str, float]] = {}  #: guarded by self._lock
+        # -- arena spill tier (docs/object_plane.md "Arena spill") --
+        # The native store has no key-enumeration API, so spill
+        # candidacy needs a Python-side index of SEALED arena entries:
+        # key -> nbytes in LRU order (move_to_end on every read grant).
+        # None spill_dir = spilling disarmed (every op short-circuits).
+        self.spill_dir = spill_dir
+        self.spill_budget = int(spill_budget or 0)
+        self._entries: "OrderedDict[bytes, int]" = OrderedDict()  #: guarded by self._lock
+        # key -> (path, nbytes) for entries currently parked on disk
+        self._spilled: Dict[bytes, Tuple[str, int]] = {}  #: guarded by self._lock
+        self._spill_stats = {"spills": 0, "restores": 0,
+                             "spilled_bytes": 0, "restored_bytes": 0,
+                             "spill_skipped_pinned": 0,
+                             "restore_failed": 0}  #: guarded by self._lock
+        self._spilled_total = 0     #: guarded by self._lock
         self._shm = None
         if sweep:
             # stale-segment hygiene: a SIGKILL'd predecessor daemon of
@@ -281,13 +301,24 @@ class ObjectTable:
 
     def put(self, oid: bytes, blob: bytes) -> None:
         if self._shm is not None and len(blob) > INLINE_RESULT:
-            try:
-                self._shm.put(oid, blob, pin=True)
-                return
-            except KeyError:
-                return  # already stored (idempotent retry)
-            except Exception:
-                pass  # arena full → dict
+            if self.spill_dir is not None:
+                with self._lock:
+                    if oid in self._spilled:
+                        return  # already stored, parked on disk
+            for attempt in range(2):
+                try:
+                    self._shm.put(oid, blob, pin=True)
+                    with self._lock:
+                        self._entries[oid] = len(blob)
+                        self._entries.move_to_end(oid)
+                    return
+                except KeyError:
+                    return  # already stored (idempotent retry)
+                except Exception:
+                    # arena full: spill cold entries once, then retry;
+                    # still full (or spilling disarmed) → dict fallback
+                    if attempt or not self.spill_for(len(blob)):
+                        break
         with self._lock:
             self._small[oid] = blob
 
@@ -297,9 +328,15 @@ class ObjectTable:
         if blob is not None:
             return blob
         if self._shm is not None:
+            if not self._maybe_restore(oid):
+                # restore failed (arena still full / failpoint): serve
+                # the bytes straight off the spill file — a read must
+                # degrade to a disk read, never to a miss
+                return self._read_spilled(oid)
             try:
                 view = self._shm.get_view(oid)  # increfs
                 try:
+                    self._touch(oid)
                     return view.tobytes()
                 finally:
                     self._shm.release(oid)
@@ -311,10 +348,12 @@ class ObjectTable:
         """(arena, capacity, off, size) with a held ref, or None."""
         if self._shm is None:
             return None
+        self._maybe_restore(oid)
         try:
             off, size = self._shm.get_ref(oid)
         except KeyError:
             return None
+        self._touch(oid)
         return (self.arena_name, self.capacity, off, size)
 
     def get_ext_meta(self, oid: bytes, client_id: str = UNKNOWN_CLIENT):
@@ -327,6 +366,7 @@ class ObjectTable:
         whose holder is not yet recorded."""
         if self._shm is None:
             return None
+        self._maybe_restore(oid)
         with self._lock:
             try:
                 off, size, slot = self._shm.get_ext(oid)
@@ -335,6 +375,8 @@ class ObjectTable:
             grants = self._ext_slots.setdefault(client_id, {})
             grants[slot] = grants.get(slot, 0) + 1
             self._slot_owners[slot] = oid
+            if oid in self._entries:
+                self._entries.move_to_end(oid)
         return (self.arena_name, self.capacity, off, size, slot)
 
     def ext_release(self, slot: int, client_id: Optional[str] = None
@@ -525,6 +567,242 @@ class ObjectTable:
         with self._lock:
             return self._raw.get(key)
 
+    # -- arena spill tier (docs/object_plane.md "Arena spill") -----------
+    # Cold, sealed, UNPINNED entries move to disk files under occupancy
+    # pressure and restore on demand on every read path. A live external
+    # slot ref (PR 16 grant ledger) pins an entry unspillable — a held
+    # zero-copy view must never lose its backing bytes. Disarmed
+    # (spill_dir None) every hook below is a None-check no-op.
+
+    def _touch(self, key: bytes) -> None:
+        """LRU maintenance on read grants (spill picks oldest first)."""
+        if self.spill_dir is None:
+            return
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+
+    def _spill_path(self, key: bytes) -> str:
+        return os.path.join(self.spill_dir, key.hex() + ".spill")
+
+    def _pinned_now(self) -> set:
+        """Keys unspillable RIGHT NOW: an outstanding external slot ref
+        means some process still maps the bytes as a zero-copy view.
+        Caller holds self._lock (grants commit under the same lock, so
+        the set cannot go stale mid-pass)."""
+        pinned = set()
+        for slot, oid in self._slot_owners.items():  # raylint: disable=guarded-by — caller holds self._lock
+            try:
+                if int(self._shm.ext_refs(slot)) > 0:
+                    pinned.add(oid)
+            except Exception:
+                pinned.add(oid)     # unreadable slot: keep it safe
+        return pinned
+
+    def _spill_one_locked(self, key: bytes, size: int) -> bool:
+        """Spill ONE sealed entry. Caller holds self._lock and has
+        checked the pin set. The write goes to a temp file renamed into
+        place, and arena bytes free through the native deferred-delete/
+        reap path — a reader that raced past the restore check keeps a
+        valid (deferred) mapping and re-reads from disk next time."""
+        if key in self._spilled or key not in self._entries:  # raylint: disable=guarded-by — caller holds self._lock
+            return True     # idempotent: already parked / already gone
+        if _fp.ENABLED:
+            # drop/error arm = this spill attempt fails; the entry
+            # stays resident at tier host-shm and a later pass retries
+            try:
+                if _fp.fire("arena.spill", key=key.hex()[:16],
+                            nbytes=size) is _fp.DROP:
+                    return False
+            except Exception:
+                return False
+        try:
+            view = self._shm.get_view(key)      # increfs
+            try:
+                data = view.tobytes()
+            finally:
+                self._shm.release(key)
+        except Exception:
+            return False
+        path = self._spill_path(key)
+        try:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:
+                fh.write(data)
+            os.replace(tmp, path)   # readers never see a torn file
+        except OSError:
+            return False
+        self._spilled[key] = (path, len(data))  # raylint: disable=guarded-by — caller holds self._lock
+        self._spilled_total += len(data)  # raylint: disable=guarded-by — caller holds self._lock
+        self._entries.pop(key, None)  # raylint: disable=guarded-by — caller holds self._lock
+        try:
+            self._shm.delete(key)   # frees now, or defers until refs drop
+        except Exception:
+            pass
+        self._spill_stats["spills"] += 1
+        self._spill_stats["spilled_bytes"] += len(data)
+        from ray_tpu.objectplane.tiers import count_spilled_bytes
+        count_spilled_bytes(len(data))
+        return True
+
+    def _spill_pass_locked(self, need_bytes: Optional[int] = None,
+                           floor_bytes: Optional[int] = None,
+                           max_entries: int = 64,
+                           exclude: tuple = ()) -> int:
+        """Shared spill loop (caller holds self._lock): LRU-first until
+        ``need_bytes`` of room exists / occupancy reaches
+        ``floor_bytes`` / the per-pass entry bound or the spill-dir
+        budget stops it. Returns entries spilled."""
+        spilled = 0
+        pinned = self._pinned_now()
+        for key in list(self._entries):  # raylint: disable=guarded-by — caller holds self._lock
+            if spilled >= max_entries:
+                break
+            used = self._shm.used_bytes()
+            if need_bytes is not None and \
+                    self.capacity - used >= need_bytes:
+                break
+            if floor_bytes is not None and used <= floor_bytes:
+                break
+            size = self._entries[key]  # raylint: disable=guarded-by — caller holds self._lock
+            if key in exclude:
+                continue
+            if key in pinned:
+                self._spill_stats["spill_skipped_pinned"] += 1
+                continue
+            if self.spill_budget and (self._spilled_total + size  # raylint: disable=guarded-by — caller holds self._lock
+                                      > self.spill_budget):
+                break       # disk budget exhausted: pressure goes hard
+            if self._spill_one_locked(key, size):
+                spilled += 1
+        if spilled:
+            try:
+                self._shm.reap()
+            except Exception:
+                pass
+        return spilled
+
+    def spill_for(self, nbytes: int) -> bool:
+        """Make ``nbytes`` of arena room by spilling cold entries; the
+        put/reserve paths call this instead of failing over to the
+        blob/dict path while cold data hogs the arena. False = spilling
+        disarmed or not enough unpinned cold bytes."""
+        if self.spill_dir is None or self._shm is None:
+            return False
+        with self._lock:
+            self._spill_pass_locked(need_bytes=nbytes)
+            return self.capacity - self._shm.used_bytes() >= nbytes
+
+    def spill_to_fraction(self, target: float) -> int:
+        """Proactive pressure-tick pass: bring occupancy down to the
+        ``target`` fraction of capacity (soft watermark), oldest first,
+        bounded per call so a tick stays short."""
+        if self.spill_dir is None or self._shm is None:
+            return 0
+        with self._lock:
+            return self._spill_pass_locked(
+                floor_bytes=int(self.capacity * max(0.0, target)))
+
+    def _maybe_restore(self, key: bytes) -> bool:
+        """True when ``key`` is resident (nothing to do) or was
+        restored; False when it is spilled and the restore failed —
+        the caller degrades to a direct disk read."""
+        if self.spill_dir is None:
+            return True
+        with self._lock:
+            if key not in self._spilled:
+                return True
+        return self.restore(key)
+
+    def restore(self, key: bytes) -> bool:
+        """Bring a spilled entry back into the arena (tier spilled ->
+        host-shm). Idempotent: a retried/concurrent restore finds the
+        entry resident and reports success. The spill file is consumed
+        only AFTER the arena copy lands — a failed attempt (failpoint
+        arm, arena full) leaves the file intact for the next try."""
+        if self._shm is None or self.spill_dir is None:
+            return False
+        done_bytes = 0
+        with self._lock:
+            spilled = self._spilled.get(key)
+            if spilled is None:
+                return True     # already resident (idempotent)
+            path, size = spilled
+            if _fp.ENABLED:
+                # drop/error arm = this restore attempt fails; the read
+                # path serves the spill file directly and retries later
+                try:
+                    if _fp.fire("arena.restore", key=key.hex()[:16],
+                                nbytes=size) is _fp.DROP:
+                        self._spill_stats["restore_failed"] += 1
+                        return False
+                except Exception:
+                    self._spill_stats["restore_failed"] += 1
+                    return False
+            try:
+                with open(path, "rb") as fh:
+                    data = fh.read()
+            except OSError:
+                self._spill_stats["restore_failed"] += 1
+                return False
+            try:
+                self._shm.put(key, data, pin=True)
+            except KeyError:
+                pass    # resident already (deferred twin / lost race)
+            except Exception:
+                # arena full: make room off colder entries, retry once
+                # BEFORE consuming the spill file (the PR 5 object-store
+                # lesson: pressure scan precedes the file delete)
+                self._spill_pass_locked(need_bytes=len(data),
+                                        exclude=(key,))
+                try:
+                    self._shm.put(key, data, pin=True)
+                except KeyError:
+                    pass
+                except Exception:
+                    self._spill_stats["restore_failed"] += 1
+                    return False
+            self._spilled.pop(key, None)
+            self._spilled_total -= size
+            self._entries[key] = len(data)
+            self._entries.move_to_end(key)
+            self._spill_stats["restores"] += 1
+            self._spill_stats["restored_bytes"] += len(data)
+            done_bytes = len(data)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        from ray_tpu.objectplane.tiers import count_restored_bytes
+        count_restored_bytes(done_bytes)
+        return True
+
+    def _read_spilled(self, key: bytes) -> Optional[bytes]:
+        """Serve a spilled entry's bytes straight off its file (restore
+        failed or lost a race with a spill pass) — reads degrade to
+        disk, never to a miss."""
+        with self._lock:
+            spilled = self._spilled.get(key)
+        if spilled is None:
+            return None
+        try:
+            with open(spilled[0], "rb") as fh:
+                return fh.read()
+        except OSError:
+            return None
+
+    def spilled_bytes(self) -> int:
+        with self._lock:
+            return self._spilled_total
+
+    def spill_stats(self) -> Dict[str, int]:
+        with self._lock:
+            out = dict(self._spill_stats)
+            out["spilled_now_bytes"] = self._spilled_total
+            out["spilled_now_count"] = len(self._spilled)
+        return out
+
     # -- direct-put (reserve + client write + seal) ----------------------
     def reserve(self, key: bytes, size: int,
                 client_id: str = UNKNOWN_CLIENT) -> Optional[int]:
@@ -539,7 +817,17 @@ class ObjectTable:
         from ray_tpu.native_store import ShmStoreFull
         try:
             off = self._shm.reserve(key, size)
-        except (ShmStoreFull, KeyError):
+        except ShmStoreFull:
+            # spill cold entries to make room, then retry ONCE — a
+            # direct put keeps succeeding in place instead of falling
+            # back to the blob path while cold data hogs the arena
+            if not self.spill_for(size):
+                return None
+            try:
+                off = self._shm.reserve(key, size)
+            except (ShmStoreFull, KeyError):
+                return None
+        except KeyError:
             return None
         with self._lock:
             self._reservations[key] = (client_id, time.monotonic())
@@ -554,8 +842,14 @@ class ObjectTable:
             self._shm.seal(key, pin=True)
         except KeyError:
             return False
+        try:
+            _off, size, _sealed = self._shm.stat(key)
+        except Exception:
+            size = 0
         with self._lock:
             self._reservations.pop(key, None)
+            self._entries[key] = size
+            self._entries.move_to_end(key)
         self.register_oid(ref, key, raw=raw)
         return True
 
@@ -576,13 +870,17 @@ class ObjectTable:
 
     def contains(self, oid: bytes) -> bool:
         with self._lock:
-            if oid in self._small:
+            if oid in self._small or oid in self._spilled:
                 return True
         return self._shm is not None and self._shm.contains(oid)
 
     def nbytes_of(self, oid: bytes) -> Optional[int]:
         with self._lock:
             blob = self._small.get(oid)
+            if blob is None:
+                spilled = self._spilled.get(oid)
+                if spilled is not None:
+                    return spilled[1]   # size answered without restore
         if blob is not None:
             return len(blob)
         if self._shm is not None:
@@ -603,9 +901,15 @@ class ObjectTable:
         if blob is not None:
             return blob[off:off + size]
         if self._shm is not None:
+            if not self._maybe_restore(oid):
+                # arena still full: chunk straight off the spill file
+                # so outbound push/pull never depends on arena room
+                blob = self._read_spilled(oid)
+                return None if blob is None else blob[off:off + size]
             try:
                 view = self._shm.get_view(oid)  # increfs
                 try:
+                    self._touch(oid)
                     return bytes(view[off:off + size])
                 finally:
                     self._shm.release(oid)
@@ -614,13 +918,24 @@ class ObjectTable:
         return None
 
     def delete(self, oid: bytes) -> None:
+        spill_path = None
         with self._lock:
             self._small.pop(oid, None)
             self._raw.pop(oid, None)
             self._reservations.pop(oid, None)
+            self._entries.pop(oid, None)
+            spilled = self._spilled.pop(oid, None)
+            if spilled is not None:
+                spill_path = spilled[0]
+                self._spilled_total -= spilled[1]
             ref = self._ref_of.pop(oid, None)
             if ref is not None:
                 self._by_oid.pop(ref, None)
+        if spill_path is not None:
+            try:
+                os.unlink(spill_path)
+            except OSError:
+                pass
         if self._shm is not None:
             try:
                 # an aborted direct put leaves an UNSEALED entry whose
@@ -1096,12 +1411,21 @@ class DaemonService:
         # driver disconnects and serve the next driver; False (driver-
         # spawned session): die with the driver.
         self.persist = persist
-        self.objects = ObjectTable(f"rtpu_{node_id_hex[:12]}",
-                                   object_store_bytes)
+        from ray_tpu._private.config import cfg as _cfg
+        # Spill armed only under the memory_pressure master switch: a
+        # disarmed table keeps every hook a None-check no-op
+        # (zero-overhead-when-off, the netchaos discipline).
+        spill_dir = None
+        if _cfg().memory_pressure:
+            spill_dir = (_cfg().arena_spill_dir
+                         or os.path.join("/tmp", f"rtpu_spill_{node_id_hex[:12]}"))
+        self.objects = ObjectTable(
+            f"rtpu_{node_id_hex[:12]}", object_store_bytes,
+            spill_dir=spill_dir,
+            spill_budget=int(_cfg().arena_spill_budget_bytes))
         # Hand the arena to every worker this daemon spawns (the
         # worker-hello leg of the zero-copy plane): workers attach the
         # segment by name and resolve host-tier objects in place.
-        from ray_tpu._private.config import cfg as _cfg
         if self.objects._shm is not None and _cfg().objectplane_attach:
             from ray_tpu._private import worker_process as _wp
             _wp.set_arena_info(self.objects.arena_name,
@@ -1126,6 +1450,14 @@ class DaemonService:
         self._lease_seq = 0                        #: guarded by self._lock
         # task_id hex -> (client, worker rid) for cancel/gen_ack
         self._task_rids: Dict[str, Tuple[Any, str]] = {}  #: guarded by self._lock
+        # task_id hex -> job hex: OOM-preemption attribution (the
+        # tenant-aware policy prefers over-quota jobs' workers); pruned
+        # against _task_rids in _memory_candidates
+        self._task_jobs: Dict[str, str] = {}       #: guarded by self._lock
+        # node memory-pressure level, advertised through heartbeats/
+        # syncer gossip and pushed to the driver on transitions; stays
+        # "ok" forever when cfg().memory_pressure is off
+        self.pressure: Optional[Any] = None        # PressureController
         # batched-submit dedupe, keyed (task hex, attempt): a retried
         # push_task_batch frame must not double-execute — running tasks
         # are skipped, finished ones get their recorded outcome resent;
@@ -1840,6 +2172,10 @@ class DaemonService:
                 })
                 with self._lock:
                     self._task_rids[task_hex] = (client, wrid)
+                    if spec.job_id is not None:
+                        # job attribution for tenant-aware OOM
+                        # preemption (pruned in _memory_candidates)
+                        self._task_jobs[task_hex] = spec.job_id.hex()
                 outcome = client._wait_outcome(wrid, pend)
             except WorkerCrashed as e:
                 client.kill(expected=False)
@@ -1970,6 +2306,8 @@ class DaemonService:
                 })
                 with self._lock:
                     self._task_rids[task_hex] = (client, wrid)
+                    if spec.job_id is not None:
+                        self._task_jobs[task_hex] = spec.job_id.hex()
                 outcome = client._wait_outcome(wrid, pend)
             except WorkerCrashed as e:
                 with self._lock:
@@ -2061,6 +2399,11 @@ class DaemonService:
                 obj.ext_release(slot, cid)
             return True
         if call == "shm_put_reserve":
+            if self.pressure_level() == "hard":
+                # shed NEW arena writes while hard-pressured; the
+                # worker falls back to its classic put path (service
+                # degrades to a payload round trip, never to an error)
+                return {"full": True, "backpressure": True}
             off = obj.reserve(kw["key"], int(kw["size"]),
                               self._worker_client_id(client))
             if off is None:
@@ -2075,6 +2418,10 @@ class DaemonService:
         raise ValueError(f"unknown shm op {call!r}")
 
     def handle_put_object(self, conn, rid, msg):
+        if self.pressure_level() == "hard":
+            # typed retriable backpressure: the driver raises
+            # MemoryPressureError and rides RetryPolicy until relief
+            return {"backpressure": True, "level": "hard"}
         self.objects.put(msg["oid"], msg["blob"])
         key = msg["oid"]
         if key.startswith(b"put:"):
@@ -2101,6 +2448,8 @@ class DaemonService:
         """Reserve arena space for a same-host client's direct put (the
         client writes the payload through its own mapping, then
         seal_object). Idempotent for a retried (oid, size)."""
+        if self.pressure_level() == "hard":
+            return {"full": True, "backpressure": True}
         off = self.objects.reserve(msg["oid"], int(msg["size"]),
                                    self._conn_client_id(conn))
         if off is None:
@@ -2445,6 +2794,10 @@ class DaemonService:
             + fast.get("queued", 0),
             "store_used": self.objects.used_bytes(),
             "fast_queued": fast.get("queued", 0),
+            # pressure level rides the load view so every driver's
+            # pick_node can soft-exclude hard-pressure nodes even when
+            # it never heard the direct node_pressure push
+            "pressure": self.pressure_level(),
         }
 
     def _syncer_tick(self) -> None:
@@ -2554,6 +2907,11 @@ class DaemonService:
         out = []
         with self._lock:
             running = dict(self._task_rids)
+            # prune finished tasks' job attributions here (the one
+            # periodic scan) instead of chasing every pop site
+            for gone in set(self._task_jobs) - set(running):
+                self._task_jobs.pop(gone, None)
+            jobs = dict(self._task_jobs)
         router = self.runtime.process_router
         with router._lock:
             actors = dict(router._actor_workers)
@@ -2562,7 +2920,8 @@ class DaemonService:
             if client.alive() and client.proc.pid not in actor_pids:
                 out.append(_Candidate(
                     client.proc.pid, "task", task_id=task_hex,
-                    retriable=True, started_at=0.0, owner_key=""))
+                    retriable=True, started_at=0.0,
+                    owner_key=jobs.get(task_hex, "")))
         for actor_id, client in actors.items():
             if client.alive():
                 out.append(_Candidate(
@@ -2577,12 +2936,34 @@ class DaemonService:
 
     def start_memory_monitor(self) -> None:
         from ray_tpu._private.config import cfg
-        from ray_tpu._private.memory_monitor import MemoryMonitor
-        if not cfg().memory_monitor:
-            return
-        self.memory_monitor = MemoryMonitor(
-            None, candidates_fn=self._memory_candidates)
-        self.memory_monitor.start()
+        from ray_tpu._private.memory_monitor import (MemoryMonitor,
+                                                     TenantAwarePolicy)
+        if cfg().memory_monitor:
+            self.memory_monitor = MemoryMonitor(
+                None, candidates_fn=self._memory_candidates)
+            if cfg().memory_pressure:
+                # degradation order under pressure: over-quota tenants'
+                # workers (driver-ledger verdict, synced) die first
+                self.memory_monitor.policy = TenantAwarePolicy(
+                    self.memory_monitor.policy,
+                    lambda: getattr(self, "_over_quota_jobs", ()))
+            self.memory_monitor.start()
+        if cfg().memory_pressure:
+            from ray_tpu._private.pressure import PressureController
+            self.pressure = PressureController(
+                self.objects,
+                monitor=getattr(self, "memory_monitor", None),
+                on_level=self._on_pressure_level)
+            self.pressure.start()
+
+    def pressure_level(self) -> str:
+        return self.pressure.level if self.pressure is not None else "ok"
+
+    def _on_pressure_level(self, old: str, new: str) -> None:
+        """Pressure transition: tell the driver immediately (placement
+        reacts now, not at the next gossip round) — the same push lane
+        DRAINING uses. Gossip/heartbeats carry it to everyone else."""
+        self.notify_driver("node_pressure", level=new)
 
     def handle_set_memory_limit(self, conn, rid, msg):
         """Driver-pushed cluster-wide limit; starts this node's monitor
@@ -2722,6 +3103,21 @@ class DaemonService:
         _nc.activate(spec, seed=int(seed) if seed is not None else None)
         return {"ok": True, "active": True, "links": _nc.describe()}
 
+    def handle_fail_points(self, conn, rid, msg):
+        """Chaos-campaign hook, the failpoint twin of ``net_chaos``:
+        install (or clear, with an empty spec) a seeded failpoint
+        registry in THIS daemon process. Programmatic per-node arming —
+        the env form reaches every spawned process, so a schedule that
+        must pressure ONE node (``pressure.level=return(hard)``) arms
+        it here instead."""
+        spec = msg.get("spec") or ""
+        if not spec:
+            _fp.reset()
+            return {"ok": True, "active": False}
+        seed = msg.get("seed")
+        _fp.activate(spec, seed=int(seed) if seed is not None else None)
+        return {"ok": True, "active": True, "arms": _fp.describe()}
+
     def handle_tenancy_sync(self, conn, rid, msg):
         """Adopt the driver's per-job quota/weight table. The daemon is
         not the admission authority (dispatch gating runs driver-side,
@@ -2730,6 +3126,10 @@ class DaemonService:
         when the driver is gone, and daemon_stats can show it."""
         jobs = msg.get("jobs") or {}
         self._tenancy_jobs = {str(j): dict(r) for j, r in jobs.items()}
+        # over-quota jobs (driver ledger verdict): the memory monitor's
+        # tenant-aware policy preempts these jobs' workers first
+        self._over_quota_jobs = {str(j)
+                                 for j in (msg.get("over_quota") or ())}
         for job, rec in self._tenancy_jobs.items():
             for res, cap in (rec.get("quota") or {}).get(
                     "hard", {}).items():
@@ -2760,6 +3160,8 @@ class DaemonService:
                 "slot_refs": self.slot_ref_attribution(),
                 "fast_lane": fast,
                 "agent_port": getattr(self, "agent_port", None),
+                "pressure": self.pressure_level(),
+                "spill": self.objects.spill_stats(),
                 "actors": len(
                     self.runtime.process_router._actor_workers)}
 
@@ -3011,6 +3413,8 @@ def main() -> None:
             service.push_rx.sweep()
             _tiers.publish_tier_bytes(_tiers.TIER_HOST,
                                       service.objects.used_bytes())
+            _tiers.publish_tier_bytes(_tiers.TIER_SPILLED,
+                                      service.objects.spilled_bytes())
             _publish_object_plane_metrics(service)
         except Exception:
             pass

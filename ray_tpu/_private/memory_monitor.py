@@ -156,6 +156,38 @@ class GroupByOwnerPolicy:
         return max(pool, key=lambda c: c.started_at)
 
 
+class TenantAwarePolicy:
+    """Point preemption at over-quota tenants first (the graceful-
+    degradation tier of docs/fault_tolerance.md "Memory pressure"):
+    when the driver's fair-share ledger marks jobs at/over a hard cap
+    (synced to daemons via ``tenancy_sync``), their workers are
+    preferred victims; the wrapped policy still orders WITHIN the
+    preferred pool, and the full pool backstops when no over-quota
+    worker runs here. ``last_reason`` feeds the
+    ``ray_tpu_oom_preemptions_total{reason}`` counter."""
+
+    def __init__(self, inner: Any, over_quota_fn: Any):
+        self.inner = inner
+        self.over_quota_fn = over_quota_fn
+        self.last_reason = "host"
+
+    def pick(self, candidates: List[_Candidate]) -> Optional[_Candidate]:
+        over = set()
+        try:
+            over = set(self.over_quota_fn() or ())
+        except Exception:
+            pass
+        if over:
+            preferred = [c for c in candidates if c.owner_key in over]
+            if preferred:
+                victim = self.inner.pick(preferred)
+                if victim is not None:
+                    self.last_reason = "tenant_quota"
+                    return victim
+        self.last_reason = "host"
+        return self.inner.pick(candidates)
+
+
 class MemoryMonitor:
     """Samples driver+worker RSS; on threshold breach kills one worker
     process per tick using the configured policy."""
@@ -291,6 +323,12 @@ class MemoryMonitor:
             self.oom_killed_tasks.add(victim.task_id)
         if victim.actor_id is not None:
             self.oom_killed_actors.add(victim.actor_id)
+        try:
+            from ray_tpu._private.pressure import count_oom_preemption
+            count_oom_preemption(
+                getattr(self.policy, "last_reason", "host") or "host")
+        except Exception:
+            pass
         try:
             os.kill(victim.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):

@@ -492,6 +492,10 @@ class Node:
         # no new placements; the dispatch loop hands queued-but-
         # unstarted tasks back to the runtime for resubmission elsewhere.
         self.draining = False
+        # Node memory-pressure level ("ok"/"soft"/"hard"), mirrored
+        # from daemon node_pressure pushes; pick_node soft-excludes
+        # "hard" nodes the way it soft-excludes DRAINING ones.
+        self.pressure_level = "ok"
         self.actors: Dict[ActorID, ActorExecutor] = {}  #: guarded by self._actors_lock
         self._actors_lock = tracked_lock("node.actors", reentrant=False)
         self._queue: "queue.Queue[Optional[TaskSpec]]" = queue.Queue()

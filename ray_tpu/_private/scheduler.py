@@ -56,6 +56,17 @@ class SchedulingError(Exception):
     """Task is infeasible: no alive node can ever satisfy it."""
 
 
+def _soft_excluded(n: Any) -> bool:
+    """Alive but taking no NEW placements: DRAINING (graceful
+    preemption, PR 2) or HARD memory pressure (the node is shedding
+    load — docs/fault_tolerance.md "Memory pressure & graceful
+    degradation"). Both are soft: when every alive node is excluded,
+    callers fall back to them — running somewhere beats failing a
+    feasible demand."""
+    return bool(getattr(n, "draining", False)
+                or getattr(n, "pressure_level", "ok") == "hard")
+
+
 _INFEASIBLE = object()      # negative-cache sentinel
 _FEAS_CACHE_MAX = 512       # distinct resource shapes per epoch
 
@@ -101,12 +112,11 @@ class ClusterScheduler:
         alive = [n for n in nodes if n.alive]
         if not alive:
             raise SchedulingError("no alive nodes in cluster")
-        # DRAINING nodes take no NEW placements while their running work
-        # finishes (graceful preemption). When every alive node is
-        # draining, fall back to them — running the task somewhere beats
-        # failing a feasible demand.
-        schedulable = [n for n in alive
-                       if not getattr(n, "draining", False)] or alive
+        # DRAINING and HARD-pressure nodes take no NEW placements while
+        # their running work finishes / pressure relieves. When every
+        # alive node is excluded, fall back to them — running the task
+        # somewhere beats failing a feasible demand.
+        schedulable = [n for n in alive if not _soft_excluded(n)] or alive
 
         if isinstance(strategy, PlacementGroupSchedulingStrategy):
             return self._pick_pg(spec, strategy, alive)
@@ -120,7 +130,7 @@ class ClusterScheduler:
             if not alive:
                 raise SchedulingError("no node matches label selector")
             schedulable = [n for n in alive
-                           if not getattr(n, "draining", False)] or alive
+                           if not _soft_excluded(n)] or alive
             strategy = "DEFAULT"
 
         feasible = self._compute_feasible(spec, alive, schedulable)
@@ -165,23 +175,23 @@ class ClusterScheduler:
             # re-check makes a missed bump degrade to a recompute
             # instead of a placement on a dead/draining node
             live = [n for n in entry
-                    if n.alive and not getattr(n, "draining", False)]
+                    if n.alive and not _soft_excluded(n)]
             if len(live) == len(entry):
                 return entry
         alive = [n for n in nodes if n.alive]
         if not alive:
             raise SchedulingError("no alive nodes in cluster")
         schedulable = [n for n in alive
-                       if not getattr(n, "draining", False)] or alive
+                       if not _soft_excluded(n)] or alive
         try:
             feasible = self._compute_feasible(spec, alive, schedulable)
         except SchedulingError:
             self._feas_store(epoch, key, _INFEASIBLE)
             raise
-        # only cache clean candidate sets: a draining-fallback pick must
-        # re-evaluate per task (the fallback is a last resort, not a
-        # steady state)
-        if all(not getattr(n, "draining", False) for n in feasible):
+        # only cache clean candidate sets: a draining-/pressure-
+        # fallback pick must re-evaluate per task (the fallback is a
+        # last resort, not a steady state)
+        if all(not _soft_excluded(n) for n in feasible):
             self._feas_store(epoch, key, feasible)
         return feasible
 

@@ -374,6 +374,7 @@ class Runtime:
         if self.tenancy.enabled:
             node.tenancy = self.tenancy
         node.daemon = handle
+        handle.runtime = self   # node_pressure pushes resolve the Node
         # proactive dep staging: enqueue-time pushes overlap the
         # transfer with the task's queue wait (PushManager dedupes)
         node.prefetch = (lambda spec, _node=node:
@@ -398,6 +399,11 @@ class Runtime:
             return
         from ray_tpu._private.config import cfg
         if not cfg().push_prefetch:
+            return
+        if getattr(node, "pressure_level", "ok") != "ok":
+            # soft/hard memory pressure: stop staging optional copies
+            # onto the node — the demand pull path still serves the
+            # task's args when it actually runs (pressure.py)
             return
         with self._loc_lock:
             locs = {dep: list(self._locations.get(dep, ()))

@@ -122,6 +122,15 @@ class OutOfMemoryError(RayTpuError):
     """Object store or host memory exhausted."""
 
 
+class MemoryPressureError(RayTpuError):
+    """A node under HARD memory pressure rejected a new object
+    reservation or put (docs/fault_tolerance.md "Memory pressure &
+    graceful degradation"). Retriable backpressure signal: the node's
+    PressureController is spilling / the memory monitor is preempting,
+    so capacity returns — callers ride :class:`RetryPolicy` until the
+    level drops, and only then surface the error."""
+
+
 class PlacementGroupUnschedulableError(RayTpuError):
     """The placement group cannot fit in the cluster."""
 

@@ -58,6 +58,30 @@ def count_grants_reclaimed(n: int, reason: str) -> None:
         pass    # metrics must never fail the data path
 
 
+def count_spilled_bytes(n: int) -> None:
+    """The daemon spilled ``n`` bytes of cold, sealed, unpinned arena
+    entries to disk under memory pressure (tier host-shm -> spilled)."""
+    try:
+        from ray_tpu.util.metrics import Counter
+        Counter("ray_tpu_arena_spilled_bytes_total",
+                "host-shm arena bytes spilled to disk under memory "
+                "pressure").inc(n)
+    except Exception:
+        pass    # metrics must never fail the data path
+
+
+def count_restored_bytes(n: int) -> None:
+    """A read path restored ``n`` spilled bytes back into the arena
+    (tier spilled -> host-shm)."""
+    try:
+        from ray_tpu.util.metrics import Counter
+        Counter("ray_tpu_arena_restored_bytes_total",
+                "spilled arena bytes restored into the arena on "
+                "demand").inc(n)
+    except Exception:
+        pass    # metrics must never fail the data path
+
+
 def count_stale_reservations(n: int = 1) -> None:
     """The orphan sweep aborted ``n`` direct-put reservations whose
     writer died between reserve and seal (bytes un-stranded)."""

@@ -360,14 +360,19 @@ class TenancyManager:
                 head.tenancy_set(job, rec)
             if report_due:
                 head.tenancy_report(self.jobs_view())
-            if dirty:
+            if dirty or (report_due and self.ledger.any_caps()):
                 table = {}
                 with self._lock:
                     table = {j: dict(r)
                              for j, r in self._records.items()}
+                # over-quota jobs ride along so node memory monitors
+                # can point OOM preemption at them first (pressure.py
+                # TenantAwarePolicy — only meaningful once caps exist)
+                over = [j for j in table if self.ledger.at_hard_cap(j)]
                 for handle in getattr(backend, "daemons", {}).values():
                     if getattr(handle, "_tenancy_supported", False):
-                        handle.client.call("tenancy_sync", jobs=table)
+                        handle.client.call("tenancy_sync", jobs=table,
+                                           over_quota=over)
         except Exception:
             return   # still dirty; retried next tick
         with self._lock:

@@ -1069,3 +1069,301 @@ def test_netchaos_partition_during_graceful_drain(seed):
     finally:
         nc.reset()
         ray_tpu.shutdown()
+
+# ---------------------------------------------------------------------------
+# memory pressure: graceful degradation under OOM chaos
+# (docs/fault_tolerance.md "Memory pressure & graceful degradation").
+# Ballast scenarios — worker host-memory ballast, arena overfill, and
+# both at once — assert the degradation ladder end to end: zero lost
+# tasks, spill/restore counters rise, a held zero-copy view is NEVER
+# spilled out from under its reader, slot refs return to zero, and the
+# node converges back to level ok after relief. The run_chaos.sh
+# `memory` tier sweeps these over both driver topologies.
+# ---------------------------------------------------------------------------
+
+def _wait_pressure(handle, level, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        last = handle.client.call("daemon_stats")["pressure"]
+        if last == level:
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"pressure stuck at {last!r}, wanted {level!r}")
+
+
+def _spill_stats(handle):
+    return handle.client.call("daemon_stats")["spill"]
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_chaos_memory_arena_fill_spills_restores_pins_hold(seed,
+                                                           tmp_path):
+    """Arena overfill ballast: blob puts far past the arena's capacity
+    all land (spill_for makes room off cold entries instead of failing
+    over), every byte reads back exactly (restore on demand), the entry
+    under a HELD zero-copy view is never spilled, and after relief the
+    grants reclaim to zero and the node returns to level ok."""
+    import numpy as np
+
+    os.environ["RAY_TPU_MEMORY_PRESSURE"] = "1"
+    os.environ["RAY_TPU_PRESSURE_TICK_S"] = "0.1"
+    os.environ["RAY_TPU_ARENA_SPILL_DIR"] = str(tmp_path)
+    try:
+        rt = ray_tpu.init(num_nodes=1, resources={"CPU": 4},
+                          cluster="daemons",
+                          object_store_memory=4 * 1024 * 1024)
+        try:
+            handle = _first_daemon(rt)
+            _needs_arena(handle)
+            daemon_pid = handle.proc.pid
+
+            # a worker-produced raw-tier entry, pinned by a held view:
+            # it must survive every spill pass bit-for-bit
+            @ray_tpu.remote
+            def produce(n):
+                return np.arange(n, dtype=np.float64)
+
+            ref = produce.remote(96 * 1024)             # 768 KiB
+
+            @ray_tpu.remote
+            class Holder:
+                def hold(self, refs):
+                    self.view = ray_tpu.get(refs)[0]
+                    return float(self.view[7])
+
+                def check(self):
+                    return float(self.view[7]), float(self.view[-1])
+
+                def drop(self):
+                    del self.view
+                    return True
+
+            h = Holder.remote()
+            assert ray_tpu.get(h.hold.remote([ref]), timeout=60) == 7.0
+            assert _slot_refs(handle)["refs"] >= 1
+
+            # ballast: 8 MiB of puts through a 4 MiB arena — every one
+            # must land (spill_for + the typed-backpressure retry ride)
+            from ray_tpu.exceptions import MemoryPressureError
+            rng = __import__("random").Random(seed)
+            blobs = {}
+            for i in range(8):
+                key = b"chaos:mem:%d:%d" % (seed, i)
+                blobs[key] = bytes([rng.randrange(256)]) * (1 << 20)
+                RetryPolicy.default(deadline_s=60.0).run(
+                    lambda k=key: handle.put_object_blob(k, blobs[k]),
+                    loop="chaos.mem_put",
+                    retry_on=(MemoryPressureError,))
+            stats = _spill_stats(handle)
+            assert stats["spills"] >= 1, stats
+            assert stats["spilled_now_bytes"] > 0, stats
+            # the pass walked past the pinned entry, never spilled it
+            assert stats["spill_skipped_pinned"] >= 1, stats
+
+            # reads never miss: every ballast byte restores (or serves
+            # off its spill file) exactly
+            for key, blob in blobs.items():
+                got = handle.get_object_blob(key)
+                assert got == blob, f"{key} corrupted"
+            assert _spill_stats(handle)["restores"] >= 1
+
+            # the held view stayed valid AND exact through the storm
+            v7, vlast = ray_tpu.get(h.check.remote(), timeout=60)
+            assert (v7, vlast) == (7.0, float(96 * 1024 - 1))
+
+            # relief: drop the view + ballast, grants reclaim to zero,
+            # the level converges back to ok, the daemon never restarted
+            assert ray_tpu.get(h.drop.remote(), timeout=60) is True
+            del ref
+            import gc
+            gc.collect()
+            handle.flush_frees()
+            handle.free_objects(list(blobs))
+            _wait_refs_zero(handle)
+            _wait_pressure(handle, "ok")
+            assert handle.proc.poll() is None
+            assert handle.proc.pid == daemon_pid
+
+            @ray_tpu.remote
+            def ping():
+                return "up"
+
+            assert ray_tpu.get(ping.remote(), timeout=60) == "up"
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_MEMORY_PRESSURE", None)
+        os.environ.pop("RAY_TPU_PRESSURE_TICK_S", None)
+        os.environ.pop("RAY_TPU_ARENA_SPILL_DIR", None)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_chaos_memory_worker_ballast_oom_preemption(seed):
+    """Worker host-memory ballast: a hog task blows the (lowered)
+    memory limit, the node's monitor SIGKILLs it, and with retries
+    exhausted it surfaces as the typed retriable OutOfMemoryError —
+    while every innocent task converges (zero lost tasks) and the
+    preemption lands on the federated
+    ray_tpu_oom_preemptions_total{reason} counter."""
+    os.environ["RAY_TPU_MEMORY_PRESSURE"] = "1"
+    os.environ["RAY_TPU_MEMORY_MONITOR_INTERVAL"] = "0.1"
+    try:
+        rt = ray_tpu.init(num_nodes=1, resources={"CPU": 4},
+                          cluster="daemons")
+        try:
+            mon = rt.memory_monitor
+            mon.interval_s = 0.1
+            if not mon._thread.is_alive():
+                mon.start()
+            baseline = mon.usage_bytes()
+            mon.set_limit(baseline + 150 * 1024 * 1024)
+
+            @ray_tpu.remote(max_retries=1)
+            def hog(s):
+                import numpy as np
+                import time as _t
+                blob = np.ones(400 * 1024 * 1024 // 8)   # ~400 MB
+                _t.sleep(20)
+                return blob.sum() + s
+
+            # innocents carry generous retries: the RetriableFIFO
+            # policy shoots the NEWEST retriable task, so any light
+            # task scheduled after the hog's (re)start can catch a
+            # stray bullet — it must retry through, never get lost
+            @ray_tpu.remote(max_retries=8)
+            def light(i):
+                time.sleep(0.05)
+                return i * 5
+
+            light_refs = [light.remote(i) for i in range(16)]
+            hog_ref = hog.remote(seed)
+
+            with pytest.raises(exc.OutOfMemoryError):
+                ray_tpu.get(hog_ref, timeout=120)
+            # zero lost tasks: every innocent task converges exactly
+            assert ray_tpu.get(light_refs, timeout=120) == [
+                i * 5 for i in range(16)]
+
+            kills = mon.kills
+            backend = getattr(rt, "cluster_backend", None)
+            if backend is not None:
+                for h in backend.daemons.values():
+                    kills += h.client.call("oom_check", task_id="",
+                                           fast_lane=False)["kills"]
+            assert kills >= 1
+
+            # the preemption federates with its reason tag
+            from ray_tpu.util import metrics
+            deadline = time.monotonic() + 30
+            reasons = set()
+            while time.monotonic() < deadline:
+                reasons = {
+                    dict(r.get("labels") or {}).get("reason")
+                    for r in metrics.cluster_metrics_json()["metrics"]
+                    if r["name"] == "ray_tpu_oom_preemptions_total"}
+                if reasons:
+                    break
+                time.sleep(0.25)
+            assert "host" in reasons or "tenant_quota" in reasons, reasons
+
+            # post-relief convergence: limit restored, the cluster runs
+            mon.set_limit(1 << 62)
+            assert ray_tpu.get(light.remote(99), timeout=60) == 495
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_MEMORY_PRESSURE", None)
+        os.environ.pop("RAY_TPU_MEMORY_MONITOR_INTERVAL", None)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_chaos_memory_combined_hard_window_backpressure(seed, tmp_path):
+    """Both ballasts at once: a forced host-hard window (the
+    pressure.level seam, armed per-node through the fail_points hook —
+    the deterministic stand-in for RSS ballast) OVER an arena overfill.
+    While hard: the level propagates to the driver's Node view (so
+    pick_node soft-excludes the victim), NEW puts reject with the typed
+    retriable error, and reads still pass. Store-level puts ride
+    RetryPolicy through the window; after relief every task and byte
+    has converged and the level returns to ok."""
+    from ray_tpu._private.ids import ObjectID
+    from ray_tpu.exceptions import MemoryPressureError
+
+    os.environ["RAY_TPU_MEMORY_PRESSURE"] = "1"
+    os.environ["RAY_TPU_PRESSURE_TICK_S"] = "0.1"
+    os.environ["RAY_TPU_ARENA_SPILL_DIR"] = str(tmp_path)
+    try:
+        rt = ray_tpu.init(num_nodes=2, resources={"CPU": 4},
+                          cluster="daemons",
+                          object_store_memory=4 * 1024 * 1024)
+        try:
+            victim = _first_daemon(rt)
+            _needs_arena(victim)
+            node = rt.get_node(victim.node_id)
+
+            # a pre-pressure object on the victim: reads must pass
+            # through the whole hard window
+            pre = ObjectID.from_random()
+            node.store.put(pre, b"pre-pressure", nbytes=12)
+
+            # ~4s of forced hard pressure on the victim only
+            out = victim.client.call(
+                "fail_points",
+                spec="pressure.level=return(hard):max=40",
+                seed=seed, timeout=5.0)
+            assert out["active"]
+            _wait_pressure(victim, "hard")
+
+            # the level rode the push/gossip to the driver's Node view
+            deadline = time.monotonic() + 10
+            while (getattr(node, "pressure_level", "ok") != "hard"
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert node.pressure_level == "hard"
+
+            # typed rejection of a NEW put; reads still pass
+            with pytest.raises(MemoryPressureError):
+                victim.put_object_blob(b"chaos:rejected", b"x" * 1024)
+            assert node.store.get(pre) == b"pre-pressure"
+
+            # tasks submitted DURING the window all converge (the other
+            # node takes them; the fallback would run them regardless)
+            @ray_tpu.remote(max_retries=2)
+            def work(i):
+                return i * 6
+
+            refs = [work.remote(i) for i in range(12)]
+
+            # arena overfill through the window: store-level puts ride
+            # RetryPolicy across the hard ticks, then spill keeps every
+            # one landing
+            rng = __import__("random").Random(seed)
+            oids = {}
+            for i in range(6):
+                oid = ObjectID.from_random()
+                blob = bytes([rng.randrange(256)]) * (1 << 20)
+                oids[oid] = blob
+                node.store.put(oid, blob, nbytes=len(blob))
+            assert ray_tpu.get(refs, timeout=120) == [
+                i * 6 for i in range(12)]
+            for oid, blob in oids.items():
+                assert node.store.get(oid) == blob
+            stats = _spill_stats(victim)
+            assert stats["spills"] >= 1, stats
+
+            # relief: the arm exhausts, the level converges to ok and
+            # the driver's view follows
+            _wait_pressure(victim, "ok", timeout=30.0)
+            deadline = time.monotonic() + 10
+            while (node.pressure_level != "ok"
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert node.pressure_level == "ok"
+            assert ray_tpu.get(work.remote(50), timeout=60) == 300
+        finally:
+            ray_tpu.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_MEMORY_PRESSURE", None)
+        os.environ.pop("RAY_TPU_PRESSURE_TICK_S", None)
+        os.environ.pop("RAY_TPU_ARENA_SPILL_DIR", None)

@@ -453,6 +453,9 @@ WIRED_SEAMS = [
     "arena.reservation_sweep",
     "net.link_drop",
     "net.partition_heal",
+    "arena.spill",
+    "arena.restore",
+    "pressure.level",
 ]
 
 

@@ -198,6 +198,58 @@ def test_local_store_tier_accounting_spill_restore(tmp_path):
     store.close()
 
 
+@needs_native
+def test_arena_spill_restore_tier_accounting(tmp_path):
+    """Arena spill is a tier transition, not a loss: spilled occupancy
+    (spilled_bytes / spill_stats) and the spill/restore byte counters
+    move in lockstep with the data, and a restore returns every byte to
+    host-shm (docs/object_plane.md "Arena spill")."""
+    import uuid
+
+    from ray_tpu._private.daemon import ObjectTable
+    from ray_tpu.util.metrics import Counter
+
+    def _total(name):
+        return sum(v for _, v in Counter(name, "").samples())
+
+    table = ObjectTable(f"rtpu_sp_{os.getpid()}_{uuid.uuid4().hex[:8]}",
+                        1 << 22, sweep=False, spill_dir=str(tmp_path))
+    if table._shm is None:
+        table.close()
+        pytest.skip("arena creation failed on this box")
+    try:
+        n = 300_000
+        spilled0 = _total("ray_tpu_arena_spilled_bytes_total")
+        restored0 = _total("ray_tpu_arena_restored_bytes_total")
+        table.put(b"obj", b"s" * n)
+        used_resident = table.used_bytes()
+        assert table.spilled_bytes() == 0
+
+        assert table.spill_to_fraction(0.0) == 1
+        assert table.spilled_bytes() == n
+        stats = table.spill_stats()
+        assert stats["spills"] == 1
+        assert stats["spilled_bytes"] == n
+        assert stats["spilled_now_count"] == 1
+        assert _total("ray_tpu_arena_spilled_bytes_total") - spilled0 == n
+        # the arena side actually freed (deferred-delete + reap ran)
+        assert table.used_bytes() < used_resident
+        # directory answers stay exact while parked on disk
+        assert table.contains(b"obj")
+        assert table.nbytes_of(b"obj") == n
+
+        assert table.get_blob(b"obj") == b"s" * n       # restores
+        assert table.spilled_bytes() == 0
+        stats = table.spill_stats()
+        assert stats["restores"] == 1
+        assert stats["restored_bytes"] == n
+        assert stats["spilled_now_count"] == 0
+        assert _total("ray_tpu_arena_restored_bytes_total") \
+            - restored0 == n
+    finally:
+        table.close()
+
+
 # ---------------------------------------------------------------------------
 # cluster e2e (daemons topology)
 # ---------------------------------------------------------------------------

@@ -170,6 +170,11 @@ _profiling.stop_process_sampler()
 # the in-process topology it measures the worker-pipe round trip
 # (docs/object_plane.md). Runs AFTER the paired overhead probes — see
 # the probe 4 comment for the interaction this ordering avoids.
+# The committed floor is the PRESSURE-DISARMED baseline: the
+# memory_pressure subsystem (spill tier + PressureController) defaults
+# off and this script never arms it, so the row doubles as the
+# zero-overhead-when-disarmed gate for that subsystem
+# (docs/fault_tolerance.md "Memory pressure & graceful degradation").
 
 
 @ray_tpu.remote

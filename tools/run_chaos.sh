@@ -26,18 +26,20 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-# the reclamation and network-partition scenarios get their own stages
+# the reclamation, network-partition and memory-pressure scenarios get
+# their own stages
 PROCKILL="sigkill or sweep_backstop"
 NETWORK="netchaos"
+MEMORY="chaos_memory"
 
 echo "=== chaos tier: in-process topology ==="
 RAY_TPU_CLUSTER= python -m pytest tests/test_chaos.py -q -m chaos \
-    -k "not ($PROCKILL) and not ($NETWORK)" \
+    -k "not ($PROCKILL) and not ($NETWORK) and not ($MEMORY)" \
     -p no:cacheprovider -p no:randomly "$@"
 
 echo "=== chaos tier: daemons topology ==="
 RAY_TPU_CLUSTER=daemons python -m pytest tests/test_chaos.py -q -m chaos \
-    -k "not ($PROCKILL) and not ($NETWORK)" \
+    -k "not ($PROCKILL) and not ($NETWORK) and not ($MEMORY)" \
     -p no:cacheprovider -p no:randomly "$@"
 
 echo "=== chaos tier: lock-sanitizer seed (in-process topology) ==="
@@ -78,4 +80,19 @@ RAY_TPU_CLUSTER= python -m pytest tests/test_chaos.py -q -m chaos \
 RAY_TPU_CLUSTER=daemons python -m pytest tests/test_chaos.py -q -m chaos \
     -k "$NETWORK" -p no:cacheprovider -p no:randomly "$@"
 
-echo "chaos tier: OK (both topologies + sanitized seed + process-kill + network)"
+echo "=== chaos tier: memory pressure (both topologies) ==="
+# OOM ballast campaign (docs/fault_tolerance.md "Memory pressure &
+# graceful degradation"): worker host-memory ballast under the memory
+# monitor's preemption policy, arena overfill through the spill tier
+# with a pinned zero-copy view held across the storm, and a forced
+# hard-pressure window (pressure.level failpoint armed per-node via the
+# fail_points RPC) over an arena overfill — every seed, swept over both
+# topology env settings (the scenarios boot their own daemons cluster
+# either way). Lost tasks, a spilled pinned entry, leaked slot refs, or
+# a node stuck off level ok fail the run inside the tests themselves.
+RAY_TPU_CLUSTER= python -m pytest tests/test_chaos.py -q -m chaos \
+    -k "$MEMORY" -p no:cacheprovider -p no:randomly "$@"
+RAY_TPU_CLUSTER=daemons python -m pytest tests/test_chaos.py -q -m chaos \
+    -k "$MEMORY" -p no:cacheprovider -p no:randomly "$@"
+
+echo "chaos tier: OK (both topologies + sanitized seed + process-kill + network + memory)"
